@@ -1,0 +1,29 @@
+"""BigDL-TPU: a TPU-native deep-learning framework with the capabilities of BigDL.
+
+A brand-new implementation of BigDL's public surface (reference: cnsky2016/BigDL,
+surveyed in SURVEY.md) designed for TPU from the ground up:
+
+- the numeric core is JAX/XLA arrays (the reference's ``bigdl.tensor`` strided
+  Tensor stack over Intel MKL, see reference ``tensor/Tensor.scala:36``);
+- every ``nn`` layer is a *pure function* ``apply(params, input)`` wrapped in a
+  thin Torch-style stateful shell (``forward``/``backward``), so whole models
+  fuse under one ``jax.jit`` + ``jax.grad`` instead of layer-at-a-time kernels
+  (reference ``nn/abstractnn/AbstractModule.scala:213``);
+- distributed training replaces the BlockManager fp16 parameter server
+  (reference ``parameters/AllReduceParameter.scala:67``) with XLA collectives
+  over a ``jax.sharding.Mesh`` — data parallelism via batch sharding, ZeRO-1
+  sharded optimizer state via reduce-scatter/all-gather, tensor/sequence
+  parallel axes for scale the reference never had.
+"""
+
+__version__ = "0.1.0"
+
+from bigdl_tpu.engine import Engine
+
+from bigdl_tpu import nn
+from bigdl_tpu import optim
+from bigdl_tpu import dataset
+from bigdl_tpu import parallel
+from bigdl_tpu import utils
+from bigdl_tpu import models
+from bigdl_tpu import visualization

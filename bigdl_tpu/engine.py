@@ -1,0 +1,142 @@
+"""Engine: process-wide topology and device management.
+
+Reference equivalent: ``utils/Engine.scala:36`` — a singleton owning engine type
+(MklBlas), node/core topology, and the two CPU thread pools (``Engine.default``
+coarse task pool, ``Engine.model`` intra-layer pool).
+
+On TPU none of the thread-pool machinery survives: XLA owns intra-op
+parallelism, and the "N model replicas per node sharing one weight storage"
+trick (reference ``optim/DistriOptimizer.scala:516-531``) collapses into a
+single larger per-chip batch under ``jit``.  What remains is topology: which
+devices exist, how they are arranged into a ``jax.sharding.Mesh``, and a
+single place to configure precision and engine type.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+
+class _EngineState:
+    def __init__(self):
+        self.engine_type: str = "tpu"
+        self.node_number: int = 1
+        self.core_number: int = 1
+        self.inited: bool = False
+        self.seed: int = 0
+        self._mesh = None
+        self._lock = threading.RLock()
+
+
+_STATE = _EngineState()
+
+
+class Engine:
+    """Static topology singleton (mirrors reference ``utils/Engine``)."""
+
+    MESH_AXES = ("data", "model", "seq")
+
+    @staticmethod
+    def init(node_number: Optional[int] = None,
+             core_number: Optional[int] = None,
+             engine_type: Optional[str] = None) -> None:
+        """Initialise topology.
+
+        ``node_number``/``core_number`` keep the reference's vocabulary
+        (reference ``utils/Engine.scala:313``): here a "node" is a host
+        participating in the jax distributed runtime and a "core" is a local
+        accelerator device.  Defaults are discovered from JAX.
+        """
+        with _STATE._lock:
+            _STATE.engine_type = engine_type or os.environ.get(
+                "BIGDL_ENGINE_TYPE", _default_engine_type())
+            _STATE.node_number = node_number or jax.process_count()
+            _STATE.core_number = core_number or jax.local_device_count()
+            _STATE.inited = True
+
+    @staticmethod
+    def node_number() -> int:
+        Engine._ensure()
+        return _STATE.node_number
+
+    @staticmethod
+    def core_number() -> int:
+        Engine._ensure()
+        return _STATE.core_number
+
+    @staticmethod
+    def engine_type() -> str:
+        Engine._ensure()
+        return _STATE.engine_type
+
+    @staticmethod
+    def device_count() -> int:
+        return jax.device_count()
+
+    @staticmethod
+    def devices():
+        return jax.devices()
+
+    @staticmethod
+    def set_seed(seed: int) -> None:
+        _STATE.seed = seed
+
+    @staticmethod
+    def get_seed() -> int:
+        return _STATE.seed
+
+    # ---- mesh -----------------------------------------------------------
+
+    @staticmethod
+    def create_mesh(mesh_shape: Optional[Sequence[int]] = None,
+                    axis_names: Optional[Sequence[str]] = None,
+                    devices=None) -> "jax.sharding.Mesh":
+        """Build a device mesh.
+
+        Default: all devices on the ``data`` axis (pure data parallelism —
+        the only parallelism the reference supports, SURVEY §2.12).  Pass
+        ``mesh_shape``/``axis_names`` for dp x tp x sp meshes.
+        """
+        from jax.sharding import Mesh
+
+        devs = np.asarray(devices if devices is not None else jax.devices())
+        if mesh_shape is None:
+            mesh_shape = (devs.size,)
+            axis_names = axis_names or ("data",)
+        axis_names = tuple(axis_names or Engine.MESH_AXES[:len(mesh_shape)])
+        if int(np.prod(mesh_shape)) != devs.size:
+            raise ValueError(
+                f"mesh shape {tuple(mesh_shape)} does not cover {devs.size} devices")
+        return Mesh(devs.reshape(mesh_shape), axis_names)
+
+    @staticmethod
+    def default_mesh() -> "jax.sharding.Mesh":
+        with _STATE._lock:
+            if _STATE._mesh is None:
+                _STATE._mesh = Engine.create_mesh()
+            return _STATE._mesh
+
+    @staticmethod
+    def set_default_mesh(mesh) -> None:
+        with _STATE._lock:
+            _STATE._mesh = mesh
+
+    # ---- internal -------------------------------------------------------
+
+    @staticmethod
+    def _ensure() -> None:
+        if not _STATE.inited:
+            Engine.init()
+
+
+def _default_engine_type() -> str:
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:  # pragma: no cover - no devices at all
+        platform = "cpu"
+    return "tpu" if platform in ("tpu", "axon") else platform

@@ -1,0 +1,331 @@
+"""Activation layers + stochastic regularizers.
+
+Reference: the activation files in ``nn/`` (ReLU.scala, Tanh.scala, ...,
+HardShrink.scala), ``nn/Dropout.scala:44``, ``nn/L1Penalty.scala``,
+``nn/PReLU.scala``, ``nn/RReLU.scala``.
+
+All are stateless elementwise maps — the VPU's bread and butter — and fuse
+into adjacent matmuls under XLA, replacing the reference's MKL VML dispatch
+(``tensor/TensorNumeric.scala:195-340``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+
+
+class _Elementwise(Module):
+    def _fn(self, x):
+        raise NotImplementedError
+
+    def apply(self, params, input, state, training=False, rng=None):
+        return self._fn(input), state
+
+
+class ReLU(_Elementwise):
+    def __init__(self, ip: bool = False, name=None):
+        super().__init__(name)
+        self.inplace = ip  # meaningless under XLA; kept for API parity
+
+    def _fn(self, x):
+        return jax.nn.relu(x)
+
+
+class ReLU6(_Elementwise):
+    def _fn(self, x):
+        return jnp.clip(x, 0.0, 6.0)
+
+
+class LeakyReLU(_Elementwise):
+    def __init__(self, negval: float = 0.01, inplace: bool = False, name=None):
+        super().__init__(name)
+        self.negval = negval
+
+    def _fn(self, x):
+        return jnp.where(x >= 0, x, x * self.negval)
+
+
+class ELU(_Elementwise):
+    def __init__(self, alpha: float = 1.0, inplace: bool = False, name=None):
+        super().__init__(name)
+        self.alpha = alpha
+
+    def _fn(self, x):
+        return jnp.where(x > 0, x, self.alpha * (jnp.exp(x) - 1.0))
+
+
+class Tanh(_Elementwise):
+    def _fn(self, x):
+        return jnp.tanh(x)
+
+
+class TanhShrink(_Elementwise):
+    def _fn(self, x):
+        return x - jnp.tanh(x)
+
+
+class Sigmoid(_Elementwise):
+    def _fn(self, x):
+        return jax.nn.sigmoid(x)
+
+
+class LogSigmoid(_Elementwise):
+    def _fn(self, x):
+        return jax.nn.log_sigmoid(x)
+
+
+class SoftMax(_Elementwise):
+    """Softmax over the last dim for 1-D/2-D input (torch semantics)."""
+
+    def _fn(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+
+class SoftMin(_Elementwise):
+    def _fn(self, x):
+        return jax.nn.softmax(-x, axis=-1)
+
+
+class LogSoftMax(_Elementwise):
+    def _fn(self, x):
+        return jax.nn.log_softmax(x, axis=-1)
+
+
+class SoftPlus(_Elementwise):
+    def __init__(self, beta: float = 1.0, name=None):
+        super().__init__(name)
+        self.beta = beta
+
+    def _fn(self, x):
+        return jax.nn.softplus(self.beta * x) / self.beta
+
+
+class SoftSign(_Elementwise):
+    def _fn(self, x):
+        return x / (1.0 + jnp.abs(x))
+
+
+class SoftShrink(_Elementwise):
+    def __init__(self, lambd: float = 0.5, name=None):
+        super().__init__(name)
+        self.lambd = lambd
+
+    def _fn(self, x):
+        return jnp.where(x > self.lambd, x - self.lambd,
+                         jnp.where(x < -self.lambd, x + self.lambd, 0.0))
+
+
+class HardShrink(_Elementwise):
+    def __init__(self, lambd: float = 0.5, name=None):
+        super().__init__(name)
+        self.lambd = lambd
+
+    def _fn(self, x):
+        return jnp.where(jnp.abs(x) > self.lambd, x, 0.0)
+
+
+class HardTanh(_Elementwise):
+    def __init__(self, min_value: float = -1.0, max_value: float = 1.0,
+                 inplace: bool = False, name=None):
+        super().__init__(name)
+        self.min_value, self.max_value = min_value, max_value
+
+    def _fn(self, x):
+        return jnp.clip(x, self.min_value, self.max_value)
+
+
+class Clamp(HardTanh):
+    def __init__(self, min_value: float, max_value: float, name=None):
+        super().__init__(min_value, max_value, name=name)
+
+
+class Threshold(_Elementwise):
+    def __init__(self, th: float = 1e-6, v: float = 0.0,
+                 ip: bool = False, name=None):
+        super().__init__(name)
+        self.th, self.v = th, v
+
+    def _fn(self, x):
+        return jnp.where(x > self.th, x, self.v)
+
+
+class Power(_Elementwise):
+    """(shift + scale * x) ** power (reference ``nn/Power.scala``)."""
+
+    def __init__(self, power: float, scale: float = 1.0, shift: float = 0.0,
+                 name=None):
+        super().__init__(name)
+        self.power, self.scale, self.shift = power, scale, shift
+
+    def _fn(self, x):
+        return jnp.power(self.shift + self.scale * x, self.power)
+
+
+class Sqrt(_Elementwise):
+    def _fn(self, x):
+        return jnp.sqrt(x)
+
+
+class Square(_Elementwise):
+    def _fn(self, x):
+        return x * x
+
+
+class Abs(_Elementwise):
+    def _fn(self, x):
+        return jnp.abs(x)
+
+
+class Log(_Elementwise):
+    def _fn(self, x):
+        return jnp.log(x)
+
+
+class Exp(_Elementwise):
+    def _fn(self, x):
+        return jnp.exp(x)
+
+
+class Negative(_Elementwise):
+    def _fn(self, x):
+        return -x
+
+
+class PReLU(Module):
+    """ReLU with learnable negative slope (reference ``nn/PReLU.scala``).
+    n_output_plane=0 -> one shared slope; else one per channel (dim 1)."""
+
+    def __init__(self, n_output_plane: int = 0, name=None):
+        super().__init__(name)
+        self.n_output_plane = n_output_plane
+
+    def _init_params(self, rng):
+        n = max(1, self.n_output_plane)
+        return {"weight": jnp.full((n,), 0.25)}
+
+    def apply(self, params, input, state, training=False, rng=None):
+        w = params["weight"]
+        if self.n_output_plane > 0:
+            # broadcast across channel dim: input (N, C, ...) or (C, ...)
+            ch_axis = 1 if input.ndim > 3 or input.ndim == 2 else 0
+            shape = [1] * input.ndim
+            shape[ch_axis] = w.shape[0]
+            w = jnp.reshape(w, shape)
+        return jnp.where(input >= 0, input, input * w), state
+
+
+class RReLU(Module):
+    """Randomized leaky ReLU (reference ``nn/RReLU.scala``): slope ~
+    U(lower, upper) during training, fixed mean slope at inference."""
+
+    def __init__(self, lower: float = 1.0 / 8, upper: float = 1.0 / 3,
+                 inplace: bool = False, name=None):
+        super().__init__(name)
+        self.lower, self.upper = lower, upper
+
+    def is_stochastic(self):
+        return True
+
+    def apply(self, params, input, state, training=False, rng=None):
+        if training and rng is not None:
+            slope = jax.random.uniform(rng, input.shape, input.dtype,
+                                       self.lower, self.upper)
+        else:
+            slope = (self.lower + self.upper) / 2.0
+        return jnp.where(input >= 0, input, input * slope), state
+
+
+class Dropout(Module):
+    """Inverted dropout (reference ``nn/Dropout.scala:44``)."""
+
+    def __init__(self, init_p: float = 0.5, inplace: bool = False,
+                 scale: bool = True, name=None):
+        super().__init__(name)
+        self.p = init_p
+        self.scale = scale
+
+    def is_stochastic(self):
+        return True
+
+    def set_p(self, p: float):
+        self.p = p
+        self._jit_apply = None
+        return self
+
+    def apply(self, params, input, state, training=False, rng=None):
+        if not training or self.p <= 0.0 or rng is None:
+            return input, state
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(rng, keep, input.shape).astype(input.dtype)
+        out = input * mask
+        if self.scale:
+            out = out / keep
+        return out, state
+
+
+class GaussianDropout(Module):
+    """Multiplicative gaussian noise N(1, p/(1-p))."""
+
+    def __init__(self, rate: float, name=None):
+        super().__init__(name)
+        self.rate = rate
+
+    def is_stochastic(self):
+        return True
+
+    def apply(self, params, input, state, training=False, rng=None):
+        if not training or rng is None:
+            return input, state
+        stddev = (self.rate / (1.0 - self.rate)) ** 0.5
+        noise = 1.0 + stddev * jax.random.normal(rng, input.shape, input.dtype)
+        return input * noise, state
+
+
+class GaussianNoise(Module):
+    """Additive gaussian noise (training only)."""
+
+    def __init__(self, stddev: float, name=None):
+        super().__init__(name)
+        self.stddev = stddev
+
+    def is_stochastic(self):
+        return True
+
+    def apply(self, params, input, state, training=False, rng=None):
+        if not training or rng is None:
+            return input, state
+        return input + self.stddev * jax.random.normal(rng, input.shape,
+                                                       input.dtype), state
+
+
+class L1Penalty(Module):
+    """Identity forward; adds l1 sparsity gradient in backward
+    (reference ``nn/L1Penalty.scala``).  Realised as a custom VJP so the same
+    behavior falls out of whole-model ``jax.grad``."""
+
+    def __init__(self, l1weight: float, size_average: bool = False,
+                 provide_output: bool = True, name=None):
+        super().__init__(name)
+        self.l1weight = l1weight
+        self.size_average = size_average
+
+    def apply(self, params, input, state, training=False, rng=None):
+        w = self.l1weight
+        size_average = self.size_average
+
+        @jax.custom_vjp
+        def penalty(x):
+            return x
+
+        def fwd(x):
+            return x, x
+
+        def bwd(x, g):
+            m = w / x.size if size_average else w
+            return (g + m * jnp.sign(x),)
+
+        penalty.defvjp(fwd, bwd)
+        return (penalty(input) if training else input), state

@@ -1,0 +1,413 @@
+"""Convolution layers.
+
+Reference: ``nn/SpatialConvolution.scala:42`` (im2col+gemm with per-frame
+threading), ``nn/SpatialShareConvolution.scala:29``,
+``nn/SpatialDilatedConvolution.scala``, ``nn/SpatialFullConvolution.scala``,
+``nn/TemporalConvolution.scala:49``, ``nn/VolumetricConvolution.scala``,
+``nn/VolumetricFullConvolution.scala``, ``nn/SpatialConvolutionMap.scala``.
+
+BigDL argument order is (kernelW, kernelH, strideW, strideH, padW, padH);
+arrays are (..., H, W), so the (W, H) pairs are swapped once at the
+constructor edge.  pad = -1 means SAME padding (reference convention).
+Kernels are stored HWIO; activations default NCHW with an optional
+``format="NHWC"`` for the TPU-preferred layout.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.nn import init as init_methods
+from bigdl_tpu import ops
+
+
+class SpatialConvolution(Module):
+    """2-D convolution (reference ``nn/SpatialConvolution.scala:42``)."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 kernel_w: int, kernel_h: int,
+                 stride_w: int = 1, stride_h: int = 1,
+                 pad_w: int = 0, pad_h: int = 0,
+                 n_group: int = 1, propagate_back: bool = True,
+                 w_regularizer=None, b_regularizer=None,
+                 init_weight=None, init_bias=None,
+                 with_bias: bool = True, format: str = "NCHW", name=None):
+        super().__init__(name)
+        assert n_input_plane % n_group == 0, \
+            "Number of input channels should be multiples of group."
+        assert n_output_plane % n_group == 0, \
+            "Number of output channels should be multiples of group."
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.kernel_w, self.kernel_h = kernel_w, kernel_h
+        self.stride_w, self.stride_h = stride_w, stride_h
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.n_group = n_group
+        self.propagate_back = propagate_back
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+        self.init_weight = init_weight
+        self.init_bias = init_bias
+        self.with_bias = with_bias
+        self.format = format
+        self.weight_init_method = init_methods.RandomUniform()
+        self.bias_init_method = init_methods.RandomUniform()
+
+    def set_init_method(self, weight_init=None, bias_init=None):
+        if weight_init is not None:
+            self.weight_init_method = weight_init
+        if bias_init is not None:
+            self.bias_init_method = bias_init
+        return self
+
+    @property
+    def _fans(self):
+        fan_in = (self.n_input_plane // self.n_group) * self.kernel_h * self.kernel_w
+        fan_out = (self.n_output_plane // self.n_group) * self.kernel_h * self.kernel_w
+        return fan_in, fan_out
+
+    def _init_params(self, rng):
+        k1, k2 = jax.random.split(rng)
+        fan_in, fan_out = self._fans
+        shape = (self.kernel_h, self.kernel_w,
+                 self.n_input_plane // self.n_group, self.n_output_plane)
+        if self.init_weight is not None:
+            w = jnp.asarray(self.init_weight)
+            if w.shape != shape:
+                # accept reference (group, out/g, in/g, kh, kw) layout
+                w = jnp.reshape(w, (self.n_group,
+                                    self.n_output_plane // self.n_group,
+                                    self.n_input_plane // self.n_group,
+                                    self.kernel_h, self.kernel_w))
+                w = jnp.transpose(w, (3, 4, 2, 0, 1)).reshape(shape)
+        else:
+            w = self.weight_init_method(k1, shape, fan_in, fan_out)
+        p = {"weight": w}
+        if self.with_bias:
+            if self.init_bias is not None:
+                p["bias"] = jnp.asarray(self.init_bias)
+            else:
+                p["bias"] = self.bias_init_method(k2, (self.n_output_plane,),
+                                                  fan_in, fan_out)
+        return p
+
+    def _padding(self):
+        if self.pad_w == -1 or self.pad_h == -1:
+            return "SAME"
+        return (self.pad_h, self.pad_w)
+
+    def apply(self, params, input, state, training=False, rng=None):
+        squeeze = input.ndim == 3
+        if squeeze:
+            input = input[None]
+        out = ops.conv2d(input, params["weight"],
+                         params.get("bias") if self.with_bias else None,
+                         stride=(self.stride_h, self.stride_w),
+                         padding=self._padding(),
+                         groups=self.n_group, format=self.format)
+        if squeeze:
+            out = out[0]
+        return out, state
+
+
+class SpatialShareConvolution(SpatialConvolution):
+    """Buffer-sharing variant in the reference
+    (``nn/SpatialShareConvolution.scala:29``); on TPU there are no im2col
+    buffers to share, so this is semantically identical to SpatialConvolution."""
+
+
+class SpatialDilatedConvolution(Module):
+    """Atrous 2-D convolution (reference ``nn/SpatialDilatedConvolution.scala``)."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 kw: int, kh: int, dw: int = 1, dh: int = 1,
+                 pad_w: int = 0, pad_h: int = 0,
+                 dilation_w: int = 1, dilation_h: int = 1,
+                 w_regularizer=None, b_regularizer=None,
+                 format: str = "NCHW", name=None):
+        super().__init__(name)
+        self.n_input_plane, self.n_output_plane = n_input_plane, n_output_plane
+        self.kw, self.kh = kw, kh
+        self.dw, self.dh = dw, dh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.dilation_w, self.dilation_h = dilation_w, dilation_h
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+        self.format = format
+
+    def _init_params(self, rng):
+        k1, k2 = jax.random.split(rng)
+        fan_in = self.n_input_plane * self.kh * self.kw
+        stdv = 1.0 / math.sqrt(fan_in)
+        w = jax.random.uniform(k1, (self.kh, self.kw, self.n_input_plane,
+                                    self.n_output_plane),
+                               minval=-stdv, maxval=stdv)
+        b = jax.random.uniform(k2, (self.n_output_plane,), minval=-stdv, maxval=stdv)
+        return {"weight": w, "bias": b}
+
+    def apply(self, params, input, state, training=False, rng=None):
+        squeeze = input.ndim == 3
+        if squeeze:
+            input = input[None]
+        out = ops.conv2d(input, params["weight"], params["bias"],
+                         stride=(self.dh, self.dw),
+                         padding=(self.pad_h, self.pad_w),
+                         dilation=(self.dilation_h, self.dilation_w),
+                         format=self.format)
+        if squeeze:
+            out = out[0]
+        return out, state
+
+
+class SpatialFullConvolution(Module):
+    """Transposed (fractionally-strided) convolution, a.k.a. deconvolution
+    (reference ``nn/SpatialFullConvolution.scala``)."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 kw: int, kh: int, dw: int = 1, dh: int = 1,
+                 pad_w: int = 0, pad_h: int = 0,
+                 adj_w: int = 0, adj_h: int = 0,
+                 n_group: int = 1, no_bias: bool = False,
+                 w_regularizer=None, b_regularizer=None,
+                 format: str = "NCHW", name=None):
+        super().__init__(name)
+        self.n_input_plane, self.n_output_plane = n_input_plane, n_output_plane
+        self.kw, self.kh = kw, kh
+        self.dw, self.dh = dw, dh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.adj_w, self.adj_h = adj_w, adj_h
+        self.n_group = n_group
+        self.no_bias = no_bias
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+        self.format = format
+        self.weight_init_method = init_methods.RandomUniform()
+        self.bias_init_method = init_methods.RandomUniform()
+
+    def set_init_method(self, weight_init=None, bias_init=None):
+        if weight_init is not None:
+            self.weight_init_method = weight_init
+        if bias_init is not None:
+            self.bias_init_method = bias_init
+        return self
+
+    def _init_params(self, rng):
+        k1, k2 = jax.random.split(rng)
+        fan_in = self.n_input_plane * self.kh * self.kw
+        shape = (self.kh, self.kw, self.n_input_plane, self.n_output_plane)
+        w = self.weight_init_method(k1, shape, fan_in, fan_in)
+        p = {"weight": w}
+        if not self.no_bias:
+            p["bias"] = self.bias_init_method(k2, (self.n_output_plane,),
+                                              fan_in, fan_in)
+        return p
+
+    def apply(self, params, input, state, training=False, rng=None):
+        squeeze = input.ndim == 3
+        if squeeze:
+            input = input[None]
+        out = ops.conv_transpose2d(
+            input, params["weight"],
+            None if self.no_bias else params.get("bias"),
+            stride=(self.dh, self.dw), padding=(self.pad_h, self.pad_w),
+            adj=(self.adj_h, self.adj_w), format=self.format)
+        if squeeze:
+            out = out[0]
+        return out, state
+
+
+class TemporalConvolution(Module):
+    """1-D convolution over (N, T, C) sequences
+    (reference ``nn/TemporalConvolution.scala:49``)."""
+
+    def __init__(self, input_frame_size: int, output_frame_size: int,
+                 kernel_w: int, stride_w: int = 1,
+                 propagate_back: bool = True,
+                 w_regularizer=None, b_regularizer=None, name=None):
+        super().__init__(name)
+        self.input_frame_size = input_frame_size
+        self.output_frame_size = output_frame_size
+        self.kernel_w = kernel_w
+        self.stride_w = stride_w
+        self.propagate_back = propagate_back
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+        self.weight_init_method = init_methods.RandomUniform()
+        self.bias_init_method = init_methods.RandomUniform()
+
+    def set_init_method(self, weight_init=None, bias_init=None):
+        if weight_init is not None:
+            self.weight_init_method = weight_init
+        if bias_init is not None:
+            self.bias_init_method = bias_init
+        return self
+
+    def _init_params(self, rng):
+        k1, k2 = jax.random.split(rng)
+        fan_in = self.input_frame_size * self.kernel_w
+        w = self.weight_init_method(
+            k1, (self.kernel_w, self.input_frame_size, self.output_frame_size),
+            fan_in, self.output_frame_size * self.kernel_w)
+        b = self.bias_init_method(k2, (self.output_frame_size,), fan_in, fan_in)
+        return {"weight": w, "bias": b}
+
+    def apply(self, params, input, state, training=False, rng=None):
+        squeeze = input.ndim == 2
+        if squeeze:
+            input = input[None]
+        out = ops.temporal_conv1d(input, params["weight"], params["bias"],
+                                  stride=self.stride_w)
+        if squeeze:
+            out = out[0]
+        return out, state
+
+
+class VolumetricConvolution(Module):
+    """3-D convolution over (N, C, D, H, W)
+    (reference ``nn/VolumetricConvolution.scala``)."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 k_t: int, k_w: int, k_h: int,
+                 d_t: int = 1, d_w: int = 1, d_h: int = 1,
+                 pad_t: int = 0, pad_w: int = 0, pad_h: int = 0,
+                 with_bias: bool = True, name=None):
+        super().__init__(name)
+        self.n_input_plane, self.n_output_plane = n_input_plane, n_output_plane
+        self.k_t, self.k_w, self.k_h = k_t, k_w, k_h
+        self.d_t, self.d_w, self.d_h = d_t, d_w, d_h
+        self.pad_t, self.pad_w, self.pad_h = pad_t, pad_w, pad_h
+        self.with_bias = with_bias
+
+    def _init_params(self, rng):
+        k1, k2 = jax.random.split(rng)
+        fan_in = self.n_input_plane * self.k_t * self.k_h * self.k_w
+        stdv = 1.0 / math.sqrt(fan_in)
+        w = jax.random.uniform(
+            k1, (self.k_t, self.k_h, self.k_w, self.n_input_plane,
+                 self.n_output_plane), minval=-stdv, maxval=stdv)
+        p = {"weight": w}
+        if self.with_bias:
+            p["bias"] = jax.random.uniform(k2, (self.n_output_plane,),
+                                           minval=-stdv, maxval=stdv)
+        return p
+
+    def apply(self, params, input, state, training=False, rng=None):
+        squeeze = input.ndim == 4
+        if squeeze:
+            input = input[None]
+        out = ops.conv3d(input, params["weight"],
+                         params.get("bias") if self.with_bias else None,
+                         stride=(self.d_t, self.d_h, self.d_w),
+                         padding=(self.pad_t, self.pad_h, self.pad_w))
+        if squeeze:
+            out = out[0]
+        return out, state
+
+
+class VolumetricFullConvolution(Module):
+    """Transposed 3-D convolution (reference ``nn/VolumetricFullConvolution.scala``)."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 k_t: int, k_w: int, k_h: int,
+                 d_t: int = 1, d_w: int = 1, d_h: int = 1,
+                 pad_t: int = 0, pad_w: int = 0, pad_h: int = 0,
+                 adj_t: int = 0, adj_w: int = 0, adj_h: int = 0,
+                 no_bias: bool = False, name=None):
+        super().__init__(name)
+        self.n_input_plane, self.n_output_plane = n_input_plane, n_output_plane
+        self.k_t, self.k_w, self.k_h = k_t, k_w, k_h
+        self.d_t, self.d_w, self.d_h = d_t, d_w, d_h
+        self.pad_t, self.pad_w, self.pad_h = pad_t, pad_w, pad_h
+        self.adj_t, self.adj_w, self.adj_h = adj_t, adj_w, adj_h
+        self.no_bias = no_bias
+
+    def _init_params(self, rng):
+        k1, k2 = jax.random.split(rng)
+        fan_in = self.n_input_plane * self.k_t * self.k_h * self.k_w
+        stdv = 1.0 / math.sqrt(fan_in)
+        w = jax.random.uniform(
+            k1, (self.k_t, self.k_h, self.k_w, self.n_input_plane,
+                 self.n_output_plane), minval=-stdv, maxval=stdv)
+        p = {"weight": w}
+        if not self.no_bias:
+            p["bias"] = jax.random.uniform(k2, (self.n_output_plane,),
+                                           minval=-stdv, maxval=stdv)
+        return p
+
+    def apply(self, params, input, state, training=False, rng=None):
+        from bigdl_tpu.ops.convolution import conv_transpose3d
+        squeeze = input.ndim == 4
+        if squeeze:
+            input = input[None]
+        out = conv_transpose3d(input, params["weight"],
+                               None if self.no_bias else params.get("bias"),
+                               stride=(self.d_t, self.d_h, self.d_w),
+                               padding=(self.pad_t, self.pad_h, self.pad_w),
+                               adj=(self.adj_t, self.adj_h, self.adj_w))
+        if squeeze:
+            out = out[0]
+        return out, state
+
+
+class SpatialConvolutionMap(Module):
+    """Convolution with an explicit input-plane -> output-plane connection
+    table (reference ``nn/SpatialConvolutionMap.scala``).  Expressed as a
+    dense convolution with a fixed binary mask over the kernel."""
+
+    def __init__(self, conn_table, kw: int, kh: int,
+                 dw: int = 1, dh: int = 1, pad_w: int = 0, pad_h: int = 0,
+                 name=None):
+        super().__init__(name)
+        self.conn_table = jnp.asarray(conn_table, jnp.int32)  # (K, 2) 1-based
+        self.kw, self.kh = kw, kh
+        self.dw, self.dh = dw, dh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.n_input_plane = int(self.conn_table[:, 0].max())
+        self.n_output_plane = int(self.conn_table[:, 1].max())
+
+    @staticmethod
+    def full(nin: int, nout: int):
+        import numpy as np
+        t = [[i + 1, o + 1] for o in range(nout) for i in range(nin)]
+        return np.asarray(t)
+
+    @staticmethod
+    def one_to_one(n: int):
+        import numpy as np
+        return np.asarray([[i + 1, i + 1] for i in range(n)])
+
+    def _init_params(self, rng):
+        k1, k2 = jax.random.split(rng)
+        n_conn = self.conn_table.shape[0]
+        fan_in = self.kh * self.kw * n_conn // max(1, self.n_output_plane)
+        stdv = 1.0 / math.sqrt(fan_in * 1.0)
+        w = jax.random.uniform(
+            k1, (self.kh, self.kw, self.n_input_plane, self.n_output_plane),
+            minval=-stdv, maxval=stdv)
+        b = jax.random.uniform(k2, (self.n_output_plane,), minval=-stdv,
+                               maxval=stdv)
+        return {"weight": w, "bias": b}
+
+    def _mask(self):
+        import numpy as np
+        m = np.zeros((1, 1, self.n_input_plane, self.n_output_plane), np.float32)
+        ct = np.asarray(self.conn_table)
+        m[0, 0, ct[:, 0] - 1, ct[:, 1] - 1] = 1.0
+        return jnp.asarray(m)
+
+    def apply(self, params, input, state, training=False, rng=None):
+        squeeze = input.ndim == 3
+        if squeeze:
+            input = input[None]
+        w = params["weight"] * self._mask()
+        out = ops.conv2d(input, w, params["bias"],
+                         stride=(self.dh, self.dw),
+                         padding=(self.pad_h, self.pad_w))
+        if squeeze:
+            out = out[0]
+        return out, state

@@ -1,0 +1,441 @@
+"""Loss functions (criterions).
+
+Reference: the 24 criterion files in ``nn/`` (SURVEY §2.6) —
+ClassNLLCriterion.scala, CrossEntropyCriterion.scala, MSECriterion.scala, ...
+
+Conventions kept from the reference/Torch: class labels are 1-based floats;
+``size_average=True`` divides by batch size (or element count where Torch
+does); each criterion is a pure ``apply(input, target) -> scalar``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.module import Criterion
+
+
+def _to_index(target):
+    return jnp.asarray(target).astype(jnp.int32) - 1  # 1-based -> 0-based
+
+
+class ClassNLLCriterion(Criterion):
+    """NLL over log-probabilities, 1-based integer targets
+    (reference ``nn/ClassNLLCriterion.scala``)."""
+
+    def __init__(self, weights=None, size_average: bool = True):
+        super().__init__()
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        logp = jnp.atleast_2d(input)
+        idx = jnp.ravel(_to_index(target))
+        picked = jnp.take_along_axis(logp, idx[:, None], axis=1)[:, 0]
+        if self.weights is not None:
+            w = jnp.take(self.weights, idx)
+            loss = -jnp.sum(picked * w)
+            denom = jnp.sum(w)
+        else:
+            loss = -jnp.sum(picked)
+            denom = logp.shape[0]
+        return loss / denom if self.size_average else loss
+
+
+class CrossEntropyCriterion(Criterion):
+    """LogSoftMax + ClassNLL fused (reference ``nn/CrossEntropyCriterion.scala``)."""
+
+    def __init__(self, weights=None, size_average: bool = True):
+        super().__init__()
+        self.nll = ClassNLLCriterion(weights, size_average)
+
+    def apply(self, input, target):
+        return self.nll.apply(jax.nn.log_softmax(input, axis=-1), target)
+
+
+class MSECriterion(Criterion):
+    def apply(self, input, target):
+        d = input - target
+        s = jnp.sum(d * d)
+        return s / input.size if self.size_average else s
+
+
+class AbsCriterion(Criterion):
+    def apply(self, input, target):
+        s = jnp.sum(jnp.abs(input - target))
+        return s / input.size if self.size_average else s
+
+
+class BCECriterion(Criterion):
+    """Binary cross entropy on probabilities (reference ``nn/BCECriterion.scala``)."""
+
+    def __init__(self, weights=None, size_average: bool = True):
+        super().__init__()
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        eps = 1e-12
+        x = jnp.clip(input, eps, 1.0 - eps)
+        l = -(target * jnp.log(x) + (1.0 - target) * jnp.log(1.0 - x))
+        if self.weights is not None:
+            l = l * self.weights
+        s = jnp.sum(l)
+        return s / input.size if self.size_average else s
+
+
+class DistKLDivCriterion(Criterion):
+    """KL(target || input) with input = log-probs
+    (reference ``nn/DistKLDivCriterion.scala``)."""
+
+    def apply(self, input, target):
+        l = jnp.where(target > 0, target * (jnp.log(jnp.maximum(target, 1e-30))
+                                            - input), 0.0)
+        s = jnp.sum(l)
+        n = input.shape[0] if input.ndim > 1 else 1
+        return s / n if self.size_average else s
+
+
+class CosineEmbeddingCriterion(Criterion):
+    """Input Table [x1, x2], target +-1 (reference ``nn/CosineEmbeddingCriterion.scala``)."""
+
+    def __init__(self, margin: float = 0.0, size_average: bool = True):
+        super().__init__()
+        self.margin = margin
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        x1, x2 = input[0], input[1]
+        y = jnp.ravel(jnp.asarray(target))
+        x1 = jnp.atleast_2d(x1)
+        x2 = jnp.atleast_2d(x2)
+        cos = jnp.sum(x1 * x2, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1), 1e-12)
+        l = jnp.where(y > 0, 1.0 - cos, jnp.maximum(0.0, cos - self.margin))
+        return jnp.mean(l) if self.size_average else jnp.sum(l)
+
+
+class HingeEmbeddingCriterion(Criterion):
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        super().__init__()
+        self.margin = margin
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        l = jnp.where(target > 0, input,
+                      jnp.maximum(0.0, self.margin - input))
+        return jnp.mean(l) if self.size_average else jnp.sum(l)
+
+
+class L1HingeEmbeddingCriterion(Criterion):
+    """Input Table [x1, x2]; L1 distance hinge
+    (reference ``nn/L1HingeEmbeddingCriterion.scala``)."""
+
+    def __init__(self, margin: float = 1.0):
+        super().__init__()
+        self.margin = margin
+
+    def apply(self, input, target):
+        d = jnp.sum(jnp.abs(input[0] - input[1]))
+        y = jnp.ravel(jnp.asarray(target))[0]
+        return jnp.where(y > 0, d, jnp.maximum(0.0, self.margin - d))
+
+
+class MarginCriterion(Criterion):
+    """Hinge loss max(0, margin - y*x) (reference ``nn/MarginCriterion.scala``)."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        super().__init__()
+        self.margin = margin
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        l = jnp.maximum(0.0, self.margin - input * target)
+        return jnp.mean(l) if self.size_average else jnp.sum(l)
+
+
+class MarginRankingCriterion(Criterion):
+    """Input Table [x1, x2]: max(0, -y*(x1-x2) + margin)
+    (reference ``nn/MarginRankingCriterion.scala``)."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        super().__init__()
+        self.margin = margin
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        x1, x2 = jnp.ravel(input[0]), jnp.ravel(input[1])
+        y = jnp.ravel(jnp.asarray(target))
+        l = jnp.maximum(0.0, -y * (x1 - x2) + self.margin)
+        return jnp.mean(l) if self.size_average else jnp.sum(l)
+
+
+class MultiCriterion(Criterion):
+    """Weighted sum of criterions on the same (input, target)
+    (reference ``nn/MultiCriterion.scala``)."""
+
+    def __init__(self):
+        super().__init__()
+        self.criterions: List[Criterion] = []
+        self.weights: List[float] = []
+
+    def add(self, criterion: Criterion, weight: float = 1.0):
+        self.criterions.append(criterion)
+        self.weights.append(weight)
+        return self
+
+    def apply(self, input, target):
+        return sum(w * c.apply(input, target)
+                   for c, w in zip(self.criterions, self.weights))
+
+
+class ParallelCriterion(Criterion):
+    """i-th criterion on i-th (input, target) table entries
+    (reference ``nn/ParallelCriterion.scala``)."""
+
+    def __init__(self, repeat_target: bool = False):
+        super().__init__()
+        self.repeat_target = repeat_target
+        self.criterions: List[Criterion] = []
+        self.weights: List[float] = []
+
+    def add(self, criterion: Criterion, weight: float = 1.0):
+        self.criterions.append(criterion)
+        self.weights.append(weight)
+        return self
+
+    def apply(self, input, target):
+        total = 0.0
+        for i, (c, w) in enumerate(zip(self.criterions, self.weights)):
+            t = target if self.repeat_target else target[i]
+            total = total + w * c.apply(input[i], t)
+        return total
+
+
+class MultiLabelMarginCriterion(Criterion):
+    """Multi-class multi-label hinge (reference ``nn/MultiLabelMarginCriterion.scala``).
+    target rows list 1-based label indices, 0-padded."""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        x = jnp.atleast_2d(input)
+        t = jnp.atleast_2d(jnp.asarray(target)).astype(jnp.int32)
+        n, c = x.shape
+
+        def per_sample(xi, ti):
+            valid = ti > 0
+            idx = jnp.clip(ti - 1, 0, c - 1)
+            is_target = jnp.zeros((c,), bool).at[idx].set(valid)
+            tscores = jnp.where(valid, xi[idx], jnp.inf)  # (c,) padded
+            # for every (target j, class k not in targets): max(0, 1 - (x_j - x_k))
+            margins = jnp.maximum(0.0, 1.0 - (tscores[:, None] - xi[None, :]))
+            mask = valid[:, None] & (~is_target)[None, :]
+            return jnp.sum(jnp.where(mask, margins, 0.0)) / c
+
+        l = jax.vmap(per_sample)(x, t)
+        return jnp.mean(l) if self.size_average else jnp.sum(l)
+
+
+class MultiLabelSoftMarginCriterion(Criterion):
+    """Multi-label one-vs-all BCE-with-logits
+    (reference ``nn/MultiLabelSoftMarginCriterion.scala``)."""
+
+    def __init__(self, weights=None, size_average: bool = True):
+        super().__init__()
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        l = target * jax.nn.log_sigmoid(input) \
+            + (1.0 - target) * jax.nn.log_sigmoid(-input)
+        if self.weights is not None:
+            l = l * self.weights
+        n_classes = input.shape[-1]
+        s = -jnp.sum(l) / n_classes
+        n = input.shape[0] if input.ndim > 1 else 1
+        return s / n if self.size_average else s
+
+
+class MultiMarginCriterion(Criterion):
+    """Multi-class hinge (reference ``nn/MultiMarginCriterion.scala``)."""
+
+    def __init__(self, p: int = 1, weights=None, margin: float = 1.0,
+                 size_average: bool = True):
+        super().__init__()
+        self.p = p
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.margin = margin
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        x = jnp.atleast_2d(input)
+        idx = jnp.ravel(_to_index(target))
+        n, c = x.shape
+        tgt_score = jnp.take_along_axis(x, idx[:, None], axis=1)
+        margins = jnp.maximum(0.0, self.margin - tgt_score + x) ** self.p
+        if self.weights is not None:
+            margins = margins * jnp.take(self.weights, idx)[:, None]
+        onehot = jax.nn.one_hot(idx, c, dtype=bool)
+        l = jnp.sum(jnp.where(onehot, 0.0, margins), axis=1) / c
+        return jnp.mean(l) if self.size_average else jnp.sum(l)
+
+
+class SmoothL1Criterion(Criterion):
+    def apply(self, input, target):
+        d = jnp.abs(input - target)
+        l = jnp.where(d < 1.0, 0.5 * d * d, d - 0.5)
+        s = jnp.sum(l)
+        return s / input.size if self.size_average else s
+
+
+class SmoothL1CriterionWithWeights(Criterion):
+    """Smooth-L1 with inside/outside weights, Fast-RCNN style
+    (reference ``nn/SmoothL1CriterionWithWeights.scala``)."""
+
+    def __init__(self, sigma: float = 1.0, num: int = 0):
+        super().__init__()
+        self.sigma2 = sigma * sigma
+        self.num = num
+
+    def apply(self, input, target):
+        if isinstance(target, (list, tuple)):
+            t, inw, outw = target[0], target[1], target[2]
+        else:
+            t, inw, outw = target, 1.0, 1.0
+        d = (input - t) * inw
+        ad = jnp.abs(d)
+        l = jnp.where(ad < 1.0 / self.sigma2,
+                      0.5 * self.sigma2 * d * d,
+                      ad - 0.5 / self.sigma2)
+        s = jnp.sum(l * outw)
+        return s / self.num if self.num > 0 else s
+
+
+class SoftmaxWithCriterion(Criterion):
+    """Caffe SoftmaxWithLoss over (N, C, H, W) logits with spatial 1-based
+    labels (reference ``nn/SoftmaxWithCriterion.scala``)."""
+
+    def __init__(self, ignore_label: Optional[int] = None,
+                 normalize_mode: str = "VALID"):
+        super().__init__()
+        self.ignore_label = ignore_label
+        self.normalize_mode = normalize_mode
+
+    def apply(self, input, target):
+        logp = jax.nn.log_softmax(input, axis=1)
+        idx = _to_index(target)  # (N, H, W) or (N, 1, H, W)
+        if idx.ndim == input.ndim:
+            idx = idx[:, 0]
+        picked = jnp.take_along_axis(logp, idx[:, None], axis=1)[:, 0]
+        if self.ignore_label is not None:
+            valid = (idx + 1) != self.ignore_label
+            picked = jnp.where(valid, picked, 0.0)
+            count = jnp.sum(valid)
+        else:
+            count = picked.size
+        loss = -jnp.sum(picked)
+        if self.normalize_mode == "VALID":
+            return loss / jnp.maximum(count, 1)
+        if self.normalize_mode == "BATCH_SIZE":
+            return loss / input.shape[0]
+        if self.normalize_mode == "FULL":
+            return loss / picked.size
+        return loss
+
+
+class SoftMarginCriterion(Criterion):
+    """log(1 + exp(-y*x)) (reference ``nn/SoftMarginCriterion.scala``)."""
+
+    def apply(self, input, target):
+        l = jnp.log1p(jnp.exp(-input * target))
+        return jnp.mean(l) if self.size_average else jnp.sum(l)
+
+
+class L1Cost(Criterion):
+    """Sum of absolute values of the input (reference ``nn/L1Cost.scala``)."""
+
+    def apply(self, input, target=None):
+        return jnp.sum(jnp.abs(input))
+
+
+class CosineDistanceCriterion(Criterion):
+    """1 - cosine(input, target) (reference ``nn/CosineDistanceCriterion.scala``)."""
+
+    def apply(self, input, target):
+        x = jnp.atleast_2d(input)
+        t = jnp.atleast_2d(target)
+        cos = jnp.sum(x * t, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(x, axis=-1) * jnp.linalg.norm(t, axis=-1), 1e-12)
+        l = 1.0 - cos
+        return jnp.mean(l) if self.size_average else jnp.sum(l)
+
+
+class DiceCoefficientCriterion(Criterion):
+    """1 - dice overlap (reference ``nn/DiceCoefficientCriterion.scala``)."""
+
+    def __init__(self, size_average: bool = True, epsilon: float = 1.0):
+        super().__init__()
+        self.size_average = size_average
+        self.epsilon = epsilon
+
+    def apply(self, input, target):
+        x = jnp.atleast_2d(input)
+        t = jnp.atleast_2d(target)
+        inter = jnp.sum(x * t, axis=-1)
+        union = jnp.sum(x, axis=-1) + jnp.sum(t, axis=-1)
+        l = 1.0 - 2.0 * (inter + self.epsilon) / (union + 2 * self.epsilon)
+        return jnp.mean(l) if self.size_average else jnp.sum(l)
+
+
+class ClassSimplexCriterion(Criterion):
+    """MSE against a regular-simplex embedding of the target class
+    (reference ``nn/ClassSimplexCriterion.scala``)."""
+
+    def __init__(self, n_classes: int):
+        super().__init__()
+        self.n_classes = n_classes
+        self.simplex = jnp.asarray(self._build_simplex(n_classes))
+
+    @staticmethod
+    def _build_simplex(n: int) -> np.ndarray:
+        m = np.zeros((n, n), np.float32)
+        m[0, 0] = 1.0
+        for k in range(1, n):
+            s = float(m[k - 1, :k - 1] @ m[k - 1, :k - 1]) if k > 1 else 0.0
+            # regular simplex construction (Gram-Schmidt style)
+        # simpler closed form: vertices of a regular simplex in R^n
+        m = np.eye(n, dtype=np.float32)
+        centroid = m.mean(axis=0, keepdims=True)
+        m = m - centroid
+        m = m / np.linalg.norm(m, axis=1, keepdims=True)
+        return m
+
+    def apply(self, input, target):
+        idx = jnp.ravel(_to_index(target))
+        t = jnp.take(self.simplex, idx, axis=0)
+        d = jnp.atleast_2d(input) - t
+        return jnp.mean(jnp.sum(d * d, axis=-1))
+
+
+class TimeDistributedCriterion(Criterion):
+    """Apply a criterion at every timestep of (N, T, ...) input
+    (reference ``nn/TimeDistributedCriterion.scala``)."""
+
+    def __init__(self, critrn: Criterion, size_average: bool = False):
+        super().__init__()
+        self.critrn = critrn
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        t_steps = input.shape[1]
+        total = 0.0
+        for t in range(t_steps):
+            total = total + self.critrn.apply(input[:, t], target[:, t])
+        return total / t_steps if self.size_average else total

@@ -1,0 +1,106 @@
+"""Graph container: DAG of modules built with the ``inputs()`` DSL.
+
+Reference: ``nn/Graph.scala:58`` (topo-sorted executions, per-node input
+marshalling, reverse-order backward) and ``utils/DirectedGraph.scala:34``.
+
+Because every module is a pure function here, Graph.apply is just a
+topological fold — XLA sees one fused program; there is no per-node backward
+bookkeeping (``jax.vjp`` of the whole fold replaces ``nn/Graph.scala:87-120``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Union
+
+from bigdl_tpu.nn.module import Module, Container, _child_rng
+
+
+class ModuleNode:
+    """A node wrapping a module, with predecessor edges
+    (reference ``utils/Node`` + ``AbstractModule.inputs:539``)."""
+
+    def __init__(self, element: Module):
+        self.element = element
+        self.prev: List["ModuleNode"] = []
+        self.next: List["ModuleNode"] = []
+
+    def inputs(self, *nodes) -> "ModuleNode":
+        for n in nodes:
+            if isinstance(n, Module):
+                n = ModuleNode(n)
+            self.prev.append(n)
+            n.next.append(self)
+        return self
+
+    def __repr__(self):
+        return f"Node({self.element.name})"
+
+
+class Graph(Container):
+    """DAG container (reference ``nn/Graph.scala:58``).
+
+    ``Graph(inputs, outputs)``: inputs is a node or list of nodes fed with
+    the graph's input activity (in order); outputs likewise gathered.
+    """
+
+    def __init__(self, inputs, outputs, name=None):
+        super().__init__(name)
+        self.input_nodes = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        self.output_nodes = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        self.executions = self._topo_sort()
+        for node in self.executions:
+            self.add(node.element)
+        self._node_index = {id(n): i for i, n in enumerate(self.executions)}
+
+    def _topo_sort(self) -> List[ModuleNode]:
+        # collect all nodes reachable (backwards) from outputs
+        seen: Dict[int, ModuleNode] = {}
+        stack = list(self.output_nodes)
+        while stack:
+            n = stack.pop()
+            if id(n) in seen:
+                continue
+            seen[id(n)] = n
+            stack.extend(n.prev)
+        # Kahn's algorithm over the reachable subgraph
+        indeg = {i: sum(1 for p in n.prev if id(p) in seen)
+                 for i, n in seen.items()}
+        ready = [n for i, n in seen.items() if indeg[i] == 0]
+        order: List[ModuleNode] = []
+        while ready:
+            n = ready.pop(0)
+            order.append(n)
+            for nxt in n.next:
+                if id(nxt) in seen:
+                    indeg[id(nxt)] -= 1
+                    if indeg[id(nxt)] == 0:
+                        ready.append(nxt)
+        if len(order) != len(seen):
+            raise ValueError("Graph contains a cycle")
+        return order
+
+    def apply(self, params, input, state, training=False, rng=None):
+        is_multi = isinstance(input, (list, tuple)) and len(self.input_nodes) > 1
+        outputs: Dict[int, object] = {}
+        new_states = list(state)
+        for i, node in enumerate(self.executions):
+            if not node.prev:
+                # source node: feed from graph input
+                k = self.input_nodes.index(node) if node in self.input_nodes else 0
+                x = input[k] if is_multi else input
+            elif len(node.prev) == 1:
+                x = outputs[id(node.prev[0])]
+            else:
+                x = [outputs[id(p)] for p in node.prev]
+            y, s = node.element.apply(params[i], x, state[i],
+                                      training=training, rng=_child_rng(rng, i))
+            outputs[id(node)] = y
+            new_states[i] = s
+        outs = [outputs[id(n)] for n in self.output_nodes]
+        return (outs[0] if len(outs) == 1 else outs), new_states
+
+
+def Input():
+    """Placeholder source node (reference ``nn/Input.scala``)."""
+    from bigdl_tpu.nn.structural import Identity
+    return ModuleNode(Identity())

@@ -1,0 +1,107 @@
+"""Parameter initialization methods.
+
+Reference: ``nn/InitializationMethod.scala`` + ``nn/abstractnn/Initializable.scala``
+(Zeros/Ones/Const/RandomUniform/RandomNormal/Xavier/BilinearFiller, with
+``VariableFormat`` fan-in/fan-out conventions).
+
+Here each method is a function ``(rng, shape, fan_in, fan_out) -> array``;
+layers compute their own fans from their kernel geometry (the role
+``VariableFormat`` plays in the reference).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class InitializationMethod:
+    def __call__(self, rng, shape: Sequence[int],
+                 fan_in: Optional[int] = None,
+                 fan_out: Optional[int] = None,
+                 dtype=jnp.float32) -> jnp.ndarray:
+        raise NotImplementedError
+
+
+class Zeros(InitializationMethod):
+    def __call__(self, rng, shape, fan_in=None, fan_out=None, dtype=jnp.float32):
+        return jnp.zeros(shape, dtype)
+
+
+class Ones(InitializationMethod):
+    def __call__(self, rng, shape, fan_in=None, fan_out=None, dtype=jnp.float32):
+        return jnp.ones(shape, dtype)
+
+
+class ConstInitMethod(InitializationMethod):
+    def __init__(self, value: float):
+        self.value = value
+
+    def __call__(self, rng, shape, fan_in=None, fan_out=None, dtype=jnp.float32):
+        return jnp.full(shape, self.value, dtype)
+
+
+class RandomUniform(InitializationMethod):
+    """Uniform in [lower, upper]; with no bounds, the Torch default
+    U(-1/sqrt(fan_in), 1/sqrt(fan_in))."""
+
+    def __init__(self, lower: Optional[float] = None, upper: Optional[float] = None):
+        self.lower, self.upper = lower, upper
+
+    def __call__(self, rng, shape, fan_in=None, fan_out=None, dtype=jnp.float32):
+        if self.lower is None:
+            bound = 1.0 / math.sqrt(max(1, fan_in or 1))
+            lo, hi = -bound, bound
+        else:
+            lo, hi = self.lower, self.upper
+        return jax.random.uniform(rng, tuple(shape), dtype, lo, hi)
+
+
+class RandomNormal(InitializationMethod):
+    def __init__(self, mean: float = 0.0, stdv: float = 1.0):
+        self.mean, self.stdv = mean, stdv
+
+    def __call__(self, rng, shape, fan_in=None, fan_out=None, dtype=jnp.float32):
+        return self.mean + self.stdv * jax.random.normal(rng, tuple(shape), dtype)
+
+
+class Xavier(InitializationMethod):
+    """Glorot uniform: U(-sqrt(6/(fan_in+fan_out)), +...)."""
+
+    def __call__(self, rng, shape, fan_in=None, fan_out=None, dtype=jnp.float32):
+        fi = fan_in or int(np.prod(shape[:-1])) or 1
+        fo = fan_out or shape[-1]
+        bound = math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(rng, tuple(shape), dtype, -bound, bound)
+
+
+class MsraFiller(InitializationMethod):
+    """He initialization (kaiming normal)."""
+
+    def __init__(self, var_fan_in: bool = True):
+        self.var_fan_in = var_fan_in
+
+    def __call__(self, rng, shape, fan_in=None, fan_out=None, dtype=jnp.float32):
+        fan = (fan_in if self.var_fan_in else fan_out) or 1
+        std = math.sqrt(2.0 / fan)
+        return std * jax.random.normal(rng, tuple(shape), dtype)
+
+
+class BilinearFiller(InitializationMethod):
+    """Bilinear upsampling kernel (for SpatialFullConvolution).
+    Expects shape (kh, kw, ...) trailing dims broadcast."""
+
+    def __call__(self, rng, shape, fan_in=None, fan_out=None, dtype=jnp.float32):
+        kh, kw = shape[0], shape[1]
+        f_h, f_w = math.ceil(kh / 2.0), math.ceil(kw / 2.0)
+        c_h = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h)
+        c_w = (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+        ys = np.arange(kh)[:, None]
+        xs = np.arange(kw)[None, :]
+        kern = (1 - np.abs(ys / f_h - c_h)) * (1 - np.abs(xs / f_w - c_w))
+        kern = kern.reshape(kern.shape + (1,) * (len(shape) - 2))
+        return jnp.broadcast_to(jnp.asarray(kern, dtype), tuple(shape))

@@ -1,0 +1,247 @@
+"""Linear / embedding family.
+
+Reference: ``nn/Linear.scala``, ``nn/Bilinear.scala``, ``nn/LookupTable.scala:44``,
+``nn/Add.scala``, ``nn/Mul.scala``, ``nn/CMul.scala``, ``nn/CAdd.scala``,
+``nn/Euclidean.scala``, ``nn/Cosine.scala``.
+
+Weight layouts are chosen for the MXU: Linear stores (in, out) so the forward
+is a plain ``x @ w`` row-major matmul in one MXU pass (the reference stores
+(out, in) and does gemv/gemm with a transpose, ``nn/Linear.scala``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.nn import init as init_methods
+
+
+class Linear(Module):
+    """y = x W + b (reference ``nn/Linear.scala``)."""
+
+    def __init__(self, input_size: int, output_size: int, with_bias: bool = True,
+                 w_regularizer=None, b_regularizer=None,
+                 init_weight=None, init_bias=None, name=None):
+        super().__init__(name)
+        self.input_size = input_size
+        self.output_size = output_size
+        self.with_bias = with_bias
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+        self.init_weight = init_weight
+        self.init_bias = init_bias
+        self.weight_init_method = init_methods.RandomUniform()
+        self.bias_init_method = init_methods.RandomUniform()
+
+    def set_init_method(self, weight_init=None, bias_init=None):
+        if weight_init is not None:
+            self.weight_init_method = weight_init
+        if bias_init is not None:
+            self.bias_init_method = bias_init
+        return self
+
+    def _init_params(self, rng):
+        k1, k2 = jax.random.split(rng)
+        fan_in, fan_out = self.input_size, self.output_size
+        if self.init_weight is not None:
+            w = jnp.asarray(self.init_weight)
+            if w.shape == (self.output_size, self.input_size):
+                w = w.T  # accept reference (out, in) layout
+        else:
+            w = self.weight_init_method(k1, (self.input_size, self.output_size),
+                                        fan_in, fan_out)
+        p = {"weight": w}
+        if self.with_bias:
+            if self.init_bias is not None:
+                p["bias"] = jnp.asarray(self.init_bias)
+            else:
+                p["bias"] = self.bias_init_method(k2, (self.output_size,),
+                                                  fan_in, fan_out)
+        return p
+
+    def apply(self, params, input, state, training=False, rng=None):
+        out = input @ params["weight"]
+        if self.with_bias:
+            out = out + params["bias"]
+        return out, state
+
+
+class Bilinear(Module):
+    """y_k = x1 W_k x2 + b_k over a Table input [x1, x2]
+    (reference ``nn/Bilinear.scala``)."""
+
+    def __init__(self, input_size1: int, input_size2: int, output_size: int,
+                 bias_res: bool = True, w_regularizer=None, b_regularizer=None,
+                 name=None):
+        super().__init__(name)
+        self.input_size1 = input_size1
+        self.input_size2 = input_size2
+        self.output_size = output_size
+        self.bias_res = bias_res
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+
+    def _init_params(self, rng):
+        k1, k2 = jax.random.split(rng)
+        stdv = 1.0 / math.sqrt(self.input_size1)
+        w = jax.random.uniform(
+            k1, (self.output_size, self.input_size1, self.input_size2),
+            minval=-stdv, maxval=stdv)
+        p = {"weight": w}
+        if self.bias_res:
+            p["bias"] = jax.random.uniform(k2, (self.output_size,),
+                                           minval=-stdv, maxval=stdv)
+        return p
+
+    def apply(self, params, input, state, training=False, rng=None):
+        x1, x2 = input[0], input[1]
+        # (N,i1) x (o,i1,i2) x (N,i2) -> (N,o)
+        out = jnp.einsum("ni,oij,nj->no", x1, params["weight"], x2)
+        if self.bias_res:
+            out = out + params["bias"]
+        return out, state
+
+
+class LookupTable(Module):
+    """Embedding lookup (reference ``nn/LookupTable.scala:44``).
+
+    Input indices are 1-based (Torch convention); optional max-norm
+    renormalisation is applied to the gathered rows.
+    """
+
+    def __init__(self, n_index: int, n_output: int, padding_value: float = 0,
+                 max_norm: float = float("inf"), norm_type: float = 2.0,
+                 should_scale_grad_by_freq: bool = False,
+                 w_regularizer=None, name=None):
+        super().__init__(name)
+        self.n_index = n_index
+        self.n_output = n_output
+        self.padding_value = padding_value
+        self.max_norm = max_norm
+        self.norm_type = norm_type
+        self.w_regularizer = w_regularizer
+
+    def _init_params(self, rng):
+        return {"weight": jax.random.normal(rng, (self.n_index, self.n_output))}
+
+    def apply(self, params, input, state, training=False, rng=None):
+        idx = jnp.asarray(input).astype(jnp.int32) - 1  # 1-based -> 0-based
+        idx = jnp.clip(idx, 0, self.n_index - 1)
+        w = params["weight"]
+        out = jnp.take(w, idx, axis=0)
+        if self.max_norm != float("inf"):
+            norms = jnp.linalg.norm(out, ord=self.norm_type, axis=-1, keepdims=True)
+            scale = jnp.minimum(1.0, self.max_norm / jnp.maximum(norms, 1e-7))
+            out = out * scale
+        return out, state
+
+
+class Add(Module):
+    """Learnable per-element bias (reference ``nn/Add.scala``)."""
+
+    def __init__(self, input_size: int, name=None):
+        super().__init__(name)
+        self.input_size = input_size
+
+    def _init_params(self, rng):
+        stdv = 1.0 / math.sqrt(self.input_size)
+        return {"bias": jax.random.uniform(rng, (self.input_size,),
+                                           minval=-stdv, maxval=stdv)}
+
+    def apply(self, params, input, state, training=False, rng=None):
+        return input + params["bias"], state
+
+
+class Mul(Module):
+    """Single learnable scalar gain (reference ``nn/Mul.scala``)."""
+
+    def _init_params(self, rng):
+        return {"weight": jax.random.uniform(rng, (), minval=-1.0, maxval=1.0)}
+
+    def apply(self, params, input, state, training=False, rng=None):
+        return input * params["weight"], state
+
+
+class CMul(Module):
+    """Learnable componentwise gain of given (broadcastable) size
+    (reference ``nn/CMul.scala``)."""
+
+    def __init__(self, size: Sequence[int], name=None):
+        super().__init__(name)
+        self.size = tuple(size)
+
+    def _init_params(self, rng):
+        n = 1
+        for s in self.size:
+            n *= s
+        stdv = 1.0 / math.sqrt(n)
+        return {"weight": jax.random.uniform(rng, self.size,
+                                             minval=-stdv, maxval=stdv)}
+
+    def apply(self, params, input, state, training=False, rng=None):
+        return input * params["weight"], state
+
+
+class CAdd(Module):
+    """Learnable componentwise bias of given (broadcastable) size
+    (reference ``nn/CAdd.scala``)."""
+
+    def __init__(self, size: Sequence[int], name=None):
+        super().__init__(name)
+        self.size = tuple(size)
+
+    def _init_params(self, rng):
+        n = 1
+        for s in self.size:
+            n *= s
+        stdv = 1.0 / math.sqrt(n)
+        return {"bias": jax.random.uniform(rng, self.size,
+                                           minval=-stdv, maxval=stdv)}
+
+    def apply(self, params, input, state, training=False, rng=None):
+        return input + params["bias"], state
+
+
+class Euclidean(Module):
+    """Output = distances to learnable centers (reference ``nn/Euclidean.scala``)."""
+
+    def __init__(self, input_size: int, output_size: int, name=None):
+        super().__init__(name)
+        self.input_size = input_size
+        self.output_size = output_size
+
+    def _init_params(self, rng):
+        stdv = 1.0 / math.sqrt(self.input_size)
+        return {"weight": jax.random.uniform(
+            rng, (self.input_size, self.output_size), minval=-stdv, maxval=stdv)}
+
+    def apply(self, params, input, state, training=False, rng=None):
+        x = input[:, :, None] if input.ndim == 2 else input[:, None]
+        d = x - params["weight"]
+        out = jnp.sqrt(jnp.sum(d * d, axis=-2) + 1e-12)
+        return out, state
+
+
+class Cosine(Module):
+    """Output = cosine similarity to learnable centers (reference ``nn/Cosine.scala``)."""
+
+    def __init__(self, input_size: int, output_size: int, name=None):
+        super().__init__(name)
+        self.input_size = input_size
+        self.output_size = output_size
+
+    def _init_params(self, rng):
+        stdv = 1.0 / math.sqrt(self.input_size)
+        return {"weight": jax.random.uniform(
+            rng, (self.input_size, self.output_size), minval=-stdv, maxval=stdv)}
+
+    def apply(self, params, input, state, training=False, rng=None):
+        w = params["weight"]
+        xn = input / jnp.maximum(jnp.linalg.norm(input, axis=-1, keepdims=True), 1e-12)
+        wn = w / jnp.maximum(jnp.linalg.norm(w, axis=0, keepdims=True), 1e-12)
+        return xn @ wn, state

@@ -1,0 +1,240 @@
+"""Normalization layers.
+
+Reference: ``nn/BatchNormalization.scala:50``, ``nn/SpatialBatchNormalization.scala``,
+``nn/SpatialCrossMapLRN.scala``, ``nn/SpatialWithinChannelLRN.scala``,
+``nn/SpatialContrastiveNormalization.scala``, ``nn/SpatialDivisiveNormalization.scala``,
+``nn/SpatialSubtractiveNormalization.scala``, ``nn/Normalize.scala``.
+
+BatchNormalization is the one stateful layer in the framework: running
+mean/var live in the module *state* pytree and a fresh state is returned from
+``apply`` — the functional mirror of the reference's mutable runningMean /
+runningVar tensors.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu import ops
+
+
+class BatchNormalization(Module):
+    """BN over dim 1 of (N, C) input (reference ``nn/BatchNormalization.scala:50``)."""
+
+    _reduce_axes = (0,)
+    _param_shape_ndim = 2
+
+    def __init__(self, n_output: int, eps: float = 1e-5, momentum: float = 0.1,
+                 affine: bool = True, init_weight=None, init_bias=None,
+                 name=None):
+        super().__init__(name)
+        self.n_output = n_output
+        self.eps = eps
+        self.momentum = momentum
+        self.affine = affine
+        self.init_weight = init_weight
+        self.init_bias = init_bias
+
+    def _init_params(self, rng):
+        if not self.affine:
+            return {}
+        w = jnp.asarray(self.init_weight) if self.init_weight is not None \
+            else jax.random.uniform(rng, (self.n_output,))
+        b = jnp.asarray(self.init_bias) if self.init_bias is not None \
+            else jnp.zeros((self.n_output,))
+        return {"weight": w, "bias": b}
+
+    def _init_state(self):
+        return {"running_mean": jnp.zeros((self.n_output,)),
+                "running_var": jnp.ones((self.n_output,))}
+
+    def _param_view(self, ndim):
+        shape = [1] * ndim
+        shape[1] = self.n_output
+        return shape
+
+    def apply(self, params, input, state, training=False, rng=None):
+        view = self._param_view(input.ndim)
+        axes = tuple(i for i in range(input.ndim) if i != 1)
+        if training:
+            mean = jnp.mean(input, axis=axes)
+            var = jnp.var(input, axis=axes)
+            n = input.size // self.n_output
+            unbiased = var * n / max(1, n - 1)
+            m = self.momentum
+            new_state = {
+                "running_mean": (1 - m) * state["running_mean"] + m * mean,
+                "running_var": (1 - m) * state["running_var"] + m * unbiased,
+            }
+        else:
+            mean, var = state["running_mean"], state["running_var"]
+            new_state = state
+        inv = jax.lax.rsqrt(var + self.eps)
+        out = (input - jnp.reshape(mean, view)) * jnp.reshape(inv, view)
+        if self.affine:
+            out = out * jnp.reshape(params["weight"], view) \
+                + jnp.reshape(params["bias"], view)
+        return out, new_state
+
+
+class SpatialBatchNormalization(BatchNormalization):
+    """BN over (N, C, H, W) (reference ``nn/SpatialBatchNormalization.scala``)."""
+
+
+class Normalize(Module):
+    """Lp-normalise each sample (reference ``nn/Normalize.scala``)."""
+
+    def __init__(self, p: float, eps: float = 1e-10, name=None):
+        super().__init__(name)
+        self.p = p
+        self.eps = eps
+
+    def apply(self, params, input, state, training=False, rng=None):
+        if self.p == float("inf"):
+            norm = jnp.max(jnp.abs(input), axis=-1, keepdims=True)
+        else:
+            norm = jnp.sum(jnp.abs(input) ** self.p, axis=-1,
+                           keepdims=True) ** (1.0 / self.p)
+        return input / (norm + self.eps), state
+
+
+class SpatialCrossMapLRN(Module):
+    """AlexNet-style local response normalization across channels
+    (reference ``nn/SpatialCrossMapLRN.scala``)."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0, beta: float = 0.75,
+                 k: float = 1.0, name=None):
+        super().__init__(name)
+        self.size = size
+        self.alpha = alpha
+        self.beta = beta
+        self.k = k
+
+    def apply(self, params, input, state, training=False, rng=None):
+        # input (N, C, H, W); window sum of squares across C
+        sq = input * input
+        half = (self.size - 1) // 2
+        pad_lo, pad_hi = half, self.size - 1 - half
+        padded = jnp.pad(sq, ((0, 0), (pad_lo, pad_hi), (0, 0), (0, 0)))
+        window = jax.lax.reduce_window(
+            padded, 0.0, jax.lax.add, (1, self.size, 1, 1), (1, 1, 1, 1),
+            "valid")
+        denom = (self.k + self.alpha / self.size * window) ** self.beta
+        return input / denom, state
+
+
+def _gaussian_kernel1d(size: int) -> np.ndarray:
+    # torch's image.gaussian with default sigma=0.25 (relative), amplitude 1
+    sigma = 0.25 * size
+    xs = np.arange(size) - (size - 1) / 2.0
+    k = np.exp(-(xs ** 2) / (2 * sigma ** 2))
+    return k / k.sum()
+
+
+class SpatialSubtractiveNormalization(Module):
+    """Subtract weighted neighborhood mean
+    (reference ``nn/SpatialSubtractiveNormalization.scala``)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None, name=None):
+        super().__init__(name)
+        self.n_input_plane = n_input_plane
+        if kernel is None:
+            kernel = np.outer(_gaussian_kernel1d(9), _gaussian_kernel1d(9))
+        self.kernel = jnp.asarray(kernel, jnp.float32)
+        self.kernel = self.kernel / jnp.sum(self.kernel)
+
+    def _local_mean(self, input):
+        kh, kw = self.kernel.shape
+        c = input.shape[1]
+        # depthwise mean filter, same padding, normalised by actual coverage
+        w = jnp.tile(self.kernel[:, :, None, None] / c, (1, 1, 1, c))
+        pad = ((kh // 2, (kh - 1) - kh // 2), (kw // 2, (kw - 1) - kw // 2))
+        dn = jax.lax.conv_dimension_numbers(input.shape, w.shape,
+                                            ("NCHW", "HWIO", "NCHW"))
+        mean = jax.lax.conv_general_dilated(
+            input, w, (1, 1), pad, dimension_numbers=dn,
+            feature_group_count=1)
+        # coverage correction at borders
+        ones = jnp.ones((1, c) + input.shape[2:], input.dtype)
+        cov = jax.lax.conv_general_dilated(
+            ones, w, (1, 1), pad, dimension_numbers=dn)
+        mean = mean / jnp.maximum(cov, 1e-8) * jnp.sum(self.kernel)
+        return jnp.broadcast_to(mean, input.shape)
+
+    def apply(self, params, input, state, training=False, rng=None):
+        squeeze = input.ndim == 3
+        if squeeze:
+            input = input[None]
+        out = input - self._local_mean(input)
+        if squeeze:
+            out = out[0]
+        return out, state
+
+
+class SpatialDivisiveNormalization(SpatialSubtractiveNormalization):
+    """Divide by weighted neighborhood stddev
+    (reference ``nn/SpatialDivisiveNormalization.scala``)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None,
+                 threshold: float = 1e-4, thresval: float = 1e-4, name=None):
+        super().__init__(n_input_plane, kernel, name=name)
+        self.threshold = threshold
+        self.thresval = thresval
+
+    def apply(self, params, input, state, training=False, rng=None):
+        squeeze = input.ndim == 3
+        if squeeze:
+            input = input[None]
+        local_var = self._local_mean(input * input)
+        local_std = jnp.sqrt(jnp.maximum(local_var, 0.0))
+        mean_std = jnp.mean(local_std, axis=(1, 2, 3), keepdims=True)
+        adjusted = jnp.maximum(local_std, mean_std)
+        adjusted = jnp.where(adjusted < self.threshold, self.thresval, adjusted)
+        out = input / adjusted
+        if squeeze:
+            out = out[0]
+        return out, state
+
+
+class SpatialContrastiveNormalization(Module):
+    """Subtractive then divisive normalization
+    (reference ``nn/SpatialContrastiveNormalization.scala``)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None,
+                 threshold: float = 1e-4, thresval: float = 1e-4, name=None):
+        super().__init__(name)
+        self.sub = SpatialSubtractiveNormalization(n_input_plane, kernel)
+        self.div = SpatialDivisiveNormalization(n_input_plane, kernel,
+                                                threshold, thresval)
+
+    def apply(self, params, input, state, training=False, rng=None):
+        x, _ = self.sub.apply({}, input, {}, training=training, rng=rng)
+        return self.div.apply({}, x, {}, training=training, rng=rng)[0], state
+
+
+class SpatialWithinChannelLRN(Module):
+    """LRN over a spatial window within each channel
+    (reference ``nn/SpatialWithinChannelLRN.scala``)."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0, beta: float = 0.75,
+                 name=None):
+        super().__init__(name)
+        self.size = size
+        self.alpha = alpha
+        self.beta = beta
+
+    def apply(self, params, input, state, training=False, rng=None):
+        sq = input * input
+        half_lo = self.size // 2
+        half_hi = (self.size - 1) - half_lo
+        pads = ((0, 0), (0, 0), (half_lo, half_hi), (half_lo, half_hi))
+        window = jax.lax.reduce_window(
+            sq, 0.0, jax.lax.add, (1, 1, self.size, self.size), (1, 1, 1, 1),
+            pads)
+        denom = (1.0 + self.alpha / (self.size * self.size) * window) ** self.beta
+        return input / denom, state

@@ -1,0 +1,175 @@
+"""Pooling layers.
+
+Reference: ``nn/SpatialMaxPooling.scala``, ``nn/SpatialAveragePooling.scala``,
+``nn/VolumetricMaxPooling.scala``, ``nn/RoiPooling.scala``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu import ops
+
+
+class SpatialMaxPooling(Module):
+    """2-D max pooling (reference ``nn/SpatialMaxPooling.scala``)."""
+
+    def __init__(self, kw: int, kh: int, dw: int = None, dh: int = None,
+                 pad_w: int = 0, pad_h: int = 0, format: str = "NCHW",
+                 name=None):
+        super().__init__(name)
+        self.kw, self.kh = kw, kh
+        self.dw = dw if dw is not None else kw
+        self.dh = dh if dh is not None else kh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.ceil_mode = False
+        self.format = format
+
+    def ceil(self):
+        self.ceil_mode = True
+        self._jit_apply = None
+        return self
+
+    def floor(self):
+        self.ceil_mode = False
+        self._jit_apply = None
+        return self
+
+    def apply(self, params, input, state, training=False, rng=None):
+        squeeze = input.ndim == 3
+        if squeeze:
+            input = input[None]
+        out = ops.max_pool2d(input, (self.kh, self.kw), (self.dh, self.dw),
+                             (self.pad_h, self.pad_w), self.ceil_mode,
+                             self.format)
+        if squeeze:
+            out = out[0]
+        return out, state
+
+
+class SpatialAveragePooling(Module):
+    """2-D average pooling (reference ``nn/SpatialAveragePooling.scala``)."""
+
+    def __init__(self, kw: int, kh: int, dw: int = 1, dh: int = 1,
+                 pad_w: int = 0, pad_h: int = 0,
+                 global_pooling: bool = False,
+                 ceil_mode: bool = False, count_include_pad: bool = True,
+                 divide: bool = True, format: str = "NCHW", name=None):
+        super().__init__(name)
+        self.kw, self.kh = kw, kh
+        self.dw, self.dh = dw, dh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.global_pooling = global_pooling
+        self.ceil_mode = ceil_mode
+        self.count_include_pad = count_include_pad
+        self.divide = divide
+        self.format = format
+
+    def ceil(self):
+        self.ceil_mode = True
+        self._jit_apply = None
+        return self
+
+    def apply(self, params, input, state, training=False, rng=None):
+        squeeze = input.ndim == 3
+        if squeeze:
+            input = input[None]
+        h_ax, w_ax = (2, 3) if self.format == "NCHW" else (1, 2)
+        kh, kw = (input.shape[h_ax], input.shape[w_ax]) \
+            if self.global_pooling else (self.kh, self.kw)
+        out = ops.avg_pool2d(input, (kh, kw), (self.dh, self.dw),
+                             (self.pad_h, self.pad_w), self.ceil_mode,
+                             self.count_include_pad, self.format)
+        if not self.divide:
+            out = out * (kh * kw)
+        if squeeze:
+            out = out[0]
+        return out, state
+
+
+class VolumetricMaxPooling(Module):
+    """3-D max pooling over (N, C, D, H, W)
+    (reference ``nn/VolumetricMaxPooling.scala``)."""
+
+    def __init__(self, k_t: int, k_w: int, k_h: int,
+                 d_t: int = None, d_w: int = None, d_h: int = None,
+                 pad_t: int = 0, pad_w: int = 0, pad_h: int = 0, name=None):
+        super().__init__(name)
+        self.k_t, self.k_w, self.k_h = k_t, k_w, k_h
+        self.d_t = d_t if d_t is not None else k_t
+        self.d_w = d_w if d_w is not None else k_w
+        self.d_h = d_h if d_h is not None else k_h
+        self.pad_t, self.pad_w, self.pad_h = pad_t, pad_w, pad_h
+        self.ceil_mode = False
+
+    def ceil(self):
+        self.ceil_mode = True
+        self._jit_apply = None
+        return self
+
+    def apply(self, params, input, state, training=False, rng=None):
+        squeeze = input.ndim == 4
+        if squeeze:
+            input = input[None]
+        out = ops.max_pool3d(input, (self.k_t, self.k_h, self.k_w),
+                             (self.d_t, self.d_h, self.d_w),
+                             (self.pad_t, self.pad_h, self.pad_w),
+                             self.ceil_mode)
+        if squeeze:
+            out = out[0]
+        return out, state
+
+
+class RoiPooling(Module):
+    """Region-of-interest max pooling (reference ``nn/RoiPooling.scala``).
+
+    Input: Table [data (N,C,H,W), rois (R,5) — (batch_idx, x1, y1, x2, y2)].
+    Output: (R, C, pooled_h, pooled_w).
+    """
+
+    def __init__(self, pooled_w: int, pooled_h: int, spatial_scale: float,
+                 name=None):
+        super().__init__(name)
+        self.pooled_w = pooled_w
+        self.pooled_h = pooled_h
+        self.spatial_scale = spatial_scale
+
+    def apply(self, params, input, state, training=False, rng=None):
+        import jax
+        data, rois = input[0], input[1]
+        n, c, h, w = data.shape
+
+        def pool_one(roi):
+            batch_idx = roi[0].astype(jnp.int32)
+            x1 = jnp.round(roi[1] * self.spatial_scale).astype(jnp.int32)
+            y1 = jnp.round(roi[2] * self.spatial_scale).astype(jnp.int32)
+            x2 = jnp.round(roi[3] * self.spatial_scale).astype(jnp.int32)
+            y2 = jnp.round(roi[4] * self.spatial_scale).astype(jnp.int32)
+            roi_h = jnp.maximum(y2 - y1 + 1, 1)
+            roi_w = jnp.maximum(x2 - x1 + 1, 1)
+            bin_h = roi_h.astype(jnp.float32) / self.pooled_h
+            bin_w = roi_w.astype(jnp.float32) / self.pooled_w
+            img = data[batch_idx]  # (C, H, W)
+
+            ys = jnp.arange(h)[None, :]      # (1, H)
+            xs = jnp.arange(w)[None, :]      # (1, W)
+            ph = jnp.arange(self.pooled_h)[:, None]
+            pw = jnp.arange(self.pooled_w)[:, None]
+            hstart = y1 + jnp.floor(ph * bin_h).astype(jnp.int32)
+            hend = y1 + jnp.ceil((ph + 1) * bin_h).astype(jnp.int32)
+            wstart = x1 + jnp.floor(pw * bin_w).astype(jnp.int32)
+            wend = x1 + jnp.ceil((pw + 1) * bin_w).astype(jnp.int32)
+            hmask = (ys >= jnp.clip(hstart, 0, h)) & (ys < jnp.clip(hend, 0, h))
+            wmask = (xs >= jnp.clip(wstart, 0, w)) & (xs < jnp.clip(wend, 0, w))
+            # (ph, pw, H, W) bin membership mask
+            mask = hmask[:, None, :, None] & wmask[None, :, None, :]
+            neg = jnp.asarray(-jnp.inf, data.dtype)
+            vals = jnp.where(mask[None], img[:, None, None, :, :], neg)
+            pooled = jnp.max(vals, axis=(3, 4))
+            # empty bins produce 0 (torch semantics)
+            any_mask = jnp.any(mask, axis=(2, 3))
+            return jnp.where(any_mask[None], pooled, 0.0)
+
+        out = jax.vmap(pool_one)(rois)
+        return out, state

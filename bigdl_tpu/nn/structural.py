@@ -1,0 +1,468 @@
+"""Structural / tensor-manipulation layers.
+
+Reference: Reshape.scala, View.scala, InferReshape.scala, Squeeze.scala,
+Unsqueeze.scala, Transpose.scala, Contiguous.scala, Identity.scala, Echo.scala,
+Narrow.scala, Select.scala, Index.scala, MaskedSelect.scala, Max.scala,
+Min.scala, Mean.scala, Sum.scala, Replicate.scala, Padding.scala,
+SpatialZeroPadding.scala, GradientReversal.scala, Scale.scala, Bottle.scala,
+MM.scala, MV.scala, DotProduct.scala, Pack.scala, Reverse.scala.
+
+Dimension arguments are 1-based (Torch convention), as in the reference.
+Many layers take ``n_input_dims``: when the actual input has one more dim,
+it is treated as a batch dim and the op shifts right by one.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.module import Module
+
+
+def _axis(dim_1based: int, ndim: int, n_input_dims: int = -1) -> int:
+    """Convert a 1-based (possibly batch-relative) dim to a 0-based axis."""
+    d = dim_1based
+    if d < 0:
+        return ndim + d
+    ax = d - 1
+    if n_input_dims > 0 and ndim == n_input_dims + 1:
+        ax += 1
+    return ax
+
+
+class Identity(Module):
+    def apply(self, params, input, state, training=False, rng=None):
+        return input, state
+
+
+class Echo(Module):
+    """Identity that prints its input shape (debug aid, reference ``nn/Echo.scala``)."""
+
+    def apply(self, params, input, state, training=False, rng=None):
+        jax.debug.print("Echo {name}: shape {shape}", name=self.name,
+                        shape=jnp.asarray(input.shape))
+        return input, state
+
+
+class Contiguous(Module):
+    """No-op on XLA arrays (kept for API parity, reference ``nn/Contiguous.scala``)."""
+
+    def apply(self, params, input, state, training=False, rng=None):
+        return input, state
+
+
+class Reshape(Module):
+    """Reshape non-batch dims to ``size`` (reference ``nn/Reshape.scala``).
+
+    batch_mode None (default): auto — treat first dim as batch when the
+    element count of the remaining dims matches prod(size).
+    """
+
+    def __init__(self, size: Sequence[int], batch_mode: Optional[bool] = None,
+                 name=None):
+        super().__init__(name)
+        self.size = tuple(int(s) for s in size)
+        self.batch_mode = batch_mode
+
+    def apply(self, params, input, state, training=False, rng=None):
+        n = int(np.prod(self.size))
+        total = int(np.prod(input.shape))
+        if self.batch_mode is True or (
+                self.batch_mode is None and total != n and
+                input.shape and total == n * input.shape[0]):
+            return jnp.reshape(input, (input.shape[0],) + self.size), state
+        return jnp.reshape(input, self.size), state
+
+
+class View(Module):
+    """Reshape with -1 inference (reference ``nn/View.scala``)."""
+
+    def __init__(self, *sizes, name=None):
+        super().__init__(name)
+        if len(sizes) == 1 and isinstance(sizes[0], (tuple, list)):
+            sizes = tuple(sizes[0])
+        self.sizes = tuple(int(s) for s in sizes)
+        self.num_input_dims = 0
+
+    def set_num_input_dims(self, n: int):
+        self.num_input_dims = n
+        return self
+
+    def apply(self, params, input, state, training=False, rng=None):
+        sizes = self.sizes
+        n = int(np.prod([s for s in sizes if s != -1]))
+        total = int(np.prod(input.shape))
+        if -1 not in sizes and total != n and input.shape \
+                and total == n * input.shape[0]:
+            return jnp.reshape(input, (input.shape[0],) + sizes), state
+        return jnp.reshape(input, sizes), state
+
+
+class InferReshape(Module):
+    """Reshape where 0 copies the input dim and -1 is inferred
+    (reference ``nn/InferReshape.scala``)."""
+
+    def __init__(self, size: Sequence[int], batch_mode: bool = False, name=None):
+        super().__init__(name)
+        self.size = tuple(int(s) for s in size)
+        self.batch_mode = batch_mode
+
+    def apply(self, params, input, state, training=False, rng=None):
+        in_shape = input.shape[1:] if self.batch_mode else input.shape
+        out = []
+        for i, s in enumerate(self.size):
+            out.append(in_shape[i] if s == 0 else s)
+        if self.batch_mode:
+            out = [input.shape[0]] + out
+        return jnp.reshape(input, tuple(out)), state
+
+
+class Squeeze(Module):
+    def __init__(self, dim: Optional[int] = None, num_input_dims: int = -1,
+                 name=None):
+        super().__init__(name)
+        self.dim = dim
+        self.num_input_dims = num_input_dims
+
+    def apply(self, params, input, state, training=False, rng=None):
+        if self.dim is None:
+            return jnp.squeeze(input), state
+        ax = _axis(self.dim, input.ndim, self.num_input_dims)
+        if input.shape[ax] != 1:
+            return input, state
+        return jnp.squeeze(input, axis=ax), state
+
+
+class Unsqueeze(Module):
+    def __init__(self, pos: int, num_input_dims: int = -1, name=None):
+        super().__init__(name)
+        self.pos = pos
+        self.num_input_dims = num_input_dims
+
+    def apply(self, params, input, state, training=False, rng=None):
+        ax = self.pos - 1
+        if self.num_input_dims > 0 and input.ndim == self.num_input_dims + 1:
+            ax += 1
+        return jnp.expand_dims(input, ax), state
+
+
+class Transpose(Module):
+    """Swap listed (1-based) dim pairs in order (reference ``nn/Transpose.scala``)."""
+
+    def __init__(self, permutations: Sequence[Sequence[int]], name=None):
+        super().__init__(name)
+        self.permutations = [tuple(p) for p in permutations]
+
+    def apply(self, params, input, state, training=False, rng=None):
+        x = input
+        for d1, d2 in self.permutations:
+            x = jnp.swapaxes(x, d1 - 1, d2 - 1)
+        return x, state
+
+
+class Narrow(Module):
+    """Slice length elements from 1-based offset along dim
+    (reference ``nn/Narrow.scala``)."""
+
+    def __init__(self, dimension: int, offset: int, length: int = 1, name=None):
+        super().__init__(name)
+        self.dimension = dimension
+        self.offset = offset
+        self.length = length
+
+    def apply(self, params, input, state, training=False, rng=None):
+        ax = _axis(self.dimension, input.ndim)
+        length = self.length
+        if length < 0:
+            length = input.shape[ax] - self.offset + 1 + length + 1
+        start = self.offset - 1
+        idx = [slice(None)] * input.ndim
+        idx[ax] = slice(start, start + length)
+        return input[tuple(idx)], state
+
+
+class Select(Module):
+    """Select 1-based index along 1-based dim, dropping the dim
+    (reference ``nn/Select.scala``)."""
+
+    def __init__(self, dimension: int, index: int, name=None):
+        super().__init__(name)
+        self.dimension = dimension
+        self.index = index
+
+    def apply(self, params, input, state, training=False, rng=None):
+        ax = _axis(self.dimension, input.ndim)
+        i = self.index - 1 if self.index > 0 else input.shape[ax] + self.index
+        return jnp.take(input, i, axis=ax), state
+
+
+class Index(Module):
+    """Table input [tensor, indices]: gather along dim (1-based indices)
+    (reference ``nn/Index.scala``)."""
+
+    def __init__(self, dimension: int, name=None):
+        super().__init__(name)
+        self.dimension = dimension
+
+    def apply(self, params, input, state, training=False, rng=None):
+        x, idx = input[0], input[1]
+        ax = self.dimension - 1
+        return jnp.take(x, idx.astype(jnp.int32) - 1, axis=ax), state
+
+
+class MaskedSelect(Module):
+    """Table input [tensor, mask] -> masked elements.
+
+    XLA needs static shapes, so unlike the reference
+    (``nn/MaskedSelect.scala``) the output keeps the input length with
+    non-selected positions zeroed, packed to the front.
+    """
+
+    def apply(self, params, input, state, training=False, rng=None):
+        x, mask = input[0], input[1]
+        flat = jnp.ravel(x)
+        m = jnp.ravel(mask).astype(bool)
+        order = jnp.argsort(~m, stable=True)
+        packed = jnp.where(m[order], flat[order], 0.0)
+        return packed, state
+
+
+class Max(Module):
+    def __init__(self, dim: int = 1, num_input_dims: int = -1, name=None):
+        super().__init__(name)
+        self.dim = dim
+        self.num_input_dims = num_input_dims
+
+    def apply(self, params, input, state, training=False, rng=None):
+        ax = _axis(self.dim, input.ndim, self.num_input_dims)
+        return jnp.max(input, axis=ax), state
+
+
+class Min(Module):
+    def __init__(self, dim: int = 1, num_input_dims: int = -1, name=None):
+        super().__init__(name)
+        self.dim = dim
+        self.num_input_dims = num_input_dims
+
+    def apply(self, params, input, state, training=False, rng=None):
+        ax = _axis(self.dim, input.ndim, self.num_input_dims)
+        return jnp.min(input, axis=ax), state
+
+
+class Mean(Module):
+    def __init__(self, dimension: int = 1, n_input_dims: int = -1,
+                 squeeze: bool = True, name=None):
+        super().__init__(name)
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+        self.squeeze = squeeze
+
+    def apply(self, params, input, state, training=False, rng=None):
+        ax = _axis(self.dimension, input.ndim, self.n_input_dims)
+        return jnp.mean(input, axis=ax, keepdims=not self.squeeze), state
+
+
+class Sum(Module):
+    def __init__(self, dimension: int = 1, n_input_dims: int = -1,
+                 size_average: bool = False, squeeze: bool = True, name=None):
+        super().__init__(name)
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+        self.size_average = size_average
+        self.squeeze = squeeze
+
+    def apply(self, params, input, state, training=False, rng=None):
+        ax = _axis(self.dimension, input.ndim, self.n_input_dims)
+        if self.size_average:
+            out = jnp.mean(input, axis=ax, keepdims=not self.squeeze)
+        else:
+            out = jnp.sum(input, axis=ax, keepdims=not self.squeeze)
+        return out, state
+
+
+class Replicate(Module):
+    """Insert a new dim of size n_features at 1-based dim
+    (reference ``nn/Replicate.scala``)."""
+
+    def __init__(self, n_features: int, dim: int = 1, n_dim: int = -1, name=None):
+        super().__init__(name)
+        self.n_features = n_features
+        self.dim = dim
+        self.n_dim = n_dim
+
+    def apply(self, params, input, state, training=False, rng=None):
+        ax = self.dim - 1
+        if self.n_dim > 0 and input.ndim == self.n_dim + 1:
+            ax += 1
+        x = jnp.expand_dims(input, ax)
+        reps = [1] * x.ndim
+        reps[ax] = self.n_features
+        return jnp.tile(x, reps), state
+
+
+class Padding(Module):
+    """Pad ``pad`` entries (negative -> before, positive -> after) along dim
+    with ``value`` (reference ``nn/Padding.scala``)."""
+
+    def __init__(self, dim: int, pad: int, n_input_dim: int,
+                 value: float = 0.0, n_index: int = 1, name=None):
+        super().__init__(name)
+        self.dim = dim
+        self.pad = pad
+        self.n_input_dim = n_input_dim
+        self.value = value
+
+    def apply(self, params, input, state, training=False, rng=None):
+        ax = _axis(self.dim, input.ndim, self.n_input_dim)
+        pads = [(0, 0)] * input.ndim
+        pads[ax] = (-self.pad, 0) if self.pad < 0 else (0, self.pad)
+        return jnp.pad(input, pads, constant_values=self.value), state
+
+
+class SpatialZeroPadding(Module):
+    def __init__(self, pad_left: int, pad_right: int = None,
+                 pad_top: int = None, pad_bottom: int = None, name=None):
+        super().__init__(name)
+        self.pl = pad_left
+        self.pr = pad_right if pad_right is not None else pad_left
+        self.pt = pad_top if pad_top is not None else pad_left
+        self.pb = pad_bottom if pad_bottom is not None else pad_left
+
+    def apply(self, params, input, state, training=False, rng=None):
+        pads = [(0, 0)] * (input.ndim - 2) + [(self.pt, self.pb),
+                                              (self.pl, self.pr)]
+        return jnp.pad(input, pads), state
+
+
+class GradientReversal(Module):
+    """Identity forward, -lambda * grad backward (reference
+    ``nn/GradientReversal.scala``), via custom VJP."""
+
+    def __init__(self, the_lambda: float = 1.0, name=None):
+        super().__init__(name)
+        self.the_lambda = the_lambda
+
+    def apply(self, params, input, state, training=False, rng=None):
+        lam = self.the_lambda
+
+        @jax.custom_vjp
+        def rev(x):
+            return x
+
+        rev.defvjp(lambda x: (x, None), lambda _, g: (-lam * g,))
+        return rev(input), state
+
+
+class Scale(Module):
+    """cmul + cadd with learnable size-shaped weight and bias
+    (reference ``nn/Scale.scala``)."""
+
+    def __init__(self, size: Sequence[int], name=None):
+        super().__init__(name)
+        self.size = tuple(size)
+
+    def _init_params(self, rng):
+        return {"weight": jnp.ones(self.size), "bias": jnp.zeros(self.size)}
+
+    def apply(self, params, input, state, training=False, rng=None):
+        w, b = params["weight"], params["bias"]
+        shape = [1] * input.ndim
+        # align size to dims starting at axis 1 (channel-wise for NCHW)
+        for i, s in enumerate(self.size):
+            shape[min(i + 1, input.ndim - 1)] = s
+        return input * jnp.reshape(w, shape) + jnp.reshape(b, shape), state
+
+
+class Bottle(Module):
+    """Flatten leading dims, apply inner module, restore
+    (reference ``nn/Bottle.scala``)."""
+
+    def __init__(self, module: Module, n_input_dim: int = 2,
+                 n_output_dim: int = 2, name=None):
+        super().__init__(name)
+        self.module = module
+        self.n_input_dim = n_input_dim
+        self.n_output_dim = n_output_dim
+
+    def _init_params(self, rng):
+        return [self.module._init_params(rng)]
+
+    def _init_state(self):
+        return [self.module._init_state()]
+
+    def modules(self):
+        return [self] + self.module.modules()
+
+    def apply(self, params, input, state, training=False, rng=None):
+        lead = input.shape[:input.ndim - self.n_input_dim + 1]
+        rest = input.shape[input.ndim - self.n_input_dim + 1:]
+        flat = jnp.reshape(input, (-1,) + rest)
+        out, s = self.module.apply(params[0], flat, state[0],
+                                   training=training, rng=rng)
+        out = jnp.reshape(out, lead + out.shape[1:])
+        return out, [s]
+
+
+class MM(Module):
+    """Matrix multiply of a Table [a, b] (reference ``nn/MM.scala``)."""
+
+    def __init__(self, trans_a: bool = False, trans_b: bool = False, name=None):
+        super().__init__(name)
+        self.trans_a, self.trans_b = trans_a, trans_b
+
+    def apply(self, params, input, state, training=False, rng=None):
+        a, b = input[0], input[1]
+        if self.trans_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.trans_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b), state
+
+
+class MV(Module):
+    """Matrix-vector multiply of a Table [m, v] (reference ``nn/MV.scala``)."""
+
+    def __init__(self, trans: bool = False, name=None):
+        super().__init__(name)
+        self.trans = trans
+
+    def apply(self, params, input, state, training=False, rng=None):
+        m, v = input[0], input[1]
+        if self.trans:
+            m = jnp.swapaxes(m, -1, -2)
+        return jnp.einsum("...ij,...j->...i", m, v), state
+
+
+class DotProduct(Module):
+    """Row-wise dot product of a Table [a, b] (reference ``nn/DotProduct.scala``)."""
+
+    def apply(self, params, input, state, training=False, rng=None):
+        a, b = input[0], input[1]
+        return jnp.sum(a * b, axis=-1), state
+
+
+class Pack(Module):
+    """Stack a Table of tensors along a new 1-based dim (reference ``nn/Pack.scala``)."""
+
+    def __init__(self, dimension: int, name=None):
+        super().__init__(name)
+        self.dimension = dimension
+
+    def apply(self, params, input, state, training=False, rng=None):
+        xs = input if isinstance(input, (list, tuple)) else [input]
+        return jnp.stack(list(xs), axis=self.dimension - 1), state
+
+
+class Reverse(Module):
+    """Reverse along a 1-based dim (reference ``nn/Reverse.scala``)."""
+
+    def __init__(self, dimension: int = 1, name=None):
+        super().__init__(name)
+        self.dimension = dimension
+
+    def apply(self, params, input, state, training=False, rng=None):
+        return jnp.flip(input, axis=self.dimension - 1), state

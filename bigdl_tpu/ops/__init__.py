@@ -1,0 +1,12 @@
+"""Functional primitives used by the nn layers.
+
+This package is the TPU replacement for the reference's numeric kernels:
+``tensor/DenseTensorMath.scala`` (MKL BLAS/VML dispatch), ``nn/NNPrimitive.scala``
+(im2col/col2im/pooling hot loops).  Everything here is a pure jax function that
+XLA tiles onto the MXU/VPU — no im2col is ever materialised.
+"""
+
+from bigdl_tpu.ops.convolution import (conv2d, conv_transpose2d, conv3d,
+                                       temporal_conv1d)
+from bigdl_tpu.ops.pooling import (max_pool2d, avg_pool2d, max_pool3d,
+                                   avg_pool3d, pool_out_size)

@@ -1,0 +1,138 @@
+"""Convolution primitives on ``lax.conv_general_dilated``.
+
+Reference equivalent: the im2col + MKL gemm pipeline
+(``nn/SpatialConvolution.scala:128-230`` → ``nn/NNPrimitive.scala:108`` →
+``tensor/DenseTensorBLAS.scala:70``).  On TPU the XLA convolution emitter owns
+the MXU tiling, so there is no materialised im2col buffer and no per-frame
+thread pool; we only describe layouts via ``dimension_numbers``.
+
+Kernel storage layout is always HWIO ((kh, kw, in/groups, out)) — the
+TPU-friendly layout — independent of the activations' data format.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_DN = {
+    "NCHW": ("NCHW", "HWIO", "NCHW"),
+    "NHWC": ("NHWC", "HWIO", "NHWC"),
+}
+
+
+def _same_pad(in_size: int, k: int, s: int, d: int = 1) -> Tuple[int, int]:
+    eff_k = (k - 1) * d + 1
+    out = -(-in_size // s)
+    pad = max(0, (out - 1) * s + eff_k - in_size)
+    return pad // 2, pad - pad // 2
+
+
+def conv2d(x: jnp.ndarray, weight: jnp.ndarray,
+           bias: Optional[jnp.ndarray] = None,
+           stride: Tuple[int, int] = (1, 1),
+           padding: Union[str, Tuple[int, int]] = (0, 0),
+           dilation: Tuple[int, int] = (1, 1),
+           groups: int = 1,
+           format: str = "NCHW") -> jnp.ndarray:
+    """2-D convolution (cross-correlation, torch semantics).
+
+    padding: (padH, padW) explicit or "SAME".  BigDL encodes same-padding as
+    pad = -1 (``nn/SpatialConvolution.scala``); callers translate that here.
+    """
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape, _DN[format])
+    if padding == "SAME":
+        h_ax, w_ax = (2, 3) if format == "NCHW" else (1, 2)
+        pad = (_same_pad(x.shape[h_ax], weight.shape[0], stride[0], dilation[0]),
+               _same_pad(x.shape[w_ax], weight.shape[1], stride[1], dilation[1]))
+    else:
+        pad = ((padding[0], padding[0]), (padding[1], padding[1]))
+    out = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups)
+    if bias is not None:
+        bshape = (1, -1, 1, 1) if format == "NCHW" else (1, 1, 1, -1)
+        out = out + jnp.reshape(bias, bshape)
+    return out
+
+
+def conv_transpose2d(x: jnp.ndarray, weight: jnp.ndarray,
+                     bias: Optional[jnp.ndarray] = None,
+                     stride: Tuple[int, int] = (1, 1),
+                     padding: Tuple[int, int] = (0, 0),
+                     adj: Tuple[int, int] = (0, 0),
+                     format: str = "NCHW") -> jnp.ndarray:
+    """Transposed convolution (reference ``nn/SpatialFullConvolution``).
+
+    weight layout HWIO with I = input planes, O = output planes.
+    out = (in - 1) * stride - 2 * pad + kernel + adj.
+    """
+    kh, kw = weight.shape[0], weight.shape[1]
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape, _DN[format])
+    pad = ((kh - 1 - padding[0], kh - 1 - padding[0] + adj[0]),
+           (kw - 1 - padding[1], kw - 1 - padding[1] + adj[1]))
+    # lhs_dilation inserts (stride-1) zeros between input rows/cols: the
+    # fractionally-strided view of deconvolution.  The HWIO kernel already has
+    # I = this layer's input planes, so only a spatial flip is needed.
+    w = jnp.flip(weight, axis=(0, 1))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=pad,
+        lhs_dilation=stride, dimension_numbers=dn)
+    if bias is not None:
+        bshape = (1, -1, 1, 1) if format == "NCHW" else (1, 1, 1, -1)
+        out = out + jnp.reshape(bias, bshape)
+    return out
+
+
+def conv3d(x: jnp.ndarray, weight: jnp.ndarray,
+           bias: Optional[jnp.ndarray] = None,
+           stride: Tuple[int, int, int] = (1, 1, 1),
+           padding: Tuple[int, int, int] = (0, 0, 0)) -> jnp.ndarray:
+    """3-D convolution, NCDHW activations, DHWIO kernel
+    (reference ``nn/VolumetricConvolution``)."""
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape,
+                                    ("NCDHW", "DHWIO", "NCDHW"))
+    pad = tuple((p, p) for p in padding)
+    out = lax.conv_general_dilated(x, weight, window_strides=stride,
+                                   padding=pad, dimension_numbers=dn)
+    if bias is not None:
+        out = out + jnp.reshape(bias, (1, -1, 1, 1, 1))
+    return out
+
+
+def conv_transpose3d(x: jnp.ndarray, weight: jnp.ndarray,
+                     bias: Optional[jnp.ndarray] = None,
+                     stride=(1, 1, 1), padding=(0, 0, 0),
+                     adj=(0, 0, 0)) -> jnp.ndarray:
+    """Transposed 3-D convolution (reference ``nn/VolumetricFullConvolution``)."""
+    kd, kh, kw = weight.shape[0], weight.shape[1], weight.shape[2]
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape,
+                                    ("NCDHW", "DHWIO", "NCDHW"))
+    ks = (kd, kh, kw)
+    pad = tuple((k - 1 - p, k - 1 - p + a) for k, p, a in zip(ks, padding, adj))
+    w = jnp.flip(weight, axis=(0, 1, 2))
+    out = lax.conv_general_dilated(x, w, window_strides=(1, 1, 1), padding=pad,
+                                   lhs_dilation=stride, dimension_numbers=dn)
+    if bias is not None:
+        out = out + jnp.reshape(bias, (1, -1, 1, 1, 1))
+    return out
+
+
+def temporal_conv1d(x: jnp.ndarray, weight: jnp.ndarray,
+                    bias: Optional[jnp.ndarray] = None,
+                    stride: int = 1) -> jnp.ndarray:
+    """1-D (temporal) convolution (reference ``nn/TemporalConvolution.scala:49``).
+
+    x: (N, T, inputFrameSize); weight: (kw, inputFrameSize, outputFrameSize).
+    """
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape,
+                                    ("NWC", "WIO", "NWC"))
+    out = lax.conv_general_dilated(x, weight, window_strides=(stride,),
+                                   padding=((0, 0),), dimension_numbers=dn)
+    if bias is not None:
+        out = out + jnp.reshape(bias, (1, 1, -1))
+    return out

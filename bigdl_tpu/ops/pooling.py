@@ -1,0 +1,111 @@
+"""Pooling primitives on ``lax.reduce_window``.
+
+Reference equivalent: the hand-written pooling loops in
+``nn/NNPrimitive.scala`` (max-pool fwd/bwd float+double variants).  XLA's
+reduce-window (and its built-in select-and-scatter gradient) replaces all of
+it; ceil-mode is expressed as extra low-priority padding on the high side.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def pool_out_size(in_size: int, k: int, stride: int, pad: int,
+                  ceil_mode: bool) -> int:
+    if ceil_mode:
+        out = int(math.ceil((in_size + 2 * pad - k) / stride)) + 1
+    else:
+        out = int(math.floor((in_size + 2 * pad - k) / stride)) + 1
+    if pad > 0 and (out - 1) * stride >= in_size + pad:
+        out -= 1  # torch rule: last window must start inside the padded input
+    return out
+
+
+def _hi_pad(in_size: int, k: int, stride: int, pad: int, ceil_mode: bool) -> int:
+    out = pool_out_size(in_size, k, stride, pad, ceil_mode)
+    return max(0, (out - 1) * stride + k - in_size - pad)
+
+
+def max_pool2d(x: jnp.ndarray, kernel: Tuple[int, int],
+               stride: Tuple[int, int], padding: Tuple[int, int] = (0, 0),
+               ceil_mode: bool = False, format: str = "NCHW") -> jnp.ndarray:
+    kh, kw = kernel
+    sh, sw = stride
+    ph, pw = padding
+    h_ax, w_ax = (2, 3) if format == "NCHW" else (1, 2)
+    pads = [(0, 0)] * x.ndim
+    pads[h_ax] = (ph, _hi_pad(x.shape[h_ax], kh, sh, ph, ceil_mode))
+    pads[w_ax] = (pw, _hi_pad(x.shape[w_ax], kw, sw, pw, ceil_mode))
+    dims = [1] * x.ndim
+    dims[h_ax], dims[w_ax] = kh, kw
+    strides = [1] * x.ndim
+    strides[h_ax], strides[w_ax] = sh, sw
+    neg_inf = jnp.asarray(-jnp.inf, x.dtype)
+    return lax.reduce_window(x, neg_inf, lax.max, tuple(dims), tuple(strides),
+                             tuple(pads))
+
+
+def avg_pool2d(x: jnp.ndarray, kernel: Tuple[int, int],
+               stride: Tuple[int, int], padding: Tuple[int, int] = (0, 0),
+               ceil_mode: bool = False, count_include_pad: bool = True,
+               format: str = "NCHW") -> jnp.ndarray:
+    kh, kw = kernel
+    sh, sw = stride
+    ph, pw = padding
+    h_ax, w_ax = (2, 3) if format == "NCHW" else (1, 2)
+    pads = [(0, 0)] * x.ndim
+    pads[h_ax] = (ph, _hi_pad(x.shape[h_ax], kh, sh, ph, ceil_mode))
+    pads[w_ax] = (pw, _hi_pad(x.shape[w_ax], kw, sw, pw, ceil_mode))
+    dims = [1] * x.ndim
+    dims[h_ax], dims[w_ax] = kh, kw
+    strides = [1] * x.ndim
+    strides[h_ax], strides[w_ax] = sh, sw
+    summed = lax.reduce_window(x, jnp.asarray(0, x.dtype), lax.add,
+                               tuple(dims), tuple(strides), tuple(pads))
+    if count_include_pad:
+        return summed / (kh * kw)
+    ones = jnp.ones_like(x)
+    counts = lax.reduce_window(ones, jnp.asarray(0, x.dtype), lax.add,
+                               tuple(dims), tuple(strides), tuple(pads))
+    return summed / counts
+
+
+def max_pool3d(x: jnp.ndarray, kernel, stride, padding=(0, 0, 0),
+               ceil_mode: bool = False) -> jnp.ndarray:
+    """NCDHW max pooling (reference ``nn/VolumetricMaxPooling``)."""
+    pads = [(0, 0), (0, 0)] + [
+        (p, _hi_pad(x.shape[2 + i], kernel[i], stride[i], p, ceil_mode))
+        for i, p in enumerate(padding)]
+    dims = (1, 1) + tuple(kernel)
+    strides = (1, 1) + tuple(stride)
+    neg_inf = jnp.asarray(-jnp.inf, x.dtype)
+    return lax.reduce_window(x, neg_inf, lax.max, dims, strides, tuple(pads))
+
+
+def avg_pool3d(x: jnp.ndarray, kernel, stride, padding=(0, 0, 0),
+               ceil_mode: bool = False, count_include_pad: bool = True) -> jnp.ndarray:
+    pads = [(0, 0), (0, 0)] + [
+        (p, _hi_pad(x.shape[2 + i], kernel[i], stride[i], p, ceil_mode))
+        for i, p in enumerate(padding)]
+    dims = (1, 1) + tuple(kernel)
+    strides = (1, 1) + tuple(stride)
+    summed = lax.reduce_window(x, jnp.asarray(0, x.dtype), lax.add, dims,
+                               strides, tuple(pads))
+    if count_include_pad:
+        return summed / float(np_prod(kernel))
+    ones = jnp.ones_like(x)
+    counts = lax.reduce_window(ones, jnp.asarray(0, x.dtype), lax.add, dims,
+                               strides, tuple(pads))
+    return summed / counts
+
+
+def np_prod(xs) -> int:
+    out = 1
+    for v in xs:
+        out *= int(v)
+    return out

@@ -1,0 +1,29 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's multi-node-without-a-cluster simulation
+(``optim/DistriOptimizerSpec.scala:38-40``: Engine.init(4 nodes) over
+local[1]): here 8 virtual XLA host devices play 8 TPU chips so sharding and
+collectives run for real without hardware.
+
+Must set env vars BEFORE jax is imported anywhere.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+import numpy as np
+import pytest
+
+# Numerical-parity tests need full fp32 matmuls; the framework's production
+# default stays backend-default (bf16 passes on the MXU — the TPU-first choice).
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(0)
+    yield

@@ -1,0 +1,198 @@
+"""Criterion semantics tests with golden values (SURVEY §4.1 strategy)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+
+
+def rand(*shape):
+    return jnp.asarray(np.random.randn(*shape).astype(np.float32))
+
+
+class TestClassNLL:
+    def test_golden(self):
+        logp = jnp.log(jnp.asarray([[0.5, 0.25, 0.25], [0.1, 0.8, 0.1]]))
+        target = jnp.asarray([1.0, 2.0])  # 1-based
+        loss = float(nn.ClassNLLCriterion().forward(logp, target))
+        exp = -(np.log(0.5) + np.log(0.8)) / 2
+        np.testing.assert_allclose(loss, exp, rtol=1e-4)
+
+    def test_no_size_average(self):
+        logp = jnp.log(jnp.asarray([[0.5, 0.5]]))
+        loss = float(nn.ClassNLLCriterion(size_average=False).forward(
+            logp, jnp.asarray([1.0])))
+        np.testing.assert_allclose(loss, -np.log(0.5), rtol=1e-5)
+
+    def test_weights(self):
+        logp = jnp.log(jnp.asarray([[0.5, 0.5], [0.5, 0.5]]))
+        t = jnp.asarray([1.0, 2.0])
+        loss = float(nn.ClassNLLCriterion(weights=[1.0, 3.0]).forward(logp, t))
+        exp = -(1 * np.log(0.5) + 3 * np.log(0.5)) / 4
+        np.testing.assert_allclose(loss, exp, rtol=1e-5)
+
+    def test_backward_shape(self):
+        logp = jax.nn.log_softmax(rand(4, 5))
+        g = nn.ClassNLLCriterion().backward(logp, jnp.asarray([1., 2., 3., 4.]))
+        assert g.shape == (4, 5)
+
+    def test_crossentropy_equals_logsoftmax_nll(self):
+        x = rand(4, 6)
+        t = jnp.asarray([1., 3., 5., 2.])
+        ce = float(nn.CrossEntropyCriterion().forward(x, t))
+        nl = float(nn.ClassNLLCriterion().forward(jax.nn.log_softmax(x), t))
+        np.testing.assert_allclose(ce, nl, rtol=1e-5)
+
+
+class TestRegression:
+    def test_mse_golden(self):
+        x = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+        t = jnp.zeros((2, 2))
+        np.testing.assert_allclose(float(nn.MSECriterion().forward(x, t)),
+                                   (1 + 4 + 9 + 16) / 4, rtol=1e-6)
+
+    def test_abs_golden(self):
+        x = jnp.asarray([[1.0, -2.0]])
+        np.testing.assert_allclose(
+            float(nn.AbsCriterion().forward(x, jnp.zeros((1, 2)))), 1.5)
+
+    def test_smooth_l1(self):
+        x = jnp.asarray([0.5, 2.0])
+        t = jnp.zeros((2,))
+        exp = (0.5 * 0.25 + (2.0 - 0.5)) / 2
+        np.testing.assert_allclose(
+            float(nn.SmoothL1Criterion().forward(x, t)), exp, rtol=1e-6)
+
+    def test_bce(self):
+        x = jnp.asarray([0.9, 0.1])
+        t = jnp.asarray([1.0, 0.0])
+        exp = -np.log(0.9)
+        np.testing.assert_allclose(float(nn.BCECriterion().forward(x, t)),
+                                   exp, rtol=1e-3)
+
+    def test_kldiv(self):
+        logq = jnp.log(jnp.asarray([[0.5, 0.5]]))
+        p = jnp.asarray([[0.75, 0.25]])
+        exp = (0.75 * (np.log(0.75) - np.log(0.5))
+               + 0.25 * (np.log(0.25) - np.log(0.5)))
+        np.testing.assert_allclose(
+            float(nn.DistKLDivCriterion().forward(logq, p)), exp, rtol=1e-3)
+
+
+class TestMarginFamily:
+    def test_margin(self):
+        x = jnp.asarray([0.5, -0.5])
+        y = jnp.asarray([1.0, -1.0])
+        np.testing.assert_allclose(
+            float(nn.MarginCriterion().forward(x, y)), 0.5, rtol=1e-6)
+
+    def test_soft_margin(self):
+        x = jnp.asarray([1.0])
+        y = jnp.asarray([1.0])
+        np.testing.assert_allclose(
+            float(nn.SoftMarginCriterion().forward(x, y)),
+            np.log1p(np.exp(-1.0)), rtol=1e-5)
+
+    def test_hinge_embedding(self):
+        x = jnp.asarray([0.3, 0.4])
+        y = jnp.asarray([1.0, -1.0])
+        exp = (0.3 + max(0, 1 - 0.4)) / 2
+        np.testing.assert_allclose(
+            float(nn.HingeEmbeddingCriterion().forward(x, y)), exp, rtol=1e-5)
+
+    def test_multimargin(self):
+        x = jnp.asarray([[0.1, 0.2, 0.7]])
+        t = jnp.asarray([3.0])
+        exp = (max(0, 1 - 0.7 + 0.1) + max(0, 1 - 0.7 + 0.2)) / 3
+        np.testing.assert_allclose(
+            float(nn.MultiMarginCriterion().forward(x, t)), exp, rtol=1e-5)
+
+    def test_margin_ranking(self):
+        x1, x2 = jnp.asarray([0.7]), jnp.asarray([0.2])
+        y = jnp.asarray([1.0])
+        np.testing.assert_allclose(
+            float(nn.MarginRankingCriterion().forward([x1, x2], y)),
+            max(0, -(0.7 - 0.2) + 1), rtol=1e-5)
+
+    def test_cosine_embedding(self):
+        a = jnp.asarray([[1.0, 0.0]])
+        b = jnp.asarray([[1.0, 0.0]])
+        y = jnp.asarray([1.0])
+        np.testing.assert_allclose(
+            float(nn.CosineEmbeddingCriterion().forward([a, b], y)), 0.0,
+            atol=1e-6)
+
+
+class TestComposite:
+    def test_multi_criterion(self):
+        mc = nn.MultiCriterion()
+        mc.add(nn.MSECriterion(), 0.5).add(nn.AbsCriterion(), 2.0)
+        x, t = rand(3, 4), rand(3, 4)
+        exp = 0.5 * float(nn.MSECriterion().forward(x, t)) \
+            + 2.0 * float(nn.AbsCriterion().forward(x, t))
+        np.testing.assert_allclose(float(mc.forward(x, t)), exp, rtol=1e-5)
+
+    def test_parallel_criterion(self):
+        pc = nn.ParallelCriterion()
+        pc.add(nn.MSECriterion()).add(nn.ClassNLLCriterion())
+        x1, t1 = rand(2, 3), rand(2, 3)
+        x2 = jax.nn.log_softmax(rand(2, 4))
+        t2 = jnp.asarray([1.0, 2.0])
+        exp = float(nn.MSECriterion().forward(x1, t1)) \
+            + float(nn.ClassNLLCriterion().forward(x2, t2))
+        np.testing.assert_allclose(float(pc.forward([x1, x2], [t1, t2])), exp,
+                                   rtol=1e-5)
+
+    def test_time_distributed_criterion(self):
+        c = nn.TimeDistributedCriterion(nn.MSECriterion(), size_average=True)
+        x, t = rand(2, 5, 3), rand(2, 5, 3)
+        loss = float(c.forward(x, t))
+        exp = np.mean([(np.asarray(x)[:, i] - np.asarray(t)[:, i]) ** 2
+                       for i in range(5)])
+        np.testing.assert_allclose(loss, exp, rtol=1e-5)
+
+
+class TestOthers:
+    def test_l1cost(self):
+        x = jnp.asarray([1.0, -2.0, 3.0])
+        np.testing.assert_allclose(float(nn.L1Cost().forward(x, None)), 6.0)
+
+    def test_dice(self):
+        x = jnp.asarray([[1.0, 0.0, 1.0]])
+        t = jnp.asarray([[1.0, 0.0, 1.0]])
+        loss = float(nn.DiceCoefficientCriterion(epsilon=0.0).forward(x, t))
+        np.testing.assert_allclose(loss, 0.0, atol=1e-6)
+
+    def test_cosine_distance_criterion(self):
+        x = jnp.asarray([[1.0, 0.0]])
+        loss = float(nn.CosineDistanceCriterion().forward(x, x))
+        np.testing.assert_allclose(loss, 0.0, atol=1e-6)
+
+    def test_multilabel_soft_margin(self):
+        x = jnp.asarray([[0.0, 0.0]])
+        t = jnp.asarray([[1.0, 0.0]])
+        exp = -np.log(0.5)
+        np.testing.assert_allclose(
+            float(nn.MultiLabelSoftMarginCriterion().forward(x, t)), exp,
+            rtol=1e-5)
+
+    def test_softmax_with_criterion(self):
+        x = rand(2, 3, 4, 4)
+        t = jnp.ones((2, 4, 4))
+        loss = float(nn.SoftmaxWithCriterion().forward(x, t))
+        assert np.isfinite(loss)
+
+    def test_class_simplex(self):
+        c = nn.ClassSimplexCriterion(5)
+        x = rand(3, 5)
+        assert np.isfinite(float(c.forward(x, jnp.asarray([1., 2., 3.]))))
+
+    def test_multilabel_margin(self):
+        x = jnp.asarray([[0.1, 0.2, 0.4, 0.8]])
+        t = jnp.asarray([[3.0, 0.0, 0.0, 0.0]])  # only class 3 is a target
+        loss = float(nn.MultiLabelMarginCriterion().forward(x, t))
+        exp = (max(0, 1 - (0.4 - 0.1)) + max(0, 1 - (0.4 - 0.2))
+               + max(0, 1 - (0.4 - 0.8))) / 4
+        np.testing.assert_allclose(loss, exp, rtol=1e-5)

@@ -1,0 +1,401 @@
+"""Layer-zoo semantics tests (shape + golden-value checks).
+
+Torch-parity strategy (SURVEY §4.1): where the reference shells out to Torch7
+for golden values, we assert against hand-computed/numpy references.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+
+
+def rand(*shape):
+    return jnp.asarray(np.random.randn(*shape).astype(np.float32))
+
+
+class TestConv:
+    def test_spatial_convolution_shape(self):
+        m = nn.SpatialConvolution(3, 16, 3, 3, 2, 2, 1, 1)
+        out = m.forward(rand(2, 3, 32, 32))
+        assert out.shape == (2, 16, 16, 16)
+
+    def test_spatial_convolution_golden_identity_kernel(self):
+        # 1x1 kernel with identity weight reproduces input channels
+        m = nn.SpatialConvolution(2, 2, 1, 1, with_bias=False)
+        eye = np.zeros((1, 1, 2, 2), np.float32)
+        eye[0, 0, 0, 0] = 1
+        eye[0, 0, 1, 1] = 1
+        m.reset()
+        m.params = {"weight": jnp.asarray(eye)}
+        x = rand(1, 2, 5, 5)
+        np.testing.assert_allclose(np.asarray(m.forward(x)), np.asarray(x),
+                                   rtol=1e-6)
+
+    def test_conv_cross_correlation_semantics(self):
+        # single 2x2 kernel of ones = sliding window sum (no flip)
+        m = nn.SpatialConvolution(1, 1, 2, 2, with_bias=False)
+        m.reset()
+        m.params = {"weight": jnp.ones((2, 2, 1, 1))}
+        x = jnp.arange(9.0).reshape(1, 1, 3, 3)
+        out = np.asarray(m.forward(x))[0, 0]
+        exp = np.array([[0 + 1 + 3 + 4, 1 + 2 + 4 + 5],
+                        [3 + 4 + 6 + 7, 4 + 5 + 7 + 8]], np.float32)
+        np.testing.assert_allclose(out, exp)
+
+    def test_grouped_conv(self):
+        m = nn.SpatialConvolution(4, 8, 3, 3, n_group=2)
+        out = m.forward(rand(2, 4, 8, 8))
+        assert out.shape == (2, 8, 6, 6)
+
+    def test_same_padding(self):
+        m = nn.SpatialConvolution(3, 5, 3, 3, 1, 1, -1, -1)
+        out = m.forward(rand(2, 3, 7, 7))
+        assert out.shape == (2, 5, 7, 7)
+
+    def test_3d_input_no_batch(self):
+        m = nn.SpatialConvolution(3, 4, 3, 3)
+        out = m.forward(rand(3, 10, 10))
+        assert out.shape == (4, 8, 8)
+
+    def test_dilated(self):
+        m = nn.SpatialDilatedConvolution(2, 4, 3, 3, dilation_w=2, dilation_h=2)
+        out = m.forward(rand(1, 2, 9, 9))
+        assert out.shape == (1, 4, 5, 5)
+
+    def test_full_convolution_upsamples(self):
+        m = nn.SpatialFullConvolution(2, 3, 4, 4, 2, 2, 1, 1)
+        out = m.forward(rand(1, 2, 8, 8))
+        # out = (in-1)*stride - 2*pad + kernel = 7*2 - 2 + 4 = 16
+        assert out.shape == (1, 3, 16, 16)
+
+    def test_full_conv_gradient(self):
+        m = nn.SpatialFullConvolution(2, 2, 3, 3, 2, 2)
+        x = rand(1, 2, 4, 4)
+        out = m.forward(x)
+        gin = m.backward(x, jnp.ones_like(out))
+        assert gin.shape == x.shape
+
+    def test_temporal_convolution(self):
+        m = nn.TemporalConvolution(8, 16, 3, 1)
+        out = m.forward(rand(2, 10, 8))
+        assert out.shape == (2, 8, 16)
+
+    def test_volumetric_convolution(self):
+        m = nn.VolumetricConvolution(2, 4, 3, 3, 3)
+        out = m.forward(rand(1, 2, 8, 8, 8))
+        assert out.shape == (1, 4, 6, 6, 6)
+
+    def test_convolution_map(self):
+        table = nn.SpatialConvolutionMap.one_to_one(3)
+        m = nn.SpatialConvolutionMap(table, 3, 3)
+        out = m.forward(rand(1, 3, 8, 8))
+        assert out.shape == (1, 3, 6, 6)
+
+
+class TestPooling:
+    def test_max_pool_golden(self):
+        m = nn.SpatialMaxPooling(2, 2, 2, 2)
+        x = jnp.arange(16.0).reshape(1, 1, 4, 4)
+        out = np.asarray(m.forward(x))[0, 0]
+        np.testing.assert_allclose(out, [[5, 7], [13, 15]])
+
+    def test_max_pool_ceil_mode(self):
+        m = nn.SpatialMaxPooling(3, 3, 2, 2).ceil()
+        out = m.forward(rand(1, 2, 6, 6))
+        assert out.shape == (1, 2, 3, 3)
+        m2 = nn.SpatialMaxPooling(3, 3, 2, 2)
+        assert m2.forward(rand(1, 2, 6, 6)).shape == (1, 2, 2, 2)
+
+    def test_avg_pool_golden(self):
+        m = nn.SpatialAveragePooling(2, 2, 2, 2)
+        x = jnp.arange(16.0).reshape(1, 1, 4, 4)
+        out = np.asarray(m.forward(x))[0, 0]
+        np.testing.assert_allclose(out, [[2.5, 4.5], [10.5, 12.5]])
+
+    def test_global_avg_pool(self):
+        m = nn.SpatialAveragePooling(0, 0, 1, 1, global_pooling=True)
+        out = m.forward(rand(2, 3, 5, 5))
+        assert out.shape == (2, 3, 1, 1)
+
+    def test_max_pool_gradient_routes_to_max(self):
+        m = nn.SpatialMaxPooling(2, 2, 2, 2)
+        x = jnp.asarray([[[[1.0, 2.0], [3.0, 4.0]]]])
+        out = m.forward(x)
+        gin = np.asarray(m.backward(x, jnp.ones_like(out)))
+        np.testing.assert_allclose(gin[0, 0], [[0, 0], [0, 1]])
+
+    def test_volumetric_max_pool(self):
+        m = nn.VolumetricMaxPooling(2, 2, 2)
+        out = m.forward(rand(1, 2, 4, 4, 4))
+        assert out.shape == (1, 2, 2, 2, 2)
+
+    def test_roi_pooling(self):
+        m = nn.RoiPooling(3, 3, 1.0)
+        data = rand(2, 4, 16, 16)
+        rois = jnp.asarray([[0, 0, 0, 7, 7], [1, 4, 4, 15, 15]], jnp.float32)
+        out = m.forward([data, rois])
+        assert out.shape == (2, 4, 3, 3)
+
+
+class TestActivations:
+    @pytest.mark.parametrize("layer,fn", [
+        (nn.ReLU(), lambda x: np.maximum(x, 0)),
+        (nn.ReLU6(), lambda x: np.clip(x, 0, 6)),
+        (nn.Tanh(), np.tanh),
+        (nn.Sigmoid(), lambda x: 1 / (1 + np.exp(-x))),
+        (nn.Abs(), np.abs),
+        (nn.Square(), lambda x: x * x),
+        (nn.Exp(), np.exp),
+        (nn.SoftSign(), lambda x: x / (1 + np.abs(x))),
+        (nn.TanhShrink(), lambda x: x - np.tanh(x)),
+        (nn.HardTanh(), lambda x: np.clip(x, -1, 1)),
+        (nn.LeakyReLU(0.1), lambda x: np.where(x >= 0, x, 0.1 * x)),
+        (nn.ELU(), lambda x: np.where(x > 0, x, np.exp(x) - 1)),
+    ])
+    def test_elementwise_golden(self, layer, fn):
+        x = rand(3, 7)
+        np.testing.assert_allclose(np.asarray(layer.forward(x)),
+                                   fn(np.asarray(x)), rtol=1e-4, atol=1e-5)
+
+    def test_logsoftmax_rows_sum_to_one(self):
+        out = np.exp(np.asarray(nn.LogSoftMax().forward(rand(4, 9))))
+        np.testing.assert_allclose(out.sum(-1), np.ones(4), rtol=1e-3)
+
+    def test_softmin(self):
+        x = rand(2, 5)
+        out = np.asarray(nn.SoftMin().forward(x))
+        exp = np.asarray(jax.nn.softmax(-x, axis=-1))
+        np.testing.assert_allclose(out, exp, rtol=1e-5)
+
+    def test_prelu_learnable(self):
+        m = nn.PReLU(3)
+        x = rand(2, 3, 4, 4)
+        out = m.forward(x)
+        assert out.shape == x.shape
+        m.backward(x, jnp.ones_like(out))
+        assert m.grads["weight"].shape == (3,)
+
+    def test_dropout_train_vs_eval(self):
+        m = nn.Dropout(0.5)
+        x = jnp.ones((100, 100))
+        out = m.forward(x)
+        frac = float((np.asarray(out) == 0).mean())
+        assert 0.3 < frac < 0.7  # ~half dropped
+        kept = np.asarray(out)[np.asarray(out) != 0]
+        np.testing.assert_allclose(kept, 2.0, rtol=1e-6)  # inverted scaling
+        m.evaluate()
+        np.testing.assert_allclose(np.asarray(m.forward(x)), 1.0)
+
+    def test_rrelu_eval_deterministic(self):
+        m = nn.RReLU().evaluate()
+        x = -jnp.ones((4,))
+        out = np.asarray(m.forward(x))
+        np.testing.assert_allclose(out, -(1 / 8 + 1 / 3) / 2, rtol=1e-5)
+
+
+class TestNormalization:
+    def test_batchnorm_normalizes(self):
+        m = nn.BatchNormalization(8)
+        x = rand(32, 8) * 5 + 3
+        out = np.asarray(m.forward(x))
+        w = np.abs(np.asarray(m.params["weight"]))
+        np.testing.assert_allclose(out.mean(0), 0, atol=1e-4)
+        # affine scale: per-channel std equals |weight| (bias init is 0)
+        np.testing.assert_allclose(out.std(0) / w, 1, atol=5e-2)
+
+    def test_batchnorm_running_stats_updated(self):
+        m = nn.SpatialBatchNormalization(4)
+        x = rand(8, 4, 5, 5) + 2.0
+        m.forward(x)
+        rm = np.asarray(m.state["running_mean"])
+        assert np.abs(rm).sum() > 0  # moved off zero
+
+    def test_batchnorm_eval_uses_running_stats(self):
+        m = nn.BatchNormalization(4)
+        for _ in range(50):
+            m.forward(rand(64, 4) + 1.0)
+        m.evaluate()
+        out = np.asarray(m.forward(jnp.ones((4, 4))))
+        # running mean ~1, var ~1 -> output ~ (1-1)/1 * w + b ~ 0 modulo w
+        assert np.abs(out.mean()) < 0.5
+
+    def test_lrn_shape(self):
+        m = nn.SpatialCrossMapLRN(5, 1.0, 0.75, 1.0)
+        out = m.forward(rand(2, 8, 6, 6))
+        assert out.shape == (2, 8, 6, 6)
+
+    def test_normalize_l2(self):
+        m = nn.Normalize(2)
+        out = np.asarray(m.forward(rand(4, 10)))
+        np.testing.assert_allclose(np.linalg.norm(out, axis=-1), 1.0, rtol=1e-4)
+
+    def test_subtractive_normalization_zero_mean_constant(self):
+        m = nn.SpatialSubtractiveNormalization(1)
+        x = jnp.ones((1, 1, 16, 16)) * 7.0
+        out = np.asarray(m.forward(x))
+        np.testing.assert_allclose(out, 0.0, atol=1e-4)
+
+
+class TestStructural:
+    def test_reshape_batch_auto(self):
+        m = nn.Reshape([12, 4])
+        assert m.forward(rand(5, 48)).shape == (5, 12, 4)
+        assert m.forward(rand(48)).shape == (12, 4)
+
+    def test_view_infer(self):
+        m = nn.View(-1, 6)
+        assert m.forward(rand(3, 12)).shape == (6, 6)
+
+    def test_select_narrow(self):
+        x = rand(4, 6, 5)
+        assert nn.Select(2, 3).forward(x).shape == (4, 5)
+        np.testing.assert_allclose(np.asarray(nn.Select(2, 3).forward(x)),
+                                   np.asarray(x)[:, 2, :])
+        out = nn.Narrow(2, 2, 3).forward(x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x)[:, 1:4])
+
+    def test_squeeze_unsqueeze_transpose(self):
+        x = rand(3, 1, 5)
+        assert nn.Squeeze(2).forward(x).shape == (3, 5)
+        assert nn.Unsqueeze(2).forward(rand(3, 5)).shape == (3, 1, 5)
+        assert nn.Transpose([(1, 2)]).forward(rand(3, 5)).shape == (5, 3)
+
+    def test_sum_mean_max_min(self):
+        x = rand(4, 6)
+        np.testing.assert_allclose(np.asarray(nn.Sum(2).forward(x)),
+                                   np.asarray(x).sum(1), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(nn.Mean(1).forward(x)),
+                                   np.asarray(x).mean(0), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(nn.Max(2).forward(x)),
+                                   np.asarray(x).max(1), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(nn.Min(2).forward(x)),
+                                   np.asarray(x).min(1), rtol=1e-5)
+
+    def test_replicate(self):
+        # nDim=1: (4,5) is batch of 1-D samples -> new dim after batch
+        assert nn.Replicate(3, 1, 1).forward(rand(4, 5)).shape == (4, 3, 5)
+        # unbatched: insert at dim 1
+        assert nn.Replicate(3, 1).forward(rand(4, 5)).shape == (3, 4, 5)
+
+    def test_padding(self):
+        out = nn.Padding(2, 2, 2).forward(rand(3, 4))
+        assert out.shape == (3, 6)
+        out = nn.Padding(2, -2, 2).forward(rand(3, 4))
+        assert out.shape == (3, 6)
+
+    def test_spatial_zero_padding(self):
+        assert nn.SpatialZeroPadding(1, 2, 3, 4).forward(
+            rand(1, 2, 5, 5)).shape == (1, 2, 12, 8)
+
+    def test_mm_mv_dot(self):
+        a, b = rand(2, 3, 4), rand(2, 4, 5)
+        assert nn.MM().forward([a, b]).shape == (2, 3, 5)
+        m, v = rand(2, 3, 4), rand(2, 4)
+        assert nn.MV().forward([m, v]).shape == (2, 3)
+        x, y = rand(5, 7), rand(5, 7)
+        np.testing.assert_allclose(np.asarray(nn.DotProduct().forward([x, y])),
+                                   (np.asarray(x) * np.asarray(y)).sum(-1),
+                                   rtol=1e-5)
+
+    def test_gradient_reversal(self):
+        m = nn.GradientReversal(2.0)
+        x = rand(3, 3)
+        out = m.forward(x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+        gin = m.backward(x, jnp.ones_like(x))
+        np.testing.assert_allclose(np.asarray(gin), -2.0)
+
+    def test_pack_reverse(self):
+        xs = [rand(3, 4), rand(3, 4)]
+        assert nn.Pack(2).forward(xs).shape == (3, 2, 4)
+        x = rand(5, 3)
+        np.testing.assert_allclose(np.asarray(nn.Reverse(1).forward(x)),
+                                   np.asarray(x)[::-1])
+
+
+class TestTableOps:
+    def test_join_split_roundtrip(self):
+        x = rand(4, 3, 5)
+        parts = nn.SplitTable(2).forward(x)
+        assert len(parts) == 3 and parts[0].shape == (4, 5)
+        packed = nn.Pack(2).forward(parts)
+        np.testing.assert_allclose(np.asarray(packed), np.asarray(x))
+
+    def test_select_narrow_flatten_table(self):
+        xs = [rand(2), rand(3), rand(4)]
+        assert nn.SelectTable(2).forward(xs).shape == (3,)
+        assert nn.SelectTable(-1).forward(xs).shape == (4,)
+        assert len(nn.NarrowTable(2, 2).forward(xs)) == 2
+        nested = [rand(2), [rand(3), [rand(4)]]]
+        assert len(nn.FlattenTable().forward(nested)) == 3
+
+    def test_arith_tables(self):
+        a, b = rand(3, 4), rand(3, 4)
+        an, bn = np.asarray(a), np.asarray(b)
+        np.testing.assert_allclose(np.asarray(nn.CAddTable().forward([a, b])),
+                                   an + bn, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(nn.CSubTable().forward([a, b])),
+                                   an - bn, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(nn.CMulTable().forward([a, b])),
+                                   an * bn, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(nn.CMaxTable().forward([a, b])),
+                                   np.maximum(an, bn), rtol=1e-5)
+
+    def test_mixture_table(self):
+        gates = jnp.asarray([[0.3, 0.7], [0.5, 0.5]])
+        e1, e2 = rand(2, 4), rand(2, 4)
+        out = np.asarray(nn.MixtureTable().forward([gates, [e1, e2]]))
+        exp = (np.asarray(gates)[:, 0:1] * np.asarray(e1)
+               + np.asarray(gates)[:, 1:2] * np.asarray(e2))
+        np.testing.assert_allclose(out, exp, rtol=1e-5)
+
+    def test_distances(self):
+        a, b = rand(3, 4), rand(3, 4)
+        d = np.asarray(nn.PairwiseDistance(2).forward([a, b]))
+        np.testing.assert_allclose(
+            d, np.linalg.norm(np.asarray(a) - np.asarray(b), axis=-1), rtol=1e-4)
+        c = np.asarray(nn.CosineDistance().forward([a, b]))
+        assert c.shape == (3,)
+
+
+class TestLinearFamily:
+    def test_linear_golden(self):
+        m = nn.Linear(3, 2)
+        m.params = {"weight": jnp.asarray([[1., 0.], [0., 1.], [1., 1.]]),
+                    "bias": jnp.asarray([0.5, -0.5])}
+        out = np.asarray(m.forward(jnp.asarray([[1., 2., 3.]])))
+        np.testing.assert_allclose(out, [[1 + 3 + 0.5, 2 + 3 - 0.5]])
+
+    def test_lookup_table_one_based(self):
+        m = nn.LookupTable(10, 4)
+        idx = jnp.asarray([[1., 10.], [3., 3.]])
+        out = m.forward(idx)
+        assert out.shape == (2, 2, 4)
+        np.testing.assert_allclose(np.asarray(out[0, 0]),
+                                   np.asarray(m.params["weight"][0]))
+        np.testing.assert_allclose(np.asarray(out[0, 1]),
+                                   np.asarray(m.params["weight"][9]))
+
+    def test_bilinear(self):
+        m = nn.Bilinear(3, 4, 2)
+        out = m.forward([rand(5, 3), rand(5, 4)])
+        assert out.shape == (5, 2)
+
+    def test_cmul_cadd(self):
+        x = rand(2, 3)
+        m = nn.CMul([3])
+        np.testing.assert_allclose(np.asarray(m.forward(x)),
+                                   np.asarray(x) * np.asarray(m.params["weight"]),
+                                   rtol=1e-5)
+        m2 = nn.CAdd([3])
+        np.testing.assert_allclose(np.asarray(m2.forward(x)),
+                                   np.asarray(x) + np.asarray(m2.params["bias"]),
+                                   rtol=1e-5)
+
+    def test_euclidean_cosine(self):
+        assert nn.Euclidean(4, 6).forward(rand(2, 4)).shape == (2, 6)
+        out = np.asarray(nn.Cosine(4, 6).forward(rand(2, 4)))
+        assert out.shape == (2, 6) and np.all(np.abs(out) <= 1 + 1e-5)
