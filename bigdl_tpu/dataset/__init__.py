@@ -1,0 +1,20 @@
+"""bigdl_tpu.dataset — host-side data pipeline (SURVEY §2.5).
+
+Records and transforms are numpy on the host; arrays cross to the device only
+at the jit boundary inside the optimizers.
+"""
+
+from bigdl_tpu.dataset.sample import Sample, MiniBatch, PaddingParam
+from bigdl_tpu.dataset.transformer import (Transformer, ChainedTransformer,
+                                           FuncTransformer, SampleToMiniBatch,
+                                           SampleToBatch)
+from bigdl_tpu.dataset.dataset import (AbstractDataSet, LocalDataSet,
+                                       ShardedDataSet, DataSet)
+from bigdl_tpu.dataset import image
+from bigdl_tpu.dataset import text
+from bigdl_tpu.dataset import datasets
+
+__all__ = ["Sample", "MiniBatch", "PaddingParam", "Transformer",
+           "ChainedTransformer", "FuncTransformer", "SampleToMiniBatch",
+           "SampleToBatch", "AbstractDataSet", "LocalDataSet",
+           "ShardedDataSet", "DataSet", "image", "text", "datasets"]

@@ -1,0 +1,178 @@
+"""DataSet: the training-data abstraction.
+
+Reference equivalents: ``dataset/DataSet.scala:46`` (``AbstractDataSet``:
+``data(train)`` returns a looped-infinite (train) or finite (eval) stream;
+``shuffle()``; ``transform/->`` composition), ``:110`` (``LocalDataSet``),
+``:164`` (``DistributedDataSet``), ``:240-314`` (``CachedDistriDataSet``:
+in-memory records + a separately shuffled index array).
+
+TPU-native notes: records stay host-side numpy until the jit boundary.  The
+epoch/shuffle protocol is reproduced exactly (shuffled index array over a
+cached record array; infinite looping iterator for training) because the
+north-star metric is epoch-to-accuracy parity (SURVEY §7 hard parts).
+
+``ShardedDataSet`` is the DistributedDataSet analog: it splits records into
+``partition_num`` shards (one per data-parallel device/host) and hands each
+shard its own looped iterator — the reference's "one Spark partition = one
+model replica group" tier, minus Spark (which orchestrates ingest in the
+full deployment; the in-process sharded form is what feeds pjit).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.dataset.transformer import Transformer
+from bigdl_tpu.utils.random_generator import RandomGenerator
+
+
+class AbstractDataSet:
+    """(reference ``AbstractDataSet``, ``dataset/DataSet.scala:46``)."""
+
+    def data(self, train: bool) -> Iterator:
+        raise NotImplementedError
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def shuffle(self) -> None:
+        raise NotImplementedError
+
+    def transform(self, transformer: Transformer) -> "AbstractDataSet":
+        raise NotImplementedError
+
+    def __rshift__(self, transformer: Transformer) -> "AbstractDataSet":
+        return self.transform(transformer)
+
+
+class LocalDataSet(AbstractDataSet):
+    """In-memory record array + shuffled index (reference ``LocalArrayDataSet``
+    + the CachedDistriDataSet index-shuffle protocol,
+    ``dataset/DataSet.scala:251-299``)."""
+
+    def __init__(self, records: Sequence[Any],
+                 transformers: Optional[List[Transformer]] = None):
+        self.records = list(records)
+        self.index = np.arange(len(self.records))
+        self.transformers: List[Transformer] = list(transformers or [])
+
+    def size(self) -> int:
+        return len(self.records)
+
+    def shuffle(self) -> None:
+        RandomGenerator.RNG().shuffle(self.index)
+
+    def transform(self, transformer: Transformer) -> "LocalDataSet":
+        ds = LocalDataSet.__new__(LocalDataSet)
+        ds.records = self.records
+        ds.index = self.index      # shared: shuffle() visible through views
+        ds.transformers = self.transformers + [transformer]
+        return ds
+
+    def _raw(self, train: bool) -> Iterator:
+        if train:
+            # looped-infinite, re-reading the (possibly re-shuffled) index
+            def gen():
+                while True:
+                    for i in self.index:
+                        yield self.records[i]
+            return gen()
+        return (self.records[i] for i in self.index)
+
+    def data(self, train: bool) -> Iterator:
+        it = self._raw(train)
+        for t in self.transformers:
+            it = t(it)
+        return it
+
+
+class ShardedDataSet(AbstractDataSet):
+    """Partition-sharded dataset — the DistributedDataSet analog
+    (reference ``CachedDistriDataSet``, ``dataset/DataSet.scala:240-314``:
+    per-partition record arrays, per-partition shuffled indexes, coalesced to
+    exactly nodeNumber partitions).
+
+    ``data(train=True)`` yields per-shard iterators via :meth:`shard_data`;
+    the distributed optimizer zips shard streams into one global step.
+    """
+
+    def __init__(self, records: Sequence[Any], partition_num: int,
+                 transformers: Optional[List[Transformer]] = None):
+        self.partition_num = partition_num
+        n = len(records)
+        if n < partition_num:
+            raise ValueError(f"{n} records < {partition_num} partitions")
+        # round-robin assignment keeps shard sizes within 1 of each other,
+        # then truncate to equal size (static shapes for XLA)
+        per = n // partition_num
+        self.shards: List[LocalDataSet] = []
+        for p in range(partition_num):
+            recs = [records[i] for i in range(p, per * partition_num,
+                                              partition_num)]
+            self.shards.append(LocalDataSet(recs, transformers))
+
+    def size(self) -> int:
+        return sum(s.size() for s in self.shards)
+
+    def shuffle(self) -> None:
+        for s in self.shards:
+            s.shuffle()
+
+    def transform(self, transformer: Transformer) -> "ShardedDataSet":
+        ds = ShardedDataSet.__new__(ShardedDataSet)
+        ds.partition_num = self.partition_num
+        ds.shards = [s.transform(transformer) for s in self.shards]
+        return ds
+
+    def shard_data(self, shard: int, train: bool) -> Iterator:
+        return self.shards[shard].data(train)
+
+    def data(self, train: bool) -> Iterator:
+        """Interleaved global stream (eval convenience)."""
+        its = [s.data(train) for s in self.shards]
+        if train:
+            while True:
+                for it in its:
+                    yield next(it)
+        else:
+            exhausted = [False] * len(its)
+            while not all(exhausted):
+                for i, it in enumerate(its):
+                    if exhausted[i]:
+                        continue
+                    try:
+                        yield next(it)
+                    except StopIteration:
+                        exhausted[i] = True
+
+
+class DataSet:
+    """Factory namespace (reference ``object DataSet``,
+    ``dataset/DataSet.scala:319-558``)."""
+
+    @staticmethod
+    def array(records: Sequence[Any],
+              partition_num: Optional[int] = None) -> AbstractDataSet:
+        if partition_num is None or partition_num <= 1:
+            return LocalDataSet(records)
+        return ShardedDataSet(records, partition_num)
+
+    @staticmethod
+    def image_folder(path: str, scale_to: int = 256) -> "LocalDataSet":
+        """Label-per-subdirectory image tree (reference
+        ``ImageFolder.paths``, ``dataset/DataSet.scala:419``).  Labels are
+        1-based float32 in subdirectory sort order, like the reference."""
+        import os
+        from bigdl_tpu.dataset.image import LocalImgPath
+        classes = sorted(d for d in os.listdir(path)
+                         if os.path.isdir(os.path.join(path, d)))
+        records = []
+        for label, cls in enumerate(classes, start=1):
+            d = os.path.join(path, cls)
+            for f in sorted(os.listdir(d)):
+                if f.lower().endswith((".jpg", ".jpeg", ".png", ".bmp")):
+                    records.append(LocalImgPath(os.path.join(d, f),
+                                                float(label)))
+        return LocalDataSet(records)

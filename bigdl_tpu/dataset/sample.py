@@ -1,0 +1,146 @@
+"""Sample and MiniBatch: the record and batch abstractions.
+
+Reference equivalents: ``dataset/Sample.scala:31`` (one record =
+feature tensor(s) + label tensor(s), backed by one flat array) and
+``dataset/MiniBatch.scala:33`` (a batch with ``slice`` for splitting across
+model-replica threads, plus padding strategies).
+
+TPU-native notes: host-side records are numpy (cheap, mutable, pipelined);
+they become device arrays only at the jit boundary.  The reference's
+``slice()`` existed to split a batch across intra-node replica threads — on
+TPU that tier disappears (one big per-chip batch under jit), but ``slice`` is
+kept for API parity and for sharding a global batch across data-parallel
+devices.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Union
+
+import numpy as np
+
+
+def _to_list(x) -> List[np.ndarray]:
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return [np.asarray(t) for t in x]
+    return [np.asarray(x)]
+
+
+class Sample:
+    """One record: feature array(s) + label array(s)
+    (reference ``ArraySample``, ``dataset/Sample.scala:129``)."""
+
+    __slots__ = ("features", "labels")
+
+    def __init__(self, features, labels=None):
+        self.features: List[np.ndarray] = _to_list(features)
+        self.labels: List[np.ndarray] = _to_list(labels)
+
+    @property
+    def feature(self) -> np.ndarray:
+        return self.features[0]
+
+    @property
+    def label(self) -> np.ndarray:
+        return self.labels[0]
+
+    def feature_size(self):
+        return [f.shape for f in self.features]
+
+    def label_size(self):
+        return [l.shape for l in self.labels]
+
+    def num_feature(self) -> int:
+        return len(self.features)
+
+    def num_label(self) -> int:
+        return len(self.labels)
+
+    def __repr__(self):
+        return (f"Sample(features={[f.shape for f in self.features]}, "
+                f"labels={[l.shape for l in self.labels]})")
+
+
+class PaddingParam:
+    """Padding strategy for variable-length samples
+    (reference ``dataset/MiniBatch.scala:522-566``).
+
+    ``padding_value``: scalar fill; ``fixed_length``: per-tensor target lengths
+    (None → pad to the longest in the batch, the reference's default).
+    """
+
+    def __init__(self, padding_value: float = 0.0,
+                 fixed_length: Optional[Sequence[int]] = None):
+        self.padding_value = padding_value
+        self.fixed_length = fixed_length
+
+
+def _stack_padded(arrays: List[np.ndarray],
+                  param: Optional[PaddingParam]) -> np.ndarray:
+    """Stack along a new batch dim, padding dim 0 of each record if ragged."""
+    shapes = {a.shape for a in arrays}
+    if len(shapes) == 1 and (param is None or param.fixed_length is None):
+        return np.stack(arrays)
+    if param is None:
+        param = PaddingParam()
+    ndim = arrays[0].ndim
+    max_per_dim = [max(a.shape[d] for a in arrays) for d in range(ndim)]
+    if param.fixed_length is not None:
+        for d, fl in enumerate(param.fixed_length[:ndim]):
+            if fl is not None and fl > 0:
+                if fl < max_per_dim[d]:
+                    raise ValueError(
+                        f"fixed_length {fl} < longest sample {max_per_dim[d]}")
+                max_per_dim[d] = fl
+    out = np.full([len(arrays)] + max_per_dim, param.padding_value,
+                  dtype=arrays[0].dtype)
+    for i, a in enumerate(arrays):
+        out[(i,) + tuple(slice(0, s) for s in a.shape)] = a
+    return out
+
+
+class MiniBatch:
+    """A batch of samples (reference ``ArrayTensorMiniBatch``,
+    ``dataset/MiniBatch.scala:33``).
+
+    ``inputs``/``targets`` are lists of numpy arrays whose dim 0 is the batch
+    dimension.  ``get_input()``/``get_target()`` return a single array when
+    there is exactly one (the reference's Tensor-vs-Table Activity collapse).
+    """
+
+    def __init__(self, inputs, targets=None):
+        self.inputs: List[np.ndarray] = _to_list(inputs)
+        self.targets: List[np.ndarray] = _to_list(targets)
+
+    @staticmethod
+    def from_samples(samples: Sequence[Sample],
+                     feature_padding: Optional[PaddingParam] = None,
+                     label_padding: Optional[PaddingParam] = None) -> "MiniBatch":
+        n_feat = samples[0].num_feature()
+        n_lab = samples[0].num_label()
+        inputs = [_stack_padded([s.features[i] for s in samples],
+                                feature_padding) for i in range(n_feat)]
+        targets = [_stack_padded([s.labels[i] for s in samples],
+                                 label_padding) for i in range(n_lab)]
+        return MiniBatch(inputs, targets)
+
+    def size(self) -> int:
+        return self.inputs[0].shape[0] if self.inputs else 0
+
+    def slice(self, offset: int, length: int) -> "MiniBatch":
+        """Sub-batch [offset, offset+length) — 0-based, unlike the 1-based
+        reference (reference ``MiniBatch.slice``)."""
+        return MiniBatch([a[offset:offset + length] for a in self.inputs],
+                         [a[offset:offset + length] for a in self.targets])
+
+    def get_input(self) -> Union[np.ndarray, List[np.ndarray]]:
+        return self.inputs[0] if len(self.inputs) == 1 else self.inputs
+
+    def get_target(self) -> Union[np.ndarray, List[np.ndarray]]:
+        return self.targets[0] if len(self.targets) == 1 else self.targets
+
+    def __repr__(self):
+        return (f"MiniBatch(inputs={[a.shape for a in self.inputs]}, "
+                f"targets={[a.shape for a in self.targets]})")

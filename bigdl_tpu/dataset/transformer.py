@@ -1,0 +1,105 @@
+"""Transformer: composable iterator-to-iterator data transforms.
+
+Reference equivalent: ``dataset/Transformer.scala:44`` — a serializable
+``Iterator[A] → Iterator[B]`` function with ``->`` chaining, cloned per Spark
+partition.  Here transformers are picklable Python callables over iterators;
+chaining composes with ``>>`` (or ``chain``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import MiniBatch, PaddingParam, Sample
+
+
+class Transformer:
+    """Base: subclasses implement ``__call__(iterator) -> iterator``."""
+
+    def __call__(self, it: Iterator) -> Iterator:
+        raise NotImplementedError(type(self).__name__)
+
+    def __rshift__(self, other: "Transformer") -> "ChainedTransformer":
+        return ChainedTransformer(self, other)
+
+    # reference spelling: ``prev -> next``
+    def chain(self, other: "Transformer") -> "ChainedTransformer":
+        return self >> other
+
+    def apply_single(self, item):
+        """Convenience: run on one element."""
+        return next(iter(self([item])))
+
+
+class ChainedTransformer(Transformer):
+    """(reference ``ChainedTransformer``, ``dataset/Transformer.scala:86``)."""
+
+    def __init__(self, *stages: Transformer):
+        flat: List[Transformer] = []
+        for s in stages:
+            if isinstance(s, ChainedTransformer):
+                flat.extend(s.stages)
+            else:
+                flat.append(s)
+        self.stages = flat
+
+    def __call__(self, it: Iterator) -> Iterator:
+        for s in self.stages:
+            it = s(it)
+        return it
+
+
+class Identity(Transformer):
+    def __call__(self, it: Iterator) -> Iterator:
+        return iter(it)
+
+
+class FuncTransformer(Transformer):
+    """Wrap a per-element function."""
+
+    def __init__(self, fn: Callable[[Any], Any]):
+        self.fn = fn
+
+    def __call__(self, it: Iterator) -> Iterator:
+        return (self.fn(x) for x in it)
+
+
+class SampleToMiniBatch(Transformer):
+    """Group a Sample stream into MiniBatches
+    (reference ``SampleToMiniBatch``, ``dataset/Transformer.scala:309``).
+
+    ``total_batch`` is the GLOBAL batch size; the per-iterator batch is
+    ``total_batch / partition_num`` exactly as the reference divides per
+    partition (``dataset/Utils.scala:25``).  Incomplete trailing batches are
+    emitted (the looped-infinite training iterator never produces one).
+    """
+
+    def __init__(self, total_batch: int, partition_num: int = 1,
+                 feature_padding: Optional[PaddingParam] = None,
+                 label_padding: Optional[PaddingParam] = None):
+        if total_batch % partition_num != 0:
+            raise ValueError(
+                f"total batch size {total_batch} must be divisible by "
+                f"partition number {partition_num} (reference dataset/Utils.scala:25)")
+        self.batch_per_partition = total_batch // partition_num
+        self.feature_padding = feature_padding
+        self.label_padding = label_padding
+
+    def __call__(self, it: Iterator) -> Iterator[MiniBatch]:
+        buf: List[Sample] = []
+        for s in it:
+            buf.append(s)
+            if len(buf) == self.batch_per_partition:
+                yield MiniBatch.from_samples(buf, self.feature_padding,
+                                             self.label_padding)
+                buf = []
+        if buf:
+            yield MiniBatch.from_samples(buf, self.feature_padding,
+                                         self.label_padding)
+
+
+# Alias for the older reference name (``SampleToBatch``).
+SampleToBatch = SampleToMiniBatch

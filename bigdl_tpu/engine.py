@@ -134,6 +134,18 @@ class Engine:
             Engine.init()
 
 
+def to_device(x):
+    """Recursively move a nested list/tuple/dict of arrays onto the device
+    (the single host→device crossing point of the data pipeline)."""
+    import jax.numpy as jnp
+
+    if isinstance(x, dict):
+        return {k: to_device(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return type(x)(to_device(v) for v in x)
+    return jnp.asarray(x)
+
+
 def _default_engine_type() -> str:
     try:
         platform = jax.devices()[0].platform

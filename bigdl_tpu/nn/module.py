@@ -339,6 +339,7 @@ class Module:
     def __getstate__(self):
         d = dict(self.__dict__)
         d["_jit_apply"] = None
+        d.pop("_eval_jit", None)
         d["_last_rng"] = None
         d["_fwd_state_in"] = None
         d["_rng_seq"] = None
@@ -418,6 +419,7 @@ class Container(Module):
     def add(self, module: Module) -> "Container":
         self.children.append(module)
         self._jit_apply = None
+        self.__dict__.pop("_eval_jit", None)
         return self
 
     def _init_params(self, rng) -> Params:

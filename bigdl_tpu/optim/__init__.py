@@ -1,0 +1,37 @@
+"""bigdl_tpu.optim — training orchestration (SURVEY §2.7)."""
+
+from bigdl_tpu.optim.optim_method import (OptimMethod, SGD, Adagrad, Adadelta,
+                                          Adam, Adamax, RMSprop, LBFGS,
+                                          LearningRateSchedule, Default, Step,
+                                          MultiStep, EpochStep, EpochDecay,
+                                          Poly, Exponential, NaturalExp,
+                                          EpochSchedule, Regime, Plateau)
+from bigdl_tpu.optim.trigger import (Trigger, every_epoch, several_iteration,
+                                     max_epoch, max_iteration, max_score,
+                                     min_loss)
+from bigdl_tpu.optim.validation_method import (ValidationMethod,
+                                               ValidationResult, Top1Accuracy,
+                                               Top5Accuracy, Loss, MAE)
+from bigdl_tpu.optim.regularizer import (Regularizer, L1Regularizer,
+                                         L2Regularizer, L1L2Regularizer)
+from bigdl_tpu.optim.metrics import Metrics
+from bigdl_tpu.optim.optimizer import Optimizer, LocalOptimizer, Checkpoint
+from bigdl_tpu.optim.evaluator import (Evaluator, Validator, LocalValidator,
+                                       DistriValidator, evaluate_dataset)
+from bigdl_tpu.optim.predictor import Predictor
+
+LocalPredictor = Predictor
+
+__all__ = [
+    "OptimMethod", "SGD", "Adagrad", "Adadelta", "Adam", "Adamax", "RMSprop",
+    "LBFGS", "LearningRateSchedule", "Default", "Step", "MultiStep",
+    "EpochStep", "EpochDecay", "Poly", "Exponential", "NaturalExp",
+    "EpochSchedule", "Regime", "Plateau", "Trigger", "every_epoch",
+    "several_iteration", "max_epoch", "max_iteration", "max_score",
+    "min_loss", "ValidationMethod", "ValidationResult", "Top1Accuracy",
+    "Top5Accuracy", "Loss", "MAE", "Regularizer", "L1Regularizer",
+    "L2Regularizer", "L1L2Regularizer", "Metrics", "Optimizer",
+    "LocalOptimizer", "Checkpoint", "Evaluator", "Validator",
+    "LocalValidator", "DistriValidator", "evaluate_dataset", "Predictor",
+    "LocalPredictor",
+]

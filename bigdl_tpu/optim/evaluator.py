@@ -1,0 +1,97 @@
+"""Evaluator / Validator: metric evaluation over a dataset.
+
+Reference equivalents: ``optim/Evaluator.scala:37-74`` (broadcast model,
+mapPartitions forward, metric reduce) and ``optim/Validator.scala`` /
+``DistriValidator.scala:35``.
+
+Here: a jitted eval-mode forward per batch; metric accumulation on host with
+the reference's mergeable-result algebra.  The distributed trainer reuses
+``evaluate_dataset`` per shard and merges results — same reduce shape as the
+reference's ``.reduce(metric +)``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.engine import to_device as _to_device
+from bigdl_tpu.dataset.dataset import AbstractDataSet
+from bigdl_tpu.dataset.sample import MiniBatch, Sample
+from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.optim.validation_method import (ValidationMethod,
+                                               ValidationResult)
+
+
+def _eval_forward(model: Module):
+    """Jitted eval-mode forward, cached on the model so repeated validation
+    triggers / predict calls reuse one compilation (params/state enter as
+    arguments — value changes don't retrace)."""
+    fn = getattr(model, "_eval_jit", None)
+    if fn is None:
+        def fwd(params, mstate, inputs):
+            out, _ = model.apply(params, inputs, mstate, training=False,
+                                 rng=None)
+            return out
+        fn = jax.jit(fwd)
+        model._eval_jit = fn
+    params, mstate = model.params, model.state
+    return lambda inputs: fn(params, mstate, inputs)
+
+
+def evaluate_dataset(model: Module, dataset,
+                     methods: Sequence[ValidationMethod]
+                     ) -> List[Tuple[ValidationMethod, ValidationResult]]:
+    """Run ``methods`` over an eval dataset (MiniBatch stream or Sample
+    stream + batching applied by the caller)."""
+    was_training = model.train_mode
+    model.evaluate()
+    try:
+        fwd = _eval_forward(model)
+        totals: List[ValidationResult] = [None] * len(methods)
+        it = dataset.data(train=False) if isinstance(
+            dataset, AbstractDataSet) else iter(dataset)
+        for batch in it:
+            inputs = _to_device(batch.get_input())
+            targets = batch.get_target()
+            out = np.asarray(fwd(inputs))
+            for i, m in enumerate(methods):
+                r = m.apply(out, targets)
+                totals[i] = r if totals[i] is None else totals[i] + r
+        return [(m, t) for m, t in zip(methods, totals) if t is not None]
+    finally:
+        if was_training:
+            model.training()
+
+
+class Evaluator:
+    """(reference ``optim/Evaluator.scala:37``)."""
+
+    def __init__(self, model: Module):
+        self.model = model
+
+    def test(self, samples: Iterable[Sample],
+             methods: Sequence[ValidationMethod],
+             batch_size: int = 32
+             ) -> List[Tuple[ValidationMethod, ValidationResult]]:
+        batches = SampleToMiniBatch(batch_size)(iter(samples))
+        return evaluate_dataset(self.model, batches, methods)
+
+
+class Validator:
+    """(reference ``optim/Validator.scala``) — over a MiniBatch dataset."""
+
+    def __init__(self, model: Module, dataset):
+        self.model = model
+        self.dataset = dataset
+
+    def test(self, methods: Sequence[ValidationMethod]):
+        return evaluate_dataset(self.model, self.dataset, methods)
+
+
+LocalValidator = Validator
+DistriValidator = Validator

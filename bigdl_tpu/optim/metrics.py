@@ -1,0 +1,53 @@
+"""Metrics: named driver-side counters for phase timing.
+
+Reference equivalent: ``optim/Metrics.scala:31`` — named counters backed by
+Spark accumulators (local / aggregated-distributed / per-node list).  Here a
+process-local dict with the same set/add/get surface; the distributed trainer
+aggregates per-shard values before recording.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Tuple, Union
+
+
+class Metrics:
+    def __init__(self):
+        self._scalar: Dict[str, Tuple[float, int]] = {}   # value, parallelism
+        self._lists: Dict[str, List[float]] = {}
+        self._lock = threading.Lock()
+
+    def set(self, name: str, value: Union[float, List[float]],
+            parallelism: int = 1) -> None:
+        with self._lock:
+            if isinstance(value, (list, tuple)):
+                self._lists[name] = list(value)
+            else:
+                self._scalar[name] = (float(value), parallelism)
+
+    def add(self, name: str, value: float) -> None:
+        with self._lock:
+            if name in self._lists:
+                self._lists[name].append(float(value))
+            elif name in self._scalar:
+                v, p = self._scalar[name]
+                self._scalar[name] = (v + float(value), p)
+            else:
+                self._scalar[name] = (float(value), 1)
+
+    def get(self, name: str):
+        with self._lock:
+            if name in self._scalar:
+                v, p = self._scalar[name]
+                return v / p
+            if name in self._lists:
+                return list(self._lists[name])
+            raise KeyError(name)
+
+    def summary(self, unit: str = "s", scale: float = 1e9) -> str:
+        with self._lock:
+            parts = [f"{k}: {v / p / scale} {unit}"
+                     for k, (v, p) in self._scalar.items()]
+        return "========== Metrics Summary ==========\n" + \
+            "\n".join(parts) + "\n====================================="

@@ -1,0 +1,666 @@
+"""OptimMethod: gradient-descent rules as pure pytree updates.
+
+Reference equivalent: ``optim/OptimMethod.scala`` + SGD/Adagrad/Adadelta/Adam/
+Adamax/RMSprop/LBFGS — torch-optim ports mutating a flattened (weight, grad)
+pair with a serializable state Table.
+
+TPU-native design: every method is split into
+- ``init_slots(params)`` — per-parameter slot pytrees (momentum, variance, …);
+- ``pure_update(grads, params, slots, hyper) -> (new_params, new_slots)`` —
+  a PURE array function.  ``hyper`` is a dict of *dynamic scalars* (lr, step
+  count) computed host-side per iteration, passed as arguments so the jitted
+  training step never retraces as the schedule decays the rate.  Branch-free
+  (``jnp.where`` instead of first-step flags) so it traces cleanly and runs
+  identically inside ``shard_map`` — which is how the ZeRO-1-style sharded
+  update (reference ``optim/DistriOptimizer.scala:265-280``) is expressed.
+- a stateful shell (``optimize(feval, x)``, ``update(grads, params)``)
+  keeping the reference's API and state-dict conventions (``evalCounter``,
+  ``epoch``, negated ``clr``).
+
+Hyper-parameters follow the reference's names and defaults
+(``optim/SGD.scala:38``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Any
+
+
+def _tmap(f, *trees):
+    return jax.tree_util.tree_map(f, *trees)
+
+
+class OptimMethod:
+    """Base class.  ``state`` is a plain dict (the reference's state Table)."""
+
+    def __init__(self):
+        self.state: Dict[str, Any] = {"evalCounter": 0, "epoch": 1}
+        self._slots = None
+
+    # ---- pure core ------------------------------------------------------
+
+    def init_slots(self, params: Params):
+        return {}
+
+    def hyper(self) -> Dict[str, float]:
+        """Dynamic scalars for this step, computed host-side."""
+        return {"t": float(self.state.get("evalCounter", 0))}
+
+    def pure_update(self, grads: Params, params: Params, slots,
+                    hyper: Dict[str, jnp.ndarray]) -> Tuple[Params, Any]:
+        raise NotImplementedError(type(self).__name__)
+
+    # ---- stateful shell -------------------------------------------------
+
+    def slots(self, params: Params):
+        if self._slots is None:
+            self._slots = self.init_slots(params)
+        return self._slots
+
+    def set_slots(self, slots) -> None:
+        self._slots = slots
+
+    def step_done(self) -> None:
+        """Advance host counters after a step."""
+        self.state["evalCounter"] = self.state.get("evalCounter", 0) + 1
+
+    def update(self, grads: Params, params: Params) -> Params:
+        """Host-driven single update (non-jit convenience path)."""
+        h = self.hyper()
+        new_params, self._slots = self.pure_update(
+            grads, params, self.slots(params), h)
+        self.step_done()
+        return new_params
+
+    def optimize(self, feval: Callable[[Params], Tuple[jnp.ndarray, Params]],
+                 params: Params) -> Tuple[Params, Tuple[jnp.ndarray, ...]]:
+        """One step: ``feval`` returns (loss, grads) at ``params``
+        (reference ``OptimMethod.optimize``)."""
+        loss, grads = feval(params)
+        return self.update(grads, params), (loss,)
+
+    def get_hyper_parameter(self) -> str:
+        clr = self.state.get("clr")
+        return f"Current learning rate is {-clr}. " if clr is not None else ""
+
+    def get_learning_rate(self) -> float:
+        return -float(self.state.get("clr", 0.0))
+
+    def clear_history(self) -> None:
+        self.state = {"evalCounter": 0, "epoch": 1}
+        self._slots = None
+
+    def save(self, path: str, overwrite: bool = True) -> "OptimMethod":
+        from bigdl_tpu.utils import file_io
+        file_io.save(self, path, overwrite)
+        return self
+
+    @staticmethod
+    def load(path: str) -> "OptimMethod":
+        from bigdl_tpu.utils import file_io
+        return file_io.load(path)
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        if d.get("_slots") is not None:
+            d["_slots"] = jax.tree_util.tree_map(np.asarray, d["_slots"])
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        if getattr(self, "_slots", None) is not None:
+            self._slots = jax.tree_util.tree_map(jnp.asarray, self._slots)
+
+
+# ---------------------------------------------------------------------------
+# learning-rate schedules (reference optim/SGD.scala:198-560)
+# ---------------------------------------------------------------------------
+
+class LearningRateSchedule:
+    """Computes the current rate from the optimizer's host state and stores
+    the negated value in ``state["clr"]`` (the reference's convention, so
+    hyper-parameter log lines match)."""
+
+    def update_hyper_parameter(self, optim: "SGD") -> None:
+        raise NotImplementedError
+
+
+class Default(LearningRateSchedule):
+    """lr / (1 + nevals * lrd) (reference ``SGD.Default``)."""
+
+    def update_hyper_parameter(self, optim: "SGD") -> None:
+        n = optim.state["evalCounter"]
+        optim.state["clr"] = -optim.learning_rate / (
+            1 + n * optim.learning_rate_decay)
+
+
+class Step(LearningRateSchedule):
+    """lr * gamma^(floor(nevals / step_size)) (reference ``SGD.Step:316``)."""
+
+    def __init__(self, step_size: int, gamma: float):
+        self.step_size = step_size
+        self.gamma = gamma
+
+    def update_hyper_parameter(self, optim: "SGD") -> None:
+        n = optim.state["evalCounter"]
+        optim.state["clr"] = -optim.learning_rate * (
+            self.gamma ** (n // self.step_size))
+
+
+class MultiStep(LearningRateSchedule):
+    """(reference ``SGD.MultiStep:349``)."""
+
+    def __init__(self, step_sizes, gamma: float):
+        self.step_sizes = list(step_sizes)
+        self.gamma = gamma
+
+    def update_hyper_parameter(self, optim: "SGD") -> None:
+        n = optim.state["evalCounter"]
+        k = sum(1 for s in self.step_sizes if n >= s)
+        optim.state["clr"] = -optim.learning_rate * (self.gamma ** k)
+
+
+class EpochStep(LearningRateSchedule):
+    """lr * gamma^(floor((epoch-1)/step)) (reference ``SGD.EpochStep:412``)."""
+
+    def __init__(self, step_size: int, gamma: float):
+        self.step_size = step_size
+        self.gamma = gamma
+
+    def update_hyper_parameter(self, optim: "SGD") -> None:
+        epoch = optim.state.get("epoch", 1)
+        optim.state["clr"] = -optim.learning_rate * (
+            self.gamma ** ((epoch - 1) // self.step_size))
+
+
+class EpochDecay(LearningRateSchedule):
+    """lr * 0.1^decayFn(epoch) (reference ``SGD.EpochDecay:385``)."""
+
+    def __init__(self, decay_fn: Callable[[int], float]):
+        self.decay_fn = decay_fn
+
+    def update_hyper_parameter(self, optim: "SGD") -> None:
+        epoch = optim.state.get("epoch", 1)
+        optim.state["clr"] = -optim.learning_rate * (
+            0.1 ** self.decay_fn(epoch))
+
+
+class Poly(LearningRateSchedule):
+    """lr * (1 - iter/max)^power (reference ``SGD.Poly:281``)."""
+
+    def __init__(self, power: float, max_iteration: int):
+        self.power = power
+        self.max_iteration = max_iteration
+
+    def update_hyper_parameter(self, optim: "SGD") -> None:
+        n = optim.state["evalCounter"]
+        if n > self.max_iteration:
+            optim.state["clr"] = 0.0
+        else:
+            optim.state["clr"] = -optim.learning_rate * (
+                (1.0 - n / self.max_iteration) ** self.power)
+
+
+class Exponential(LearningRateSchedule):
+    """lr * gamma^(iter/decayStep), optionally staircased
+    (reference ``SGD.Exponential:467``)."""
+
+    def __init__(self, decay_step: int, decay_rate: float,
+                 stair_case: bool = False):
+        self.decay_step = decay_step
+        self.decay_rate = decay_rate
+        self.stair_case = stair_case
+
+    def update_hyper_parameter(self, optim: "SGD") -> None:
+        n = optim.state["evalCounter"]
+        p = n / self.decay_step
+        if self.stair_case:
+            p = float(int(p))
+        optim.state["clr"] = -optim.learning_rate * (self.decay_rate ** p)
+
+
+class NaturalExp(LearningRateSchedule):
+    """lr * exp(-gamma * floor(iter/decayStep))
+    (reference ``SGD.NaturalExp:446``)."""
+
+    def __init__(self, decay_step: int, gamma: float):
+        self.decay_step = decay_step
+        self.gamma = gamma
+
+    def update_hyper_parameter(self, optim: "SGD") -> None:
+        n = optim.state["evalCounter"]
+        optim.state["clr"] = -optim.learning_rate * float(
+            np.exp(-self.gamma * (n // self.decay_step)))
+
+
+class Regime:
+    """(startEpoch, endEpoch, config) (reference ``SGD.Regime``)."""
+
+    def __init__(self, start_epoch: int, end_epoch: int,
+                 config: Dict[str, Any]):
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.config = config
+
+
+class EpochSchedule(LearningRateSchedule):
+    """Per-epoch-range hyper-parameter regimes
+    (reference ``SGD.EpochSchedule:224``)."""
+
+    def __init__(self, regimes):
+        self.regimes = list(regimes)
+
+    def update_hyper_parameter(self, optim: "SGD") -> None:
+        epoch = optim.state.get("epoch", 1)
+        for r in self.regimes:
+            if r.start_epoch <= epoch <= r.end_epoch:
+                for k, v in r.config.items():
+                    setattr(optim, k, v)
+        optim.state["clr"] = -optim.learning_rate
+
+
+class Plateau(LearningRateSchedule):
+    """Reduce on metric plateau (reference ``SGD.Plateau:534``)."""
+
+    def __init__(self, monitor: str = "score", factor: float = 0.1,
+                 patience: int = 10, mode: str = "min", epsilon: float = 1e-4,
+                 cooldown: int = 0, min_lr: float = 0.0):
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.mode = mode
+        self.epsilon = epsilon
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self._wait = 0
+        self._cooldown_counter = 0
+        self._best: Optional[float] = None
+        self._current_lr: Optional[float] = None
+
+    def _is_better(self, cur: float, best: float) -> bool:
+        if self.mode == "min":
+            return cur < best - self.epsilon
+        return cur > best + self.epsilon
+
+    def update_hyper_parameter(self, optim: "SGD") -> None:
+        if self._current_lr is None:
+            self._current_lr = optim.learning_rate
+        metric = optim.state.get(self.monitor)
+        if metric is not None:
+            if self._best is None or self._is_better(metric, self._best):
+                self._best = metric
+                self._wait = 0
+            elif self._cooldown_counter > 0:
+                self._cooldown_counter -= 1
+                self._wait = 0
+            else:
+                self._wait += 1
+                if self._wait >= self.patience:
+                    self._current_lr = max(self._current_lr * self.factor,
+                                           self.min_lr)
+                    self._cooldown_counter = self.cooldown
+                    self._wait = 0
+        optim.state["clr"] = -self._current_lr
+
+
+# ---------------------------------------------------------------------------
+# concrete methods
+# ---------------------------------------------------------------------------
+
+class SGD(OptimMethod):
+    """SGD with momentum/dampening/nesterov/weight-decay and pluggable LR
+    schedules (reference ``optim/SGD.scala:38``)."""
+
+    def __init__(self, learning_rate: float = 1e-3,
+                 learning_rate_decay: float = 0.0,
+                 weight_decay: float = 0.0, momentum: float = 0.0,
+                 dampening: Optional[float] = None, nesterov: bool = False,
+                 learning_rate_schedule: Optional[LearningRateSchedule] = None):
+        super().__init__()
+        if dampening is None:
+            dampening = momentum if not nesterov else 0.0
+        if nesterov and (momentum <= 0 or dampening != 0):
+            raise ValueError(
+                "Nesterov momentum requires momentum > 0 and dampening = 0 "
+                "(reference SGD.scala requirement)")
+        self.learning_rate = learning_rate
+        self.learning_rate_decay = learning_rate_decay
+        self.weight_decay = weight_decay
+        self.momentum = momentum
+        self.dampening = dampening
+        self.nesterov = nesterov
+        self.schedule = learning_rate_schedule or Default()
+
+    def init_slots(self, params):
+        if self.momentum > 0:
+            return {"dfdx": _tmap(jnp.zeros_like, params)}
+        return {}
+
+    def hyper(self):
+        self.schedule.update_hyper_parameter(self)
+        return {"lr": -self.state["clr"],
+                "t": float(self.state.get("evalCounter", 0))}
+
+    def pure_update(self, grads, params, slots, hyper):
+        lr, t = hyper["lr"], hyper["t"]
+        wd, mom, damp = self.weight_decay, self.momentum, self.dampening
+        if wd != 0:
+            grads = _tmap(lambda g, p: g + wd * p, grads, params)
+        if mom > 0:
+            # first step: v = g (torch convention); branch-free via where
+            dfdx = _tmap(
+                lambda v, g: jnp.where(t == 0, g, v * mom + (1 - damp) * g),
+                slots["dfdx"], grads)
+            slots = {"dfdx": dfdx}
+            if self.nesterov:
+                grads = _tmap(lambda g, v: g + mom * v, grads, dfdx)
+            else:
+                grads = dfdx
+        new_params = _tmap(lambda p, g: p - lr * g, params, grads)
+        return new_params, slots
+
+
+class Adagrad(OptimMethod):
+    """(reference ``optim/Adagrad.scala``)."""
+
+    def __init__(self, learning_rate: float = 1e-3,
+                 learning_rate_decay: float = 0.0,
+                 weight_decay: float = 0.0):
+        super().__init__()
+        self.learning_rate = learning_rate
+        self.learning_rate_decay = learning_rate_decay
+        self.weight_decay = weight_decay
+
+    def init_slots(self, params):
+        return {"var": _tmap(jnp.zeros_like, params)}
+
+    def hyper(self):
+        n = self.state.get("evalCounter", 0)
+        clr = self.learning_rate / (1 + n * self.learning_rate_decay)
+        self.state["clr"] = -clr
+        return {"lr": clr, "t": float(n)}
+
+    def pure_update(self, grads, params, slots, hyper):
+        lr = hyper["lr"]
+        if self.weight_decay != 0:
+            grads = _tmap(lambda g, p: g + self.weight_decay * p,
+                          grads, params)
+        var = _tmap(lambda v, g: v + g * g, slots["var"], grads)
+        new_params = _tmap(
+            lambda p, g, v: p - lr * g / (jnp.sqrt(v) + 1e-10),
+            params, grads, var)
+        return new_params, {"var": var}
+
+
+class Adadelta(OptimMethod):
+    """(reference ``optim/Adadelta.scala``; decayRate=0.9, epsilon=1e-10)."""
+
+    def __init__(self, decay_rate: float = 0.9, epsilon: float = 1e-10):
+        super().__init__()
+        self.decay_rate = decay_rate
+        self.epsilon = epsilon
+
+    def init_slots(self, params):
+        return {"paramVariance": _tmap(jnp.zeros_like, params),
+                "delta": _tmap(jnp.zeros_like, params)}
+
+    def pure_update(self, grads, params, slots, hyper):
+        rho, eps = self.decay_rate, self.epsilon
+        var = _tmap(lambda v, g: v * rho + (1 - rho) * g * g,
+                    slots["paramVariance"], grads)
+        upd = _tmap(
+            lambda d, v, g: jnp.sqrt(d + eps) / jnp.sqrt(v + eps) * g,
+            slots["delta"], var, grads)
+        delta = _tmap(lambda d, u: d * rho + (1 - rho) * u * u,
+                      slots["delta"], upd)
+        new_params = _tmap(lambda p, u: p - u, params, upd)
+        return new_params, {"paramVariance": var, "delta": delta}
+
+
+class Adam(OptimMethod):
+    """(reference ``optim/Adam.scala``; lr=1e-3, beta1=0.9, beta2=0.999,
+    eps=1e-8, bias-corrected)."""
+
+    def __init__(self, learning_rate: float = 1e-3,
+                 learning_rate_decay: float = 0.0,
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 epsilon: float = 1e-8):
+        super().__init__()
+        self.learning_rate = learning_rate
+        self.learning_rate_decay = learning_rate_decay
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def init_slots(self, params):
+        return {"s": _tmap(jnp.zeros_like, params),
+                "r": _tmap(jnp.zeros_like, params)}
+
+    def hyper(self):
+        n = self.state.get("evalCounter", 0)
+        clr = self.learning_rate / (1 + n * self.learning_rate_decay)
+        self.state["clr"] = -clr
+        return {"lr": clr, "t": float(n + 1)}
+
+    def pure_update(self, grads, params, slots, hyper):
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+        lr, t = hyper["lr"], hyper["t"]
+        s = _tmap(lambda m, g: b1 * m + (1 - b1) * g, slots["s"], grads)
+        r = _tmap(lambda v, g: b2 * v + (1 - b2) * g * g, slots["r"], grads)
+        bc1 = 1 - b1 ** t
+        bc2 = 1 - b2 ** t
+        new_params = _tmap(
+            lambda p, m, v: p - lr * (m / bc1) / (jnp.sqrt(v / bc2) + eps),
+            params, s, r)
+        return new_params, {"s": s, "r": r}
+
+
+class Adamax(OptimMethod):
+    """(reference ``optim/Adamax.scala``; lr=2e-3)."""
+
+    def __init__(self, learning_rate: float = 2e-3, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-38):
+        super().__init__()
+        self.learning_rate = learning_rate
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def init_slots(self, params):
+        return {"m": _tmap(jnp.zeros_like, params),
+                "u": _tmap(jnp.zeros_like, params)}
+
+    def hyper(self):
+        n = self.state.get("evalCounter", 0)
+        return {"lr": self.learning_rate, "t": float(n + 1)}
+
+    def pure_update(self, grads, params, slots, hyper):
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+        lr, t = hyper["lr"], hyper["t"]
+        m = _tmap(lambda m_, g: b1 * m_ + (1 - b1) * g, slots["m"], grads)
+        u = _tmap(lambda u_, g: jnp.maximum(b2 * u_, jnp.abs(g) + eps),
+                  slots["u"], grads)
+        clr = lr / (1 - b1 ** t)
+        new_params = _tmap(lambda p, m_, u_: p - clr * m_ / u_, params, m, u)
+        return new_params, {"m": m, "u": u}
+
+
+class RMSprop(OptimMethod):
+    """(reference ``optim/RMSprop.scala``; lr=1e-2, decayRate=0.99)."""
+
+    def __init__(self, learning_rate: float = 1e-2,
+                 learning_rate_decay: float = 0.0,
+                 decay_rate: float = 0.99, epsilon: float = 1e-8):
+        super().__init__()
+        self.learning_rate = learning_rate
+        self.learning_rate_decay = learning_rate_decay
+        self.decay_rate = decay_rate
+        self.epsilon = epsilon
+
+    def init_slots(self, params):
+        return {"sumSquare": _tmap(jnp.zeros_like, params)}
+
+    def hyper(self):
+        n = self.state.get("evalCounter", 0)
+        clr = self.learning_rate / (1 + n * self.learning_rate_decay)
+        self.state["clr"] = -clr
+        return {"lr": clr, "t": float(n)}
+
+    def pure_update(self, grads, params, slots, hyper):
+        rho, eps = self.decay_rate, self.epsilon
+        lr = hyper["lr"]
+        r = _tmap(lambda v, g: rho * v + (1 - rho) * g * g,
+                  slots["sumSquare"], grads)
+        new_params = _tmap(
+            lambda p, g, v: p - lr * g / (jnp.sqrt(v) + eps),
+            params, grads, r)
+        return new_params, {"sumSquare": r}
+
+
+class LBFGS(OptimMethod):
+    """Limited-memory BFGS with optional strong-Wolfe line search
+    (reference ``optim/LBFGS.scala``; inherently sequential — host-driven,
+    operating on the flattened parameter vector like the reference)."""
+
+    def __init__(self, max_iter: int = 20, max_eval: Optional[float] = None,
+                 tolerance_fun: float = 1e-5, tolerance_x: float = 1e-9,
+                 n_correction: int = 100, learning_rate: float = 1.0,
+                 line_search: bool = False):
+        super().__init__()
+        self.max_iter = max_iter
+        self.max_eval = max_eval if max_eval is not None else max_iter * 1.25
+        self.tolerance_fun = tolerance_fun
+        self.tolerance_x = tolerance_x
+        self.n_correction = n_correction
+        self.learning_rate = learning_rate
+        self.line_search = line_search
+
+    def pure_update(self, grads, params, slots, hyper):
+        raise NotImplementedError(
+            "LBFGS needs re-evaluation inside the step; use optimize(feval, x)")
+
+    def optimize(self, feval, x):
+        """Multi-evaluation inner loop per optimize() call (torch lbfgs
+        semantics).  ``x`` may be any pytree; flattened internally."""
+        leaves, treedef = jax.tree_util.tree_flatten(x)
+        shapes = [l.shape for l in leaves]
+
+        def to_flat(t):
+            ls = jax.tree_util.tree_leaves(t)
+            return jnp.concatenate([jnp.ravel(l) for l in ls])
+
+        def from_flat(vec):
+            out, off = [], 0
+            for shp in shapes:
+                n = int(np.prod(shp)) if shp else 1
+                out.append(jnp.reshape(vec[off:off + n], shp))
+                off += n
+            return jax.tree_util.tree_unflatten(treedef, out)
+
+        def feval_flat(vec):
+            loss, g = feval(from_flat(vec))
+            return float(loss), to_flat(g)
+
+        f, g = feval_flat(to_flat(x))
+        xv = to_flat(x)
+        losses = [f]
+        n_eval = 1
+
+        old_dirs = self.state.setdefault("old_dirs", [])
+        old_stps = self.state.setdefault("old_stps", [])
+        hdiag = self.state.get("Hdiag", 1.0)
+        prev_g = self.state.get("prev_g")
+        prev_loss = self.state.get("prev_loss", f)
+
+        for _ in range(self.max_iter):
+            if float(jnp.abs(g).max()) <= 1e-10:
+                break
+            if prev_g is not None and "prev_step" in self.state:
+                y = g - prev_g
+                s = self.state["prev_step"]
+                ys = float(y @ s)
+                if ys > 1e-10:
+                    if len(old_dirs) == self.n_correction:
+                        old_dirs.pop(0)
+                        old_stps.pop(0)
+                    old_dirs.append(s)
+                    old_stps.append(y)
+                    hdiag = ys / float(y @ y)
+            # L-BFGS two-loop recursion
+            k = len(old_dirs)
+            ro = [1.0 / float(old_stps[i] @ old_dirs[i]) for i in range(k)]
+            al = [0.0] * k
+            q = -g
+            for i in range(k - 1, -1, -1):
+                al[i] = float(old_dirs[i] @ q) * ro[i]
+                q = q - al[i] * old_stps[i]
+            d = q * hdiag
+            for i in range(k):
+                be = float(old_stps[i] @ d) * ro[i]
+                d = d + old_dirs[i] * (al[i] - be)
+
+            gtd = float(g @ d)
+            if gtd > -self.tolerance_x:
+                break
+            if prev_g is None:
+                t = min(1.0, 1.0 / float(jnp.abs(g).sum())) * self.learning_rate
+            else:
+                t = self.learning_rate
+
+            prev_g = g
+            self.state["prev_g"] = g
+            if self.line_search:
+                t, f, g, xv, ls_evals = _lswolfe(feval_flat, xv, t, d, f, g,
+                                                 gtd)
+                n_eval += ls_evals
+            else:
+                xv = xv + t * d
+                f, g = feval_flat(xv)
+                n_eval += 1
+            losses.append(f)
+            self.state["prev_step"] = t * d
+
+            if n_eval >= self.max_eval:
+                break
+            if abs(losses[-1] - prev_loss) < self.tolerance_fun:
+                break
+            prev_loss = losses[-1]
+            self.state["prev_loss"] = prev_loss
+
+        self.state["Hdiag"] = hdiag
+        self.state["evalCounter"] = self.state.get("evalCounter", 0) + 1
+        return from_flat(xv), tuple(losses)
+
+
+def _lswolfe(feval_flat, xv, t, d, f, g, gtd,
+             c1: float = 1e-4, c2: float = 0.9, max_ls: int = 25):
+    """Backtracking/extending strong-Wolfe line search (torch lswolfe analog,
+    simplified bracketing).  Returns (t, f, g, x) all evaluated at the SAME
+    point ``xv + t*d`` so the caller's curvature pair stays consistent."""
+    f0, gtd0 = f, gtd
+    evals = 0
+    f_prev = f
+    # best-so-far evaluated point (step, loss, gradient)
+    t_eval, f_eval, g_eval = 0.0, f, g
+    for _ in range(max_ls):
+        f_new, g_new = feval_flat(xv + t * d)
+        evals += 1
+        t_eval, f_eval, g_eval = t, f_new, g_new
+        gtd_new = float(g_new @ d)
+        if f_new > f0 + c1 * t * gtd0 or (evals > 1 and f_new >= f_prev):
+            t = t * 0.5
+            continue
+        if abs(gtd_new) <= -c2 * gtd0:
+            break
+        if gtd_new >= 0:
+            t = t * 0.5
+            continue
+        f_prev = f_new
+        t = t * 2.0
+    return t_eval, f_eval, g_eval, xv + t_eval * d, evals

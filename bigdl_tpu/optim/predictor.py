@@ -1,0 +1,55 @@
+"""Predictor: inference over sample collections.
+
+Reference equivalents: ``optim/Predictor.scala:34`` / ``LocalPredictor.scala:37``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+import numpy as np
+
+from bigdl_tpu.dataset.dataset import AbstractDataSet
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.optim.evaluator import _eval_forward, _to_device
+
+
+class Predictor:
+    def __init__(self, model: Module):
+        self.model = model
+
+    def _batches(self, dataset, batch_size: int):
+        if isinstance(dataset, AbstractDataSet):
+            it = dataset.data(train=False)
+        else:
+            it = iter(dataset)
+        first = next(it, None)
+        if first is None:
+            return
+        import itertools
+        it = itertools.chain([first], it)
+        if isinstance(first, Sample):
+            yield from SampleToMiniBatch(batch_size)(it)
+        else:
+            yield from it
+
+    def predict(self, dataset, batch_size: int = 32) -> np.ndarray:
+        """Per-sample model outputs (reference ``predict``)."""
+        was_training = self.model.train_mode
+        self.model.evaluate()
+        try:
+            fwd = _eval_forward(self.model)
+            outs: List[np.ndarray] = []
+            for batch in self._batches(dataset, batch_size):
+                outs.append(np.asarray(fwd(_to_device(batch.get_input()))))
+            return np.concatenate(outs, axis=0)
+        finally:
+            if was_training:
+                self.model.training()
+
+    def predict_class(self, dataset, batch_size: int = 32) -> np.ndarray:
+        """1-based argmax class ids (reference ``predictClass``)."""
+        out = self.predict(dataset, batch_size)
+        return out.argmax(axis=-1) + 1

@@ -1,0 +1,43 @@
+"""Regularizers: L1/L2/L1L2 penalties added to gradients per layer.
+
+Reference equivalent: ``optim/Regularizer.scala:87,175,186`` — the reference
+mutates gradients in ``accGradParameters``; here regularizers contribute a
+pure penalty term that the training-loss builder adds to the loss, so the
+gradient contribution appears through autodiff (mathematically identical for
+L2; for L1 the subgradient at 0 matches the reference's sign() convention).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class Regularizer:
+    def __init__(self, l1: float = 0.0, l2: float = 0.0):
+        self.l1 = l1
+        self.l2 = l2
+
+    def penalty(self, params) -> jnp.ndarray:
+        leaves = jax.tree_util.tree_leaves(params)
+        total = jnp.zeros(())
+        for p in leaves:
+            if self.l1:
+                total = total + self.l1 * jnp.abs(p).sum()
+            if self.l2:
+                total = total + 0.5 * self.l2 * (p * p).sum()
+        return total
+
+
+class L1Regularizer(Regularizer):
+    def __init__(self, l1: float):
+        super().__init__(l1=l1)
+
+
+class L2Regularizer(Regularizer):
+    def __init__(self, l2: float):
+        super().__init__(l2=l2)
+
+
+class L1L2Regularizer(Regularizer):
+    pass

@@ -1,0 +1,25 @@
+"""bigdl_tpu.utils — Table, persistence, RNG, DAG, misc helpers.
+
+Mirrors the reference's ``com.intel.analytics.bigdl.utils`` (SURVEY §2.2),
+minus the thread-pool machinery (XLA owns intra-op parallelism on TPU).
+"""
+
+from bigdl_tpu.utils.table import Table, T
+from bigdl_tpu.utils import file_io
+from bigdl_tpu.utils.file_io import save, load
+from bigdl_tpu.utils.random_generator import RandomGenerator
+from bigdl_tpu.utils.directed_graph import DirectedGraph, Node, Edge
+
+
+def kth_largest(arr, k: int):
+    """k-th largest element (1-based k) — straggler threshold helper
+    (reference ``utils/Util.scala:20`` quickselect)."""
+    import numpy as np
+    a = np.asarray(arr)
+    if not (1 <= k <= a.size):
+        raise ValueError(f"k={k} out of range for size {a.size}")
+    return np.partition(a, a.size - k)[a.size - k]
+
+
+__all__ = ["Table", "T", "file_io", "save", "load", "RandomGenerator",
+           "DirectedGraph", "Node", "Edge", "kth_largest"]

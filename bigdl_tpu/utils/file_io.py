@@ -1,0 +1,59 @@
+"""Persistence: save/load of modules, optim methods, and arbitrary objects.
+
+Reference equivalent: ``utils/File.scala:25`` — java-serialization to
+local/HDFS/S3 paths.  Here: pickle to local paths (HDFS/S3 support is gated on
+optional deps; fsspec-style schemes raise a clear error when unavailable —
+this image is egress-free, so remote filesystems cannot be exercised anyway).
+
+Checkpoint layout matches the reference protocol
+(``optim/DistriOptimizer.scala:394-416``): ``model.<neval>`` /
+``optimMethod.<neval>`` files in a checkpoint directory.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+from typing import Any
+
+
+def _check_scheme(path: str) -> str:
+    if path.startswith(("hdfs://", "s3://", "s3a://", "s3n://")):
+        raise NotImplementedError(
+            f"remote filesystem scheme in {path!r}: HDFS/S3 persistence "
+            "requires the corresponding filesystem client which is not "
+            "available in this environment (reference: utils/File.scala:106)")
+    if path.startswith("file://"):
+        path = path[len("file://"):]
+    return path
+
+
+def save(obj: Any, path: str, overwrite: bool = True) -> None:
+    """Serialize ``obj`` to ``path`` (reference ``File.save:67``).
+
+    Writes atomically: temp file in the same directory, then rename.
+    """
+    path = _check_scheme(path)
+    if os.path.exists(path) and not overwrite:
+        raise FileExistsError(
+            f"{path} already exists and overwrite is False "
+            "(reference File.scala overWrite check)")
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp_bigdl_")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            pickle.dump(obj, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load(path: str) -> Any:
+    """Deserialize from ``path`` (reference ``File.load:162``)."""
+    path = _check_scheme(path)
+    with open(path, "rb") as f:
+        return pickle.load(f)

@@ -1,0 +1,68 @@
+"""RandomGenerator: seedable host-side RNG for data pipelines and init.
+
+Reference equivalent: ``utils/RandomGenerator.scala:23`` — a hand-written,
+thread-local Mersenne Twister used for init and data augmentation.
+
+TPU-native split: *device-side* randomness (Dropout masks, RReLU slopes) uses
+jax's counter-based PRNG keys threaded through ``Module.apply`` — reproducible
+under jit and across shardings, which a stateful MT could never be.
+*Host-side* randomness (shuffles, crops, jitter in the numpy data pipeline)
+uses this class: numpy's MT19937, same algorithm family as the reference, one
+instance per thread.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+
+class RandomGenerator:
+    """Thread-local seedable generator (mirrors reference RNG surface)."""
+
+    _tls = threading.local()
+
+    def __init__(self, seed: int = 5489):  # 5489 = MT19937 default, as in Torch
+        self._seed = seed
+        self._rng = np.random.RandomState(seed)
+
+    @classmethod
+    def RNG(cls) -> "RandomGenerator":
+        """The thread-local instance (reference ``RandomGenerator.RNG``)."""
+        inst = getattr(cls._tls, "inst", None)
+        if inst is None:
+            inst = cls()
+            cls._tls.inst = inst
+        return inst
+
+    def set_seed(self, seed: int) -> "RandomGenerator":
+        self._seed = seed
+        self._rng = np.random.RandomState(seed)
+        return self
+
+    def get_seed(self) -> int:
+        return self._seed
+
+    @property
+    def np(self) -> np.random.RandomState:
+        return self._rng
+
+    def uniform(self, a: float = 0.0, b: float = 1.0) -> float:
+        return float(self._rng.uniform(a, b))
+
+    def normal(self, mean: float = 0.0, stdv: float = 1.0) -> float:
+        return float(self._rng.normal(mean, stdv))
+
+    def bernoulli(self, p: float = 0.5) -> bool:
+        return bool(self._rng.uniform() <= p)
+
+    def random_int(self, low: int, high: int) -> int:
+        """Inclusive-exclusive [low, high)."""
+        return int(self._rng.randint(low, high))
+
+    def permutation(self, n: int) -> np.ndarray:
+        return self._rng.permutation(n)
+
+    def shuffle(self, arr) -> None:
+        self._rng.shuffle(arr)

@@ -1,0 +1,152 @@
+"""Table: the Lua-style heterogeneous container.
+
+Reference equivalent: ``utils/Table.scala:34`` — an int/any-keyed map used both
+as an Activity (multi-input/output of layers) and as the optimizer state dict,
+with a ``T(...)`` varargs constructor that auto-assigns 1-based integer keys.
+
+In the TPU rebuild, layer activities are plain nested lists/tuples/dicts of jax
+arrays (pytrees — XLA-friendly and jit-transparent), so Table is NOT on the hot
+path.  It survives as the user-facing optimizer-state / multi-value container
+for API parity: 1-based integer keys, ``insert``/``remove`` with Lua shifting
+semantics, and pytree registration so a Table can still cross a jit boundary.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, Optional
+
+import jax
+
+
+class Table:
+    """Int/any-keyed map with Lua array semantics (reference ``utils/Table``)."""
+
+    def __init__(self, state: Optional[Dict[Any, Any]] = None):
+        self._state: Dict[Any, Any] = dict(state) if state else {}
+
+    # -- map interface ----------------------------------------------------
+
+    def __getitem__(self, key):
+        return self._state[key]
+
+    def get(self, key, default=None):
+        return self._state.get(key, default)
+
+    def __setitem__(self, key, value):
+        self._state[key] = value
+
+    def __delitem__(self, key):
+        del self._state[key]
+
+    def __contains__(self, key) -> bool:
+        return key in self._state
+
+    def contains(self, key) -> bool:
+        return key in self._state
+
+    def get_or_update(self, key, factory):
+        if key not in self._state:
+            self._state[key] = factory()
+        return self._state[key]
+
+    def update(self, other) -> "Table":
+        src = other._state if isinstance(other, Table) else other
+        self._state.update(src)
+        return self
+
+    def keys(self):
+        return self._state.keys()
+
+    def values(self):
+        return self._state.values()
+
+    def items(self):
+        return self._state.items()
+
+    def __iter__(self) -> Iterator:
+        return iter(self._state)
+
+    def __len__(self) -> int:
+        return len(self._state)
+
+    # -- Lua array semantics ----------------------------------------------
+
+    def length(self) -> int:
+        """Count of the contiguous 1..n integer-key prefix (Lua ``#t``)."""
+        n = 0
+        while (n + 1) in self._state:
+            n += 1
+        return n
+
+    def insert(self, *args) -> "Table":
+        """``insert(value)`` appends; ``insert(index, value)`` shifts right
+        (reference ``Table.insert``)."""
+        if len(args) == 1:
+            self._state[self.length() + 1] = args[0]
+        else:
+            index, value = args
+            n = self.length()
+            for i in range(n, index - 1, -1):
+                self._state[i + 1] = self._state[i]
+            self._state[index] = value
+        return self
+
+    def remove(self, index: Optional[int] = None):
+        """Remove at index (default: last), shifting left."""
+        n = self.length()
+        if index is None:
+            index = n
+        if index == 0 or index > n and index not in self._state:
+            return None
+        value = self._state.pop(index, None)
+        for i in range(index + 1, n + 1):
+            self._state[i - 1] = self._state.pop(i)
+        return value
+
+    # -- misc -------------------------------------------------------------
+
+    def clone(self) -> "Table":
+        return Table(dict(self._state))
+
+    def to_dict(self) -> Dict[Any, Any]:
+        return dict(self._state)
+
+    def to_seq(self):
+        """The 1..n prefix as a list (reference ``toSeq``)."""
+        return [self._state[i] for i in range(1, self.length() + 1)]
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, Table):
+            return self._state == other._state
+        return NotImplemented
+
+    def __hash__(self):
+        return id(self)
+
+    def __repr__(self) -> str:
+        inner = ", ".join(f"{k}: {v!r}" for k, v in sorted(
+            self._state.items(), key=lambda kv: str(kv[0])))
+        return f"T{{{inner}}}"
+
+
+def T(*args, **kwargs) -> Table:
+    """Varargs constructor: ``T(a, b)`` → {1: a, 2: b}; kwargs become string
+    keys (reference ``object T``, ``utils/Table.scala:269``)."""
+    t = Table()
+    for i, v in enumerate(args, start=1):
+        t[i] = v
+    for k, v in kwargs.items():
+        t[k] = v
+    return t
+
+
+def _table_flatten(t: Table):
+    keys = sorted(t._state.keys(), key=lambda k: (str(type(k)), str(k)))
+    return [t._state[k] for k in keys], tuple(keys)
+
+
+def _table_unflatten(keys, values) -> Table:
+    return Table(dict(zip(keys, values)))
+
+
+jax.tree_util.register_pytree_node(Table, _table_flatten, _table_unflatten)

@@ -1,0 +1,189 @@
+"""Tests for the data pipeline (reference analog: dataset/ transformer specs)."""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.dataset import (DataSet, LocalDataSet, MiniBatch, PaddingParam,
+                               Sample, SampleToMiniBatch, ShardedDataSet)
+from bigdl_tpu.dataset.image import (BGRImgToBatch, CenterCrop, ChannelNormalize,
+                                     ColorJitter, HFlip, LabeledImage,
+                                     Lighting, RandomCrop)
+from bigdl_tpu.dataset.text import (Dictionary, LabeledSentenceToSample,
+                                    SentenceSplitter, SentenceTokenizer,
+                                    TextToLabeledSentence)
+from bigdl_tpu.dataset.datasets import synthetic_images, synthetic_separable
+
+
+class TestSampleMiniBatch:
+    def test_minibatch_from_samples(self):
+        samples = [Sample(np.ones((3, 4)) * i, np.float32(i)) for i in range(5)]
+        mb = MiniBatch.from_samples(samples)
+        assert mb.size() == 5
+        assert mb.get_input().shape == (5, 3, 4)
+        assert mb.get_target().shape == (5,)
+
+    def test_slice(self):
+        mb = MiniBatch(np.arange(12).reshape(6, 2), np.arange(6))
+        sub = mb.slice(2, 3)
+        assert sub.size() == 3
+        np.testing.assert_array_equal(sub.get_input(),
+                                      np.arange(12).reshape(6, 2)[2:5])
+
+    def test_ragged_padding(self):
+        samples = [Sample(np.ones((2, 3)), np.float32(1)),
+                   Sample(np.ones((4, 3)), np.float32(2))]
+        mb = MiniBatch.from_samples(samples, feature_padding=PaddingParam(-1.0))
+        assert mb.get_input().shape == (2, 4, 3)
+        assert mb.get_input()[0, 3, 0] == -1.0
+
+    def test_fixed_length_padding(self):
+        samples = [Sample(np.ones((2,)), np.float32(1))]
+        mb = MiniBatch.from_samples(
+            samples, feature_padding=PaddingParam(0.0, fixed_length=[5]))
+        assert mb.get_input().shape == (1, 5)
+
+
+class TestSampleToMiniBatch:
+    def test_batching(self):
+        samples = [Sample(np.ones(3), np.float32(1)) for _ in range(10)]
+        batches = list(SampleToMiniBatch(4)(iter(samples)))
+        assert [b.size() for b in batches] == [4, 4, 2]
+
+    def test_partition_division(self):
+        t = SampleToMiniBatch(8, partition_num=4)
+        assert t.batch_per_partition == 2
+        with pytest.raises(ValueError):
+            SampleToMiniBatch(10, partition_num=4)
+
+
+class TestLocalDataSet:
+    def test_train_loops_forever(self):
+        ds = LocalDataSet([1, 2, 3])
+        it = ds.data(train=True)
+        got = [next(it) for _ in range(7)]
+        assert sorted(set(got)) == [1, 2, 3]
+
+    def test_eval_finite(self):
+        ds = LocalDataSet([1, 2, 3])
+        assert sorted(ds.data(train=False)) == [1, 2, 3]
+
+    def test_shuffle_changes_order(self):
+        ds = LocalDataSet(list(range(100)))
+        before = list(ds.data(train=False))
+        ds.shuffle()
+        after = list(ds.data(train=False))
+        assert before != after
+        assert sorted(after) == sorted(before)
+
+    def test_transform_shares_index(self):
+        ds = LocalDataSet(list(range(10)))
+        ds2 = ds.transform(SampleToMiniBatch.__new__(SampleToMiniBatch) if False
+                           else _DoubleTransformer())
+        ds.shuffle()
+        # transformed view sees the shuffled index
+        assert sorted(ds2.data(train=False)) == [2 * i for i in range(10)]
+
+
+class _DoubleTransformer:
+    def __call__(self, it):
+        return (2 * x for x in it)
+
+
+class TestShardedDataSet:
+    def test_shard_sizes_equal(self):
+        ds = ShardedDataSet(list(range(10)), partition_num=4)
+        sizes = [s.size() for s in ds.shards]
+        assert sizes == [2, 2, 2, 2]  # truncated to equal size
+
+    def test_shard_disjoint(self):
+        ds = ShardedDataSet(list(range(8)), partition_num=4)
+        all_items = []
+        for i in range(4):
+            all_items.extend(ds.shards[i].records)
+        assert sorted(all_items) == list(range(8))
+
+
+class TestImageTransforms:
+    def _img(self, h=8, w=8, c=3):
+        return LabeledImage(np.arange(h * w * c, dtype=np.float32)
+                            .reshape(h, w, c), 1.0)
+
+    def test_center_crop(self):
+        out = next(iter(CenterCrop(4, 4)([self._img()])))
+        assert out.data.shape == (4, 4, 3)
+
+    def test_random_crop_with_padding(self):
+        out = next(iter(RandomCrop(8, 8, padding=2)([self._img()])))
+        assert out.data.shape == (8, 8, 3)
+
+    def test_hflip(self):
+        img = self._img()
+        out = next(iter(HFlip(threshold=1.1)([img])))
+        np.testing.assert_array_equal(out.data, img.data[:, ::-1])
+
+    def test_normalize(self):
+        img = self._img()
+        out = next(iter(ChannelNormalize([1.0, 2.0, 3.0],
+                                         [2.0, 2.0, 2.0])([img])))
+        np.testing.assert_allclose(
+            out.data[..., 1], (img.data[..., 1] - 2.0) / 2.0)
+
+    def test_color_jitter_shape_and_range(self):
+        out = next(iter(ColorJitter()([self._img()])))
+        assert out.data.shape == (8, 8, 3)
+        assert out.data.min() >= 0.0 and out.data.max() <= 255.0
+
+    def test_lighting(self):
+        out = next(iter(Lighting()([self._img()])))
+        assert out.data.shape == (8, 8, 3)
+
+    def test_to_batch_chw(self):
+        batches = list(BGRImgToBatch(2)([self._img(), self._img(),
+                                         self._img()]))
+        assert batches[0].get_input().shape == (2, 3, 8, 8)
+        assert batches[1].get_input().shape == (1, 3, 8, 8)
+
+
+class TestText:
+    def test_split_tokenize(self):
+        sents = list(SentenceSplitter()(["Hello there. How are you?"]))
+        assert len(sents) == 2
+        toks = next(iter(SentenceTokenizer()(["Hello, world!"])))
+        assert toks == ["hello", ",", "world", "!"]
+
+    def test_dictionary(self):
+        d = Dictionary([["a", "b", "a"], ["a", "c"]], vocab_size=2)
+        assert d.vocab_size() == 2
+        assert d.get_index("a") == 0          # most frequent first
+        assert d.get_index("zzz") == 2        # OOV index
+
+    def test_lm_pipeline(self):
+        d = Dictionary([["the", "cat", "sat"]])
+        pairs = list(TextToLabeledSentence(d)([["the", "cat", "sat"]]))
+        assert len(pairs) == 1
+        samples = list(LabeledSentenceToSample(
+            d.vocab_size() + 1, fixed_length=4)(iter(pairs)))
+        s = samples[0]
+        assert s.feature.shape == (4, 4)       # one-hot (T, vocab)
+        assert s.label.shape == (4,)
+        assert s.label[0] == d.get_index("cat") + 1  # 1-based
+
+
+def test_oov_clamped_into_vocab():
+    d = Dictionary([["a", "b", "a"]], vocab_size=2)
+    pairs = list(TextToLabeledSentence(d)([["a", "zzz", "b"]]))  # OOV word
+    # natural call: vocab_length == vocab_size() — OOV folds onto last slot
+    samples = list(LabeledSentenceToSample(d.vocab_size(),
+                                           fixed_length=3)(iter(pairs)))
+    s = samples[0]
+    assert s.feature.shape == (3, 2)
+    assert s.label.max() <= d.vocab_size()
+
+
+def test_synthetic_generators():
+    imgs = synthetic_images(5, 3, 8, 8, 10)
+    assert len(imgs) == 5 and imgs[0].data.shape == (8, 8, 3)
+    samples = synthetic_separable(20, 4, n_classes=3)
+    assert len(samples) == 20
+    labels = {float(s.label) for s in samples}
+    assert labels <= {1.0, 2.0, 3.0}

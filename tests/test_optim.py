@@ -1,0 +1,259 @@
+"""Tests for optim methods, schedules, triggers, metrics, and the
+LocalOptimizer end-to-end slice (reference analogs: optim/ specs +
+LocalOptimizerSpec's convergence tests on separable data)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import bigdl_tpu.nn as nn
+import bigdl_tpu.optim as optim
+from bigdl_tpu.dataset import LocalDataSet, Sample, SampleToMiniBatch
+from bigdl_tpu.dataset.datasets import synthetic_separable
+from bigdl_tpu.optim.evaluator import Evaluator
+
+
+def quad_feval(x):
+    """f(x) = sum((x-3)^2); grad = 2(x-3)."""
+    loss = jnp.sum((x - 3.0) ** 2)
+    return loss, 2 * (x - 3.0)
+
+
+class TestOptimMethods:
+    @pytest.mark.parametrize("method,steps,tol", [
+        (optim.SGD(learning_rate=0.1), 100, 1e-3),
+        (optim.SGD(learning_rate=0.05, momentum=0.9), 150, 1e-2),
+        (optim.SGD(learning_rate=0.05, momentum=0.9, nesterov=True,
+                   dampening=0.0), 150, 1e-2),
+        (optim.Adam(learning_rate=0.3), 200, 1e-2),
+        (optim.Adagrad(learning_rate=1.0), 300, 1e-2),
+        (optim.Adadelta(decay_rate=0.9, epsilon=1e-2), 1500, 0.2),
+        (optim.Adamax(learning_rate=0.5), 200, 1e-2),
+        (optim.RMSprop(learning_rate=0.1), 300, 1e-2),
+    ])
+    def test_converges_on_quadratic(self, method, steps, tol):
+        x = jnp.array([0.0, 10.0, -5.0])
+        for _ in range(steps):
+            x, _ = method.optimize(quad_feval, x)
+        np.testing.assert_allclose(np.asarray(x), 3.0, atol=tol)
+
+    def test_lbfgs_converges_fast(self):
+        x = jnp.array([0.0, 10.0, -5.0])
+        method = optim.LBFGS(max_iter=10)
+        x, losses = method.optimize(quad_feval, x)
+        np.testing.assert_allclose(np.asarray(x), 3.0, atol=1e-4)
+        assert losses[-1] < losses[0]
+
+    def test_weight_decay_shrinks(self):
+        m = optim.SGD(learning_rate=0.1, weight_decay=0.5)
+        x = jnp.array([1.0])
+        x2 = m.update(jnp.zeros(1), x)
+        assert float(x2[0]) < 1.0
+
+    def test_pytree_params(self):
+        m = optim.Adam(learning_rate=0.5)
+        params = {"w": jnp.zeros((2, 2)), "b": jnp.zeros(2)}
+
+        def feval(p):
+            loss = jnp.sum((p["w"] - 1) ** 2) + jnp.sum((p["b"] + 2) ** 2)
+            return loss, {"w": 2 * (p["w"] - 1), "b": 2 * (p["b"] + 2)}
+
+        for _ in range(100):
+            params, _ = m.optimize(feval, params)
+        np.testing.assert_allclose(np.asarray(params["w"]), 1.0, atol=0.05)
+        np.testing.assert_allclose(np.asarray(params["b"]), -2.0, atol=0.05)
+
+    def test_state_serialization(self, tmp_path):
+        m = optim.Adam()
+        x = jnp.zeros(3)
+        for _ in range(3):
+            x, _ = m.optimize(quad_feval, x)
+        p = str(tmp_path / "adam.bin")
+        m.save(p)
+        m2 = optim.OptimMethod.load(p)
+        assert m2.state["evalCounter"] == 3
+
+
+class TestSchedules:
+    def _clr(self, sgd):
+        sgd.hyper()
+        return -sgd.state["clr"]
+
+    def test_default(self):
+        s = optim.SGD(learning_rate=1.0, learning_rate_decay=0.1)
+        assert self._clr(s) == 1.0
+        s.state["evalCounter"] = 10
+        np.testing.assert_allclose(self._clr(s), 1.0 / 2.0)
+
+    def test_step(self):
+        s = optim.SGD(learning_rate=1.0,
+                      learning_rate_schedule=optim.Step(10, 0.5))
+        s.state["evalCounter"] = 25
+        np.testing.assert_allclose(self._clr(s), 0.25)
+
+    def test_multistep(self):
+        s = optim.SGD(learning_rate=1.0,
+                      learning_rate_schedule=optim.MultiStep([10, 20], 0.1))
+        s.state["evalCounter"] = 15
+        np.testing.assert_allclose(self._clr(s), 0.1)
+
+    def test_epoch_step(self):
+        s = optim.SGD(learning_rate=1.0,
+                      learning_rate_schedule=optim.EpochStep(2, 0.1))
+        s.state["epoch"] = 5
+        np.testing.assert_allclose(self._clr(s), 0.01)
+
+    def test_poly(self):
+        s = optim.SGD(learning_rate=1.0,
+                      learning_rate_schedule=optim.Poly(2.0, 100))
+        s.state["evalCounter"] = 50
+        np.testing.assert_allclose(self._clr(s), 0.25)
+
+    def test_exponential(self):
+        s = optim.SGD(learning_rate=1.0,
+                      learning_rate_schedule=optim.Exponential(
+                          10, 0.5, stair_case=True))
+        s.state["evalCounter"] = 25
+        np.testing.assert_allclose(self._clr(s), 0.25)
+
+    def test_plateau_reduces(self):
+        sched = optim.Plateau(monitor="score", factor=0.5, patience=2,
+                              mode="max")
+        s = optim.SGD(learning_rate=1.0, learning_rate_schedule=sched)
+        s.state["score"] = 0.9
+        self._clr(s)
+        for _ in range(2):          # no improvement for `patience` steps
+            s.state["score"] = 0.5
+            lr = self._clr(s)
+        assert lr == 0.5            # exactly one reduction
+
+    def test_epoch_schedule_regimes(self):
+        sched = optim.EpochSchedule([
+            optim.Regime(1, 3, {"learning_rate": 1e-2}),
+            optim.Regime(4, 10, {"learning_rate": 1e-3}),
+        ])
+        s = optim.SGD(learning_rate=1.0, learning_rate_schedule=sched)
+        s.state["epoch"] = 5
+        np.testing.assert_allclose(self._clr(s), 1e-3)
+
+
+class TestTriggers:
+    def test_every_epoch(self):
+        t = optim.every_epoch()
+        assert not t({"epoch": 1})
+        assert t({"epoch": 2})
+        assert not t({"epoch": 2})
+
+    def test_several_iteration(self):
+        t = optim.several_iteration(3)
+        assert [t({"neval": i}) for i in range(1, 7)] == \
+            [False, False, True, False, False, True]
+
+    def test_max_epoch_iteration(self):
+        assert optim.max_epoch(5)({"epoch": 6})
+        assert not optim.max_epoch(5)({"epoch": 5})
+        assert optim.max_iteration(10)({"neval": 11})
+
+    def test_min_loss_max_score_inert_on_fresh_state(self):
+        # driver state initialises Loss/score to None; triggers must not crash
+        fresh = {"epoch": 1, "neval": 1, "Loss": None, "score": None}
+        assert not optim.min_loss(0.1)(fresh)
+        assert not optim.max_score(0.9)(fresh)
+        assert optim.min_loss(0.1)({"Loss": 0.05})
+        assert optim.max_score(0.9)({"score": 0.95})
+
+    def test_combinators(self):
+        t = optim.max_epoch(2) | optim.max_iteration(100)
+        assert t({"epoch": 3, "neval": 1})
+        assert t({"epoch": 1, "neval": 101})
+        assert not t({"epoch": 1, "neval": 1})
+
+
+class TestValidationMethods:
+    def test_top1(self):
+        out = np.array([[0.1, 0.9], [0.8, 0.2]])
+        target = np.array([2.0, 1.0])
+        r = optim.Top1Accuracy()(out, target)
+        assert r.final_result() == 1.0
+
+    def test_top5(self):
+        out = np.tile(np.arange(10.0), (2, 1))
+        target = np.array([6.0, 1.0])   # class 6 in top5 (classes 6..10)
+        r = optim.Top5Accuracy()(out, target)
+        assert r.final_result() == 0.5
+
+    def test_result_merge(self):
+        a = optim.ValidationResult(3, 4, "x")
+        b = optim.ValidationResult(1, 4, "x")
+        assert (a + b).final_result() == 0.5
+
+    def test_mae(self):
+        out = np.array([[0.9, 0.1]])    # pred class 1
+        target = np.array([3.0])
+        assert optim.MAE()(out, target).final_result() == 2.0
+
+
+def _mlp(din, nclass):
+    return (nn.Sequential()
+            .add(nn.Linear(din, 16))
+            .add(nn.Tanh())
+            .add(nn.Linear(16, nclass))
+            .add(nn.LogSoftMax()))
+
+
+class TestLocalOptimizerE2E:
+    """The 'minimum slice': train a tiny MLP to high accuracy on separable
+    data (reference LocalOptimizerSpec / DistriOptimizerSpec strategy)."""
+
+    def test_converges_and_validates(self, tmp_path):
+        samples = synthetic_separable(256, 4, n_classes=3, seed=7)
+        ds = LocalDataSet(samples).transform(SampleToMiniBatch(32))
+        model = _mlp(4, 3)
+        opt = optim.Optimizer.create(model, ds, nn.ClassNLLCriterion())
+        opt.set_optim_method(optim.SGD(learning_rate=0.5))
+        opt.set_end_when(optim.max_epoch(15))
+        opt.set_checkpoint(str(tmp_path / "ckpt"), optim.every_epoch())
+        opt.set_validation(optim.every_epoch(),
+                           LocalDataSet(samples).transform(SampleToMiniBatch(32)),
+                           [optim.Top1Accuracy()])
+        trained = opt.optimize()
+
+        results = Evaluator(trained).test(samples, [optim.Top1Accuracy()],
+                                          batch_size=32)
+        acc = results[0][1].final_result()
+        assert acc > 0.9, f"model failed to learn separable data: acc={acc}"
+
+        # checkpoint exists and resumes
+        latest = opt.checkpoint.latest()
+        assert latest is not None
+        from bigdl_tpu.utils import file_io
+        m2 = file_io.load(latest[0])
+        r2 = Evaluator(m2).test(samples, [optim.Top1Accuracy()], 32)
+        assert r2[0][1].final_result() > 0.8
+
+    def test_adam_path(self):
+        samples = synthetic_separable(128, 4, n_classes=2, seed=3)
+        ds = LocalDataSet(samples).transform(SampleToMiniBatch(32))
+        model = _mlp(4, 2)
+        opt = optim.Optimizer.create(model, ds, nn.ClassNLLCriterion())
+        opt.set_optim_method(optim.Adam(learning_rate=0.05))
+        opt.set_end_when(optim.max_iteration(60))
+        trained = opt.optimize()
+        acc = Evaluator(trained).test(
+            samples, [optim.Top1Accuracy()], 32)[0][1].final_result()
+        assert acc > 0.9
+
+    def test_predictor(self):
+        samples = synthetic_separable(64, 4, n_classes=2, seed=3)
+        model = _mlp(4, 2)
+        preds = model.predict_class(samples, batch_size=16)
+        assert preds.shape == (64,)
+        assert set(np.unique(preds)) <= {1, 2}
+
+    def test_batch_size_factory(self):
+        samples = synthetic_separable(64, 4, n_classes=2)
+        model = _mlp(4, 2)
+        opt = optim.Optimizer.create(model, LocalDataSet(samples),
+                                     nn.ClassNLLCriterion(), batch_size=16)
+        opt.set_end_when(optim.max_iteration(5))
+        opt.optimize()          # runs without error

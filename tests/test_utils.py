@@ -1,0 +1,117 @@
+"""Tests for bigdl_tpu.utils (reference test analog: utils/ specs)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.utils import (DirectedGraph, Edge, Node, RandomGenerator, T,
+                             Table, file_io, kth_largest)
+
+
+class TestTable:
+    def test_t_constructor(self):
+        t = T(10, 20, x=3)
+        assert t[1] == 10 and t[2] == 20 and t["x"] == 3
+        assert t.length() == 2
+
+    def test_insert_remove(self):
+        t = T("a", "b", "c")
+        t.insert(2, "z")
+        assert t.to_seq() == ["a", "z", "b", "c"]
+        assert t.remove(2) == "z"
+        assert t.to_seq() == ["a", "b", "c"]
+        t.insert("d")
+        assert t.length() == 4
+
+    def test_pytree(self):
+        import jax
+        t = T(np.ones(3), np.zeros(2))
+        doubled = jax.tree_util.tree_map(lambda x: x * 2, t)
+        assert isinstance(doubled, Table)
+        np.testing.assert_allclose(doubled[1], 2 * np.ones(3))
+
+    def test_get_or_update(self):
+        t = Table()
+        assert t.get_or_update("k", lambda: 5) == 5
+        assert t.get_or_update("k", lambda: 99) == 5
+
+
+class TestFileIO:
+    def test_save_load_roundtrip(self, tmp_path):
+        obj = {"a": np.arange(5), "b": "text"}
+        p = str(tmp_path / "obj.bin")
+        file_io.save(obj, p)
+        loaded = file_io.load(p)
+        np.testing.assert_array_equal(loaded["a"], obj["a"])
+        assert loaded["b"] == "text"
+
+    def test_no_overwrite(self, tmp_path):
+        p = str(tmp_path / "f.bin")
+        file_io.save(1, p)
+        with pytest.raises(FileExistsError):
+            file_io.save(2, p, overwrite=False)
+
+    def test_remote_scheme_rejected(self):
+        with pytest.raises(NotImplementedError):
+            file_io.save(1, "hdfs://nn/path")
+
+
+class TestRandomGenerator:
+    def test_seed_reproducible(self):
+        a = RandomGenerator(42)
+        b = RandomGenerator(42)
+        assert [a.uniform() for _ in range(5)] == [b.uniform() for _ in range(5)]
+
+    def test_thread_local_singleton(self):
+        assert RandomGenerator.RNG() is RandomGenerator.RNG()
+
+    def test_permutation(self):
+        p = RandomGenerator(1).permutation(10)
+        assert sorted(p.tolist()) == list(range(10))
+
+
+class TestDirectedGraph:
+    def _diamond(self):
+        a, b, c, d = Node("a"), Node("b"), Node("c"), Node("d")
+        a.add(b)
+        a.add(c)
+        b.add(d)
+        c.add(d)
+        return a, b, c, d
+
+    def test_topsort(self):
+        a, b, c, d = self._diamond()
+        order = [n.element for n in a.graph().topology_sort()]
+        assert order[0] == "a" and order[-1] == "d"
+        assert set(order) == {"a", "b", "c", "d"}
+
+    def test_bfs_dfs(self):
+        a, *_ = self._diamond()
+        assert len(list(a.graph().bfs())) == 4
+        assert len(list(a.graph().dfs())) == 4
+
+    def test_reverse_graph(self):
+        a, b, c, d = self._diamond()
+        rev = [n.element for n in d.graph(reverse=True).topology_sort()]
+        assert rev[0] == "d" and rev[-1] == "a"
+
+    def test_cycle_detection(self):
+        a, b = Node("a"), Node("b")
+        a.add(b)
+        b.add(a)
+        with pytest.raises(ValueError):
+            a.graph().topology_sort()
+
+    def test_clone(self):
+        a, *_ = self._diamond()
+        g2 = a.graph().clone_graph()
+        assert g2.size() == 4
+        assert g2.source is not a
+
+
+def test_kth_largest():
+    arr = [5, 1, 9, 3, 7]
+    assert kth_largest(arr, 1) == 9
+    assert kth_largest(arr, 3) == 5
+    assert kth_largest(arr, 5) == 1
