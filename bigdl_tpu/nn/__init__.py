@@ -67,3 +67,7 @@ from bigdl_tpu.nn.criterion import (ClassNLLCriterion, CrossEntropyCriterion,
                                     ClassSimplexCriterion,
                                     TimeDistributedCriterion)
 from bigdl_tpu.nn.graph import Graph, ModuleNode, Input
+from bigdl_tpu.nn.recurrent import (Cell, RnnCell, LSTM, LSTMPeephole, GRU,
+                                    ConvLSTMPeephole, ConvLSTMPeephole3D,
+                                    Recurrent, BiRecurrent, TimeDistributed,
+                                    BinaryTreeLSTM, TreeLSTM)

@@ -282,6 +282,7 @@ class Plateau(LearningRateSchedule):
         self._cooldown_counter = 0
         self._best: Optional[float] = None
         self._current_lr: Optional[float] = None
+        self._cur_epoch = -1
 
     def _is_better(self, cur: float, best: float) -> bool:
         if self.mode == "min":
@@ -291,21 +292,29 @@ class Plateau(LearningRateSchedule):
     def update_hyper_parameter(self, optim: "SGD") -> None:
         if self._current_lr is None:
             self._current_lr = optim.learning_rate
+        optim.state["clr"] = -self._current_lr
+        # advance the plateau state once per epoch, not per iteration
+        # (reference ``SGD.Plateau:558`` — ``if (epoch == curEpoch) return``)
+        epoch = optim.state.get("epoch", 1)
+        if epoch == self._cur_epoch:
+            return
+        self._cur_epoch = epoch
         metric = optim.state.get(self.monitor)
-        if metric is not None:
-            if self._best is None or self._is_better(metric, self._best):
-                self._best = metric
+        if metric is None:
+            return
+        if self._cooldown_counter > 0:
+            self._cooldown_counter -= 1
+            self._wait = 0
+        if self._best is None or self._is_better(metric, self._best):
+            self._best = metric
+            self._wait = 0
+        elif self._cooldown_counter <= 0:
+            self._wait += 1
+            if self._wait >= self.patience:
+                self._current_lr = max(self._current_lr * self.factor,
+                                       self.min_lr)
+                self._cooldown_counter = self.cooldown
                 self._wait = 0
-            elif self._cooldown_counter > 0:
-                self._cooldown_counter -= 1
-                self._wait = 0
-            else:
-                self._wait += 1
-                if self._wait >= self.patience:
-                    self._current_lr = max(self._current_lr * self.factor,
-                                           self.min_lr)
-                    self._cooldown_counter = self.cooldown
-                    self._wait = 0
         optim.state["clr"] = -self._current_lr
 
 

@@ -114,11 +114,13 @@ class Optimizer:
         self.drop_percentage: float = 0.0
         self.max_drop_percentage: float = 0.0
         self.metrics = Metrics()
+        self._step_fn = None
 
     # -- fluent setters (reference Optimizer.scala fluent API) ------------
 
     def set_optim_method(self, method: OptimMethod) -> "Optimizer":
         self.optim_method = method
+        self._step_fn = None  # the jitted step closes over the optim method
         return self
 
     def set_end_when(self, trigger: Trigger) -> "Optimizer":
@@ -186,11 +188,19 @@ class Optimizer:
 
 
 def _yields_minibatches(ds: AbstractDataSet) -> bool:
-    from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+    from bigdl_tpu.dataset.transformer import ChainedTransformer, SampleToMiniBatch
+
+    def has_batcher(t) -> bool:
+        if isinstance(t, SampleToMiniBatch):
+            return True
+        if isinstance(t, ChainedTransformer):
+            return any(has_batcher(s) for s in t.stages)
+        return False
+
     ts = getattr(ds, "transformers", None)
     if ts is None and isinstance(ds, ShardedDataSet):
         ts = ds.shards[0].transformers
-    return bool(ts) and any(isinstance(t, SampleToMiniBatch) for t in ts)
+    return bool(ts) and any(has_batcher(t) for t in ts)
 
 
 # shared state-key conventions (reference DistriOptimizer driverState)
@@ -207,12 +217,6 @@ class LocalOptimizer(Optimizer):
     hyper-parameters (decayed lr, step count) enter as scalar arguments so
     the step never retraces.
     """
-
-    def __init__(self, model: Module, dataset: AbstractDataSet,
-                 criterion: Criterion):
-        super().__init__(model, dataset, criterion)
-        self._step_fn = None
-        self._loss_uses_rng = False
 
     def _build_step(self):
         model, criterion = self.model, self.criterion

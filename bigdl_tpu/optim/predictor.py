@@ -44,6 +44,8 @@ class Predictor:
             outs: List[np.ndarray] = []
             for batch in self._batches(dataset, batch_size):
                 outs.append(np.asarray(fwd(_to_device(batch.get_input()))))
+            if not outs:
+                return np.zeros((0,))
             return np.concatenate(outs, axis=0)
         finally:
             if was_training:

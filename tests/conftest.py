@@ -18,6 +18,10 @@ import jax
 import numpy as np
 import pytest
 
+# The environment's sitecustomize may pre-register an accelerator backend and
+# force it via jax_platforms; tests run on the virtual CPU mesh regardless.
+jax.config.update("jax_platforms", "cpu")
+
 # Numerical-parity tests need full fp32 matmuls; the framework's production
 # default stays backend-default (bf16 passes on the MXU — the TPU-first choice).
 jax.config.update("jax_default_matmul_precision", "highest")

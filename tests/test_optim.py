@@ -121,10 +121,13 @@ class TestSchedules:
                               mode="max")
         s = optim.SGD(learning_rate=1.0, learning_rate_schedule=sched)
         s.state["score"] = 0.9
+        s.state["epoch"] = 1
         self._clr(s)
-        for _ in range(2):          # no improvement for `patience` steps
-            s.state["score"] = 0.5
+        s.state["score"] = 0.5
+        for e in range(2, 4):       # no improvement for `patience` epochs
+            s.state["epoch"] = e
             lr = self._clr(s)
+            lr = self._clr(s)       # second call same epoch must be inert
         assert lr == 0.5            # exactly one reduction
 
     def test_epoch_schedule_regimes(self):
