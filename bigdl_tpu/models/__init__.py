@@ -1,0 +1,21 @@
+"""bigdl_tpu.models — the model zoo (reference ``models/`` + ``example/``)."""
+
+from bigdl_tpu.models.lenet import lenet5
+from bigdl_tpu.models.autoencoder import autoencoder
+from bigdl_tpu.models.vgg import vgg_for_cifar10, vgg16, vgg19
+from bigdl_tpu.models.resnet import resnet, model_init, DatasetType, ShortcutType
+from bigdl_tpu.models.inception import (inception_v1, inception_v1_no_aux_classifier,
+                                        inception_v2, inception_v2_no_aux_classifier,
+                                        inception_layer_v1, inception_layer_v2)
+from bigdl_tpu.models.alexnet import alexnet, alexnet_owt
+from bigdl_tpu.models.rnn import simple_rnn, lstm_lm
+from bigdl_tpu.models.textclassifier import text_classifier
+
+__all__ = [
+    "lenet5", "autoencoder", "vgg_for_cifar10", "vgg16", "vgg19",
+    "resnet", "model_init", "DatasetType", "ShortcutType",
+    "inception_v1", "inception_v1_no_aux_classifier",
+    "inception_v2", "inception_v2_no_aux_classifier",
+    "inception_layer_v1", "inception_layer_v2",
+    "alexnet", "alexnet_owt", "simple_rnn", "lstm_lm", "text_classifier",
+]

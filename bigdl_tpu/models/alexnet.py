@@ -1,0 +1,67 @@
+"""AlexNet (reference ``example/loadmodel/AlexNet.scala``)."""
+
+from bigdl_tpu.nn import (Sequential, SpatialConvolution, SpatialMaxPooling,
+                          SpatialCrossMapLRN, ReLU, Dropout, View, Linear,
+                          LogSoftMax)
+
+
+def alexnet_owt(class_num: int = 1000, has_dropout: bool = True,
+                first_layer_propagate_back: bool = False) -> Sequential:
+    """One-weird-trick AlexNet (no LRN, no grouping)."""
+    m = Sequential()
+    m.add(SpatialConvolution(3, 64, 11, 11, 4, 4, 2, 2, 1,
+                             first_layer_propagate_back, name="conv1"))
+    m.add(ReLU())
+    m.add(SpatialMaxPooling(3, 3, 2, 2))
+    m.add(SpatialConvolution(64, 192, 5, 5, 1, 1, 2, 2, name="conv2"))
+    m.add(ReLU())
+    m.add(SpatialMaxPooling(3, 3, 2, 2))
+    m.add(SpatialConvolution(192, 384, 3, 3, 1, 1, 1, 1, name="conv3"))
+    m.add(ReLU())
+    m.add(SpatialConvolution(384, 256, 3, 3, 1, 1, 1, 1, name="conv4"))
+    m.add(ReLU())
+    m.add(SpatialConvolution(256, 256, 3, 3, 1, 1, 1, 1, name="conv5"))
+    m.add(ReLU())
+    m.add(SpatialMaxPooling(3, 3, 2, 2))
+    m.add(View(256 * 6 * 6))
+    m.add(Linear(256 * 6 * 6, 4096, name="fc6"))
+    m.add(ReLU())
+    if has_dropout:
+        m.add(Dropout(0.5))
+    m.add(Linear(4096, 4096, name="fc7"))
+    m.add(ReLU())
+    if has_dropout:
+        m.add(Dropout(0.5))
+    m.add(Linear(4096, class_num, name="fc8"))
+    m.add(LogSoftMax())
+    return m
+
+
+def alexnet(class_num: int = 1000) -> Sequential:
+    """Original grouped AlexNet with cross-map LRN."""
+    m = Sequential()
+    m.add(SpatialConvolution(3, 96, 11, 11, 4, 4, 0, 0, 1, False, name="conv1"))
+    m.add(ReLU())
+    m.add(SpatialCrossMapLRN(5, 0.0001, 0.75))
+    m.add(SpatialMaxPooling(3, 3, 2, 2))
+    m.add(SpatialConvolution(96, 256, 5, 5, 1, 1, 2, 2, 2, name="conv2"))
+    m.add(ReLU())
+    m.add(SpatialCrossMapLRN(5, 0.0001, 0.75))
+    m.add(SpatialMaxPooling(3, 3, 2, 2))
+    m.add(SpatialConvolution(256, 384, 3, 3, 1, 1, 1, 1, name="conv3"))
+    m.add(ReLU())
+    m.add(SpatialConvolution(384, 384, 3, 3, 1, 1, 1, 1, 2, name="conv4"))
+    m.add(ReLU())
+    m.add(SpatialConvolution(384, 256, 3, 3, 1, 1, 1, 1, 2, name="conv5"))
+    m.add(ReLU())
+    m.add(SpatialMaxPooling(3, 3, 2, 2))
+    m.add(View(256 * 6 * 6))
+    m.add(Linear(256 * 6 * 6, 4096, name="fc6"))
+    m.add(ReLU())
+    m.add(Dropout(0.5))
+    m.add(Linear(4096, 4096, name="fc7"))
+    m.add(ReLU())
+    m.add(Dropout(0.5))
+    m.add(Linear(4096, class_num, name="fc8"))
+    m.add(LogSoftMax())
+    return m

@@ -1,0 +1,18 @@
+"""MNIST autoencoder (reference ``models/autoencoder/Autoencoder.scala``)."""
+
+from bigdl_tpu.nn import Sequential, Reshape, Linear, ReLU, Sigmoid
+
+ROW_N = 28
+COL_N = 28
+FEATURE_SIZE = ROW_N * COL_N
+
+
+def autoencoder(class_num: int = 32) -> Sequential:
+    """784 -> class_num -> 784 bottleneck autoencoder with sigmoid output."""
+    m = Sequential()
+    m.add(Reshape((FEATURE_SIZE,)))
+    m.add(Linear(FEATURE_SIZE, class_num))
+    m.add(ReLU())
+    m.add(Linear(class_num, FEATURE_SIZE))
+    m.add(Sigmoid())
+    return m

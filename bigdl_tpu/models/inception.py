@@ -1,0 +1,289 @@
+"""Inception v1 / v2 for ImageNet
+(reference ``models/inception/Inception_v1.scala:102``, ``Inception_v2.scala:152``).
+"""
+
+from bigdl_tpu.nn import (Sequential, SpatialConvolution, SpatialMaxPooling,
+                          SpatialAveragePooling, SpatialCrossMapLRN,
+                          SpatialBatchNormalization, ReLU, Concat, Dropout,
+                          View, Linear, LogSoftMax, Xavier, Zeros)
+
+
+def _conv(n_in, n_out, kw, kh, sw=1, sh=1, pw=0, ph=0, name=None,
+          propagate_back=True, xavier=True):
+    c = SpatialConvolution(n_in, n_out, kw, kh, sw, sh, pw, ph, 1,
+                           propagate_back, name=name)
+    if xavier:
+        c.set_init_method(Xavier(), Zeros())
+    return c
+
+
+def inception_layer_v1(input_size, config, name_prefix=""):
+    """One GoogLeNet inception block: 1x1 / 3x3 / 5x5 / pool-proj towers
+    concatenated along channels.  ``config = ((c1,), (r3, c3), (r5, c5), (cp,))``.
+    """
+    concat = Concat(2, name=name_prefix + "output")
+    conv1 = Sequential()
+    conv1.add(_conv(input_size, config[0][0], 1, 1, name=name_prefix + "1x1"))
+    conv1.add(ReLU())
+    concat.add(conv1)
+    conv3 = Sequential()
+    conv3.add(_conv(input_size, config[1][0], 1, 1, name=name_prefix + "3x3_reduce"))
+    conv3.add(ReLU())
+    conv3.add(_conv(config[1][0], config[1][1], 3, 3, 1, 1, 1, 1,
+                    name=name_prefix + "3x3"))
+    conv3.add(ReLU())
+    concat.add(conv3)
+    conv5 = Sequential()
+    conv5.add(_conv(input_size, config[2][0], 1, 1, name=name_prefix + "5x5_reduce"))
+    conv5.add(ReLU())
+    conv5.add(_conv(config[2][0], config[2][1], 5, 5, 1, 1, 2, 2,
+                    name=name_prefix + "5x5"))
+    conv5.add(ReLU())
+    concat.add(conv5)
+    pool = Sequential()
+    pool.add(SpatialMaxPooling(3, 3, 1, 1, 1, 1).ceil())
+    pool.add(_conv(input_size, config[3][0], 1, 1, name=name_prefix + "pool_proj"))
+    pool.add(ReLU())
+    concat.add(pool)
+    return concat
+
+
+def _v1_stem():
+    f = Sequential()
+    f.add(_conv(3, 64, 7, 7, 2, 2, 3, 3, name="conv1/7x7_s2",
+                propagate_back=False))
+    f.add(ReLU())
+    f.add(SpatialMaxPooling(3, 3, 2, 2).ceil())
+    f.add(SpatialCrossMapLRN(5, 0.0001, 0.75))
+    f.add(_conv(64, 64, 1, 1, name="conv2/3x3_reduce"))
+    f.add(ReLU())
+    f.add(_conv(64, 192, 3, 3, 1, 1, 1, 1, name="conv2/3x3"))
+    f.add(ReLU())
+    f.add(SpatialCrossMapLRN(5, 0.0001, 0.75))
+    f.add(SpatialMaxPooling(3, 3, 2, 2).ceil())
+    f.add(inception_layer_v1(192, ((64,), (96, 128), (16, 32), (32,)),
+                             "inception_3a/"))
+    f.add(inception_layer_v1(256, ((128,), (128, 192), (32, 96), (64,)),
+                             "inception_3b/"))
+    f.add(SpatialMaxPooling(3, 3, 2, 2).ceil())
+    f.add(inception_layer_v1(480, ((192,), (96, 208), (16, 48), (64,)),
+                             "inception_4a/"))
+    return f
+
+
+def inception_v1_no_aux_classifier(class_num: int = 1000) -> Sequential:
+    m = _v1_stem()
+    m.add(inception_layer_v1(512, ((160,), (112, 224), (24, 64), (64,)),
+                             "inception_4b/"))
+    m.add(inception_layer_v1(512, ((128,), (128, 256), (24, 64), (64,)),
+                             "inception_4c/"))
+    m.add(inception_layer_v1(512, ((112,), (144, 288), (32, 64), (64,)),
+                             "inception_4d/"))
+    m.add(inception_layer_v1(528, ((256,), (160, 320), (32, 128), (128,)),
+                             "inception_4e/"))
+    m.add(SpatialMaxPooling(3, 3, 2, 2).ceil())
+    m.add(inception_layer_v1(832, ((256,), (160, 320), (32, 128), (128,)),
+                             "inception_5a/"))
+    m.add(inception_layer_v1(832, ((384,), (192, 384), (48, 128), (128,)),
+                             "inception_5b/"))
+    m.add(SpatialAveragePooling(7, 7, 1, 1))
+    m.add(Dropout(0.4))
+    m.add(View(1024).set_num_input_dims(3))
+    m.add(Linear(1024, class_num, name="loss3/classifier"))
+    m.add(LogSoftMax())
+    return m
+
+
+def inception_v1(class_num: int = 1000) -> Sequential:
+    """Full GoogLeNet with the two auxiliary classifier heads; output is the
+    channel-concat of [main, aux2, aux1] log-probabilities
+    (reference ``Inception_v1.scala:104-186``)."""
+    feature1 = _v1_stem()
+
+    output1 = Sequential()
+    output1.add(SpatialAveragePooling(5, 5, 3, 3).ceil())
+    output1.add(_conv(512, 128, 1, 1, name="loss1/conv", xavier=False))
+    output1.add(ReLU())
+    output1.add(View(128 * 4 * 4).set_num_input_dims(3))
+    output1.add(Linear(128 * 4 * 4, 1024, name="loss1/fc"))
+    output1.add(ReLU())
+    output1.add(Dropout(0.7))
+    output1.add(Linear(1024, class_num, name="loss1/classifier"))
+    output1.add(LogSoftMax())
+
+    feature2 = Sequential()
+    feature2.add(inception_layer_v1(512, ((160,), (112, 224), (24, 64), (64,)),
+                                    "inception_4b/"))
+    feature2.add(inception_layer_v1(512, ((128,), (128, 256), (24, 64), (64,)),
+                                    "inception_4c/"))
+    feature2.add(inception_layer_v1(512, ((112,), (144, 288), (32, 64), (64,)),
+                                    "inception_4d/"))
+
+    output2 = Sequential()
+    output2.add(SpatialAveragePooling(5, 5, 3, 3))
+    output2.add(_conv(528, 128, 1, 1, name="loss2/conv", xavier=False))
+    output2.add(ReLU())
+    output2.add(View(128 * 4 * 4).set_num_input_dims(3))
+    output2.add(Linear(128 * 4 * 4, 1024, name="loss2/fc"))
+    output2.add(ReLU())
+    output2.add(Dropout(0.7))
+    output2.add(Linear(1024, class_num, name="loss2/classifier"))
+    output2.add(LogSoftMax())
+
+    output3 = Sequential()
+    output3.add(inception_layer_v1(528, ((256,), (160, 320), (32, 128), (128,)),
+                                   "inception_4e/"))
+    output3.add(SpatialMaxPooling(3, 3, 2, 2).ceil())
+    output3.add(inception_layer_v1(832, ((256,), (160, 320), (32, 128), (128,)),
+                                   "inception_5a/"))
+    output3.add(inception_layer_v1(832, ((384,), (192, 384), (48, 128), (128,)),
+                                   "inception_5b/"))
+    output3.add(SpatialAveragePooling(7, 7, 1, 1))
+    output3.add(Dropout(0.4))
+    output3.add(View(1024).set_num_input_dims(3))
+    output3.add(Linear(1024, class_num, name="loss3/classifier"))
+    output3.add(LogSoftMax())
+
+    split2 = Concat(2).add(output3).add(output2)
+    main_branch = Sequential().add(feature2).add(split2)
+    split1 = Concat(2).add(main_branch).add(output1)
+    return Sequential().add(feature1).add(split1)
+
+
+def _conv_bn(seq, n_in, n_out, kw, kh, sw=1, sh=1, pw=0, ph=0, name="",
+             propagate_back=True):
+    seq.add(SpatialConvolution(n_in, n_out, kw, kh, sw, sh, pw, ph, 1,
+                               propagate_back, name=name))
+    seq.add(SpatialBatchNormalization(n_out, 1e-3))
+    seq.add(ReLU())
+
+
+def inception_layer_v2(input_size, config, name_prefix=""):
+    """BN-Inception block.  ``config = ((c1,), (r3, c3), (r33, c33),
+    (pool_kind, cp))`` where pool_kind in {"max", "avg"}; c1 == 0 drops the
+    1x1 tower and the 3x3 towers stride 2 when cp == 0 under max pooling
+    (reference ``Inception_v2.scala:27-115``)."""
+    concat = Concat(2, name=name_prefix + "output")
+    pool_kind, cp = config[3]
+    reduce_grid = pool_kind == "max" and cp == 0
+
+    if config[0][0] != 0:
+        conv1 = Sequential()
+        _conv_bn(conv1, input_size, config[0][0], 1, 1, name=name_prefix + "1x1")
+        concat.add(conv1)
+
+    conv3 = Sequential()
+    _conv_bn(conv3, input_size, config[1][0], 1, 1,
+             name=name_prefix + "3x3_reduce")
+    stride = 2 if reduce_grid else 1
+    _conv_bn(conv3, config[1][0], config[1][1], 3, 3, stride, stride, 1, 1,
+             name=name_prefix + "3x3")
+    concat.add(conv3)
+
+    conv33 = Sequential()
+    _conv_bn(conv33, input_size, config[2][0], 1, 1,
+             name=name_prefix + "double3x3_reduce")
+    _conv_bn(conv33, config[2][0], config[2][1], 3, 3, 1, 1, 1, 1,
+             name=name_prefix + "double3x3a")
+    _conv_bn(conv33, config[2][1], config[2][1], 3, 3, stride, stride, 1, 1,
+             name=name_prefix + "double3x3b")
+    concat.add(conv33)
+
+    pool = Sequential()
+    if pool_kind == "max":
+        if cp != 0:
+            pool.add(SpatialMaxPooling(3, 3, 1, 1, 1, 1).ceil())
+        else:
+            pool.add(SpatialMaxPooling(3, 3, 2, 2).ceil())
+    elif pool_kind == "avg":
+        p = SpatialAveragePooling(3, 3, 1, 1, 1, 1, ceil_mode=True)
+        pool.add(p)
+    else:
+        raise ValueError(pool_kind)
+    if cp != 0:
+        _conv_bn(pool, input_size, cp, 1, 1, name=name_prefix + "pool_proj")
+    concat.add(pool)
+    return concat
+
+
+_V2_BLOCKS_3 = [
+    (192, ((64,), (64, 64), (64, 96), ("avg", 32)), "inception_3a/"),
+    (256, ((64,), (64, 96), (64, 96), ("avg", 64)), "inception_3b/"),
+    (320, ((0,), (128, 160), (64, 96), ("max", 0)), "inception_3c/"),
+]
+_V2_BLOCKS_4 = [
+    (576, ((224,), (64, 96), (96, 128), ("avg", 128)), "inception_4a/"),
+    (576, ((192,), (96, 128), (96, 128), ("avg", 128)), "inception_4b/"),
+    (576, ((160,), (128, 160), (128, 160), ("avg", 96)), "inception_4c/"),
+    (576, ((96,), (128, 192), (160, 192), ("avg", 96)), "inception_4d/"),
+    (576, ((0,), (128, 192), (192, 256), ("max", 0)), "inception_4e/"),
+]
+_V2_BLOCKS_5 = [
+    (1024, ((352,), (192, 320), (160, 224), ("avg", 128)), "inception_5a/"),
+    (1024, ((352,), (192, 320), (192, 224), ("max", 128)), "inception_5b/"),
+]
+
+
+def _v2_stem():
+    f = Sequential()
+    _conv_bn(f, 3, 64, 7, 7, 2, 2, 3, 3, name="conv1/7x7_s2",
+             propagate_back=False)
+    f.add(SpatialMaxPooling(3, 3, 2, 2).ceil())
+    _conv_bn(f, 64, 64, 1, 1, name="conv2/3x3_reduce")
+    _conv_bn(f, 64, 192, 3, 3, 1, 1, 1, 1, name="conv2/3x3")
+    f.add(SpatialMaxPooling(3, 3, 2, 2).ceil())
+    return f
+
+
+def inception_v2_no_aux_classifier(class_num: int = 1000) -> Sequential:
+    m = _v2_stem()
+    for size, cfg, prefix in _V2_BLOCKS_3 + _V2_BLOCKS_4 + _V2_BLOCKS_5:
+        m.add(inception_layer_v2(size, cfg, prefix))
+    m.add(SpatialAveragePooling(7, 7, 1, 1, ceil_mode=True))
+    m.add(View(1024).set_num_input_dims(3))
+    m.add(Linear(1024, class_num, name="loss3/classifier"))
+    m.add(LogSoftMax())
+    return m
+
+
+def inception_v2(class_num: int = 1000) -> Sequential:
+    features1 = _v2_stem()
+    for size, cfg, prefix in _V2_BLOCKS_3:
+        features1.add(inception_layer_v2(size, cfg, prefix))
+
+    output1 = Sequential()
+    p1 = SpatialAveragePooling(5, 5, 3, 3, ceil_mode=True)
+    output1.add(p1)
+    _conv_bn(output1, 576, 128, 1, 1, name="loss1/conv")
+    output1.add(View(128 * 4 * 4).set_num_input_dims(3))
+    output1.add(Linear(128 * 4 * 4, 1024, name="loss1/fc"))
+    output1.add(ReLU())
+    output1.add(Linear(1024, class_num, name="loss1/classifier"))
+    output1.add(LogSoftMax())
+
+    features2 = Sequential()
+    for size, cfg, prefix in _V2_BLOCKS_4:
+        features2.add(inception_layer_v2(size, cfg, prefix))
+
+    output2 = Sequential()
+    p2 = SpatialAveragePooling(5, 5, 3, 3, ceil_mode=True)
+    output2.add(p2)
+    _conv_bn(output2, 1024, 128, 1, 1, name="loss2/conv")
+    output2.add(View(128 * 2 * 2).set_num_input_dims(3))
+    output2.add(Linear(128 * 2 * 2, 1024, name="loss2/fc"))
+    output2.add(ReLU())
+    output2.add(Linear(1024, class_num, name="loss2/classifier"))
+    output2.add(LogSoftMax())
+
+    output3 = Sequential()
+    for size, cfg, prefix in _V2_BLOCKS_5:
+        output3.add(inception_layer_v2(size, cfg, prefix))
+    output3.add(SpatialAveragePooling(7, 7, 1, 1, ceil_mode=True))
+    output3.add(View(1024).set_num_input_dims(3))
+    output3.add(Linear(1024, class_num, name="loss3/classifier"))
+    output3.add(LogSoftMax())
+
+    split2 = Concat(2).add(output3).add(output2)
+    main_branch = Sequential().add(features2).add(split2)
+    split1 = Concat(2).add(main_branch).add(output1)
+    return Sequential().add(features1).add(split1)

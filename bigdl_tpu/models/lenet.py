@@ -1,0 +1,22 @@
+"""LeNet-5 for MNIST (reference ``models/lenet/LeNet5.scala:25``)."""
+
+from bigdl_tpu.nn import (Sequential, Reshape, SpatialConvolution, Tanh,
+                          SpatialMaxPooling, Linear, LogSoftMax)
+
+
+def lenet5(class_num: int = 10) -> Sequential:
+    """The classic 2-conv 2-fc LeNet: 28x28 grey image -> class_num logits."""
+    m = Sequential()
+    m.add(Reshape((1, 28, 28)))
+    m.add(SpatialConvolution(1, 6, 5, 5, name="conv1_5x5"))
+    m.add(Tanh())
+    m.add(SpatialMaxPooling(2, 2, 2, 2))
+    m.add(Tanh())
+    m.add(SpatialConvolution(6, 12, 5, 5, name="conv2_5x5"))
+    m.add(SpatialMaxPooling(2, 2, 2, 2))
+    m.add(Reshape((12 * 4 * 4,)))
+    m.add(Linear(12 * 4 * 4, 100, name="fc1"))
+    m.add(Tanh())
+    m.add(Linear(100, class_num, name="fc2"))
+    m.add(LogSoftMax())
+    return m

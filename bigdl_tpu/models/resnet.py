@@ -1,0 +1,164 @@
+"""ResNet family — CIFAR-10 and ImageNet variants
+(reference ``models/resnet/ResNet.scala:57,132,211-244``).
+
+TPU note: the reference's ``optnet`` buffer sharing (SpatialShareConvolution,
+shareGradInput) is a CPU memory trick; under XLA buffer reuse is the
+compiler's job, so plain convolutions are used everywhere.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn import (Sequential, SpatialConvolution, SpatialMaxPooling,
+                          SpatialAveragePooling, SpatialBatchNormalization,
+                          ReLU, ConcatTable, CAddTable, Identity, Linear,
+                          View, Concat, MulConstant, Module)
+
+
+class DatasetType:
+    CIFAR10 = "cifar10"
+    IMAGENET = "imagenet"
+
+
+class ShortcutType:
+    A = "A"  # zero-padded identity on dim change
+    B = "B"  # 1x1 conv on dim change, identity otherwise
+    C = "C"  # 1x1 conv everywhere
+
+
+def _shortcut(n_in, n_out, stride, shortcut_type):
+    use_conv = shortcut_type == ShortcutType.C or (
+        shortcut_type == ShortcutType.B and n_in != n_out)
+    if use_conv:
+        s = Sequential()
+        s.add(SpatialConvolution(n_in, n_out, 1, 1, stride, stride))
+        s.add(SpatialBatchNormalization(n_out))
+        return s
+    if n_in != n_out:
+        # Type A: strided subsample then pad channels with zeros by
+        # concatenating a zeroed copy (reference ResNet.scala:139-144).
+        s = Sequential()
+        s.add(SpatialAveragePooling(1, 1, stride, stride))
+        s.add(Concat(2).add(Identity()).add(MulConstant(0.0)))
+        return s
+    return Identity()
+
+
+def _basic_block(n_in, n, stride, shortcut_type):
+    s = Sequential()
+    s.add(SpatialConvolution(n_in, n, 3, 3, stride, stride, 1, 1))
+    s.add(SpatialBatchNormalization(n))
+    s.add(ReLU())
+    s.add(SpatialConvolution(n, n, 3, 3, 1, 1, 1, 1))
+    s.add(SpatialBatchNormalization(n))
+    block = Sequential()
+    block.add(ConcatTable().add(s).add(_shortcut(n_in, n, stride, shortcut_type)))
+    block.add(CAddTable())
+    block.add(ReLU())
+    return block, n
+
+
+def _bottleneck(n_in, n, stride, shortcut_type):
+    s = Sequential()
+    s.add(SpatialConvolution(n_in, n, 1, 1, 1, 1, 0, 0))
+    s.add(SpatialBatchNormalization(n))
+    s.add(ReLU())
+    s.add(SpatialConvolution(n, n, 3, 3, stride, stride, 1, 1))
+    s.add(SpatialBatchNormalization(n))
+    s.add(ReLU())
+    s.add(SpatialConvolution(n, n * 4, 1, 1, 1, 1, 0, 0))
+    s.add(SpatialBatchNormalization(n * 4))
+    block = Sequential()
+    block.add(ConcatTable().add(s).add(_shortcut(n_in, n * 4, stride, shortcut_type)))
+    block.add(CAddTable())
+    block.add(ReLU())
+    return block, n * 4
+
+
+def _layer(block_fn, n_in, features, count, stride, shortcut_type):
+    s = Sequential()
+    for i in range(count):
+        b, n_in = block_fn(n_in, features, stride if i == 0 else 1, shortcut_type)
+        s.add(b)
+    return s, n_in
+
+
+# (block counts per stage, final feature width, block fn)
+_IMAGENET_CFG = {
+    18: ((2, 2, 2, 2), 512, _basic_block),
+    34: ((3, 4, 6, 3), 512, _basic_block),
+    50: ((3, 4, 6, 3), 2048, _bottleneck),
+    101: ((3, 4, 23, 3), 2048, _bottleneck),
+    152: ((3, 8, 36, 3), 2048, _bottleneck),
+    200: ((3, 24, 36, 3), 2048, _bottleneck),
+}
+
+
+def resnet(class_num: int, depth: int = 18,
+           shortcut_type: str = ShortcutType.B,
+           dataset: str = DatasetType.CIFAR10) -> Sequential:
+    model = Sequential()
+    if dataset == DatasetType.IMAGENET:
+        if depth not in _IMAGENET_CFG:
+            raise ValueError(f"Invalid depth {depth}")
+        counts, n_features, block = _IMAGENET_CFG[depth]
+        model.add(SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3))
+        model.add(SpatialBatchNormalization(64))
+        model.add(ReLU())
+        model.add(SpatialMaxPooling(3, 3, 2, 2, 1, 1))
+        ch = 64
+        for i, (features, count) in enumerate(zip((64, 128, 256, 512), counts)):
+            l, ch = _layer(block, ch, features, count, 1 if i == 0 else 2,
+                           shortcut_type)
+            model.add(l)
+        model.add(SpatialAveragePooling(7, 7, 1, 1))
+        model.add(View(n_features).set_num_input_dims(3))
+        model.add(Linear(n_features, class_num))
+    elif dataset == DatasetType.CIFAR10:
+        if (depth - 2) % 6 != 0:
+            raise ValueError("depth should be one of 20, 32, 44, 56, 110, 1202")
+        n = (depth - 2) // 6
+        model.add(SpatialConvolution(3, 16, 3, 3, 1, 1, 1, 1))
+        model.add(SpatialBatchNormalization(16))
+        model.add(ReLU())
+        ch = 16
+        l, ch = _layer(_basic_block, ch, 16, n, 1, shortcut_type)
+        model.add(l)
+        l, ch = _layer(_basic_block, ch, 32, n, 2, shortcut_type)
+        model.add(l)
+        l, ch = _layer(_basic_block, ch, 64, n, 2, shortcut_type)
+        model.add(l)
+        model.add(SpatialAveragePooling(8, 8, 1, 1))
+        model.add(View(64).set_num_input_dims(3))
+        model.add(Linear(64, class_num))
+    else:
+        raise ValueError(f"Unknown dataset {dataset}")
+    return model
+
+
+def model_init(model: Module, rng=None) -> Module:
+    """He-init convolutions, (1, 0) batchnorm, zero linear bias
+    (reference ``ResNet.modelInit``, ``models/resnet/ResNet.scala:103-130``)."""
+    model._ensure_init()
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    for m in model.modules():
+        if isinstance(m, SpatialConvolution):
+            rng, k = jax.random.split(rng)
+            n = m.kernel_w * m.kernel_w * m.n_output_plane
+            w = m.params["weight"]
+            m.params["weight"] = (jax.random.normal(k, w.shape, w.dtype)
+                                  * math.sqrt(2.0 / n))
+            if m.with_bias:
+                m.params["bias"] = jnp.zeros_like(m.params["bias"])
+        elif isinstance(m, SpatialBatchNormalization):
+            if "weight" in m.params:
+                m.params["weight"] = jnp.ones_like(m.params["weight"])
+            if "bias" in m.params:
+                m.params["bias"] = jnp.zeros_like(m.params["bias"])
+        elif isinstance(m, Linear):
+            if "bias" in m.params:
+                m.params["bias"] = jnp.zeros_like(m.params["bias"])
+    return model

@@ -1,0 +1,22 @@
+"""Character-level simple RNN language model
+(reference ``models/rnn/SimpleRNN.scala:22``)."""
+
+from bigdl_tpu.nn import (Sequential, Recurrent, RnnCell, Tanh,
+                          TimeDistributed, Linear, LogSoftMax)
+
+
+def simple_rnn(input_size: int, hidden_size: int, output_size: int) -> Sequential:
+    m = Sequential()
+    m.add(Recurrent().add(RnnCell(input_size, hidden_size, Tanh())))
+    m.add(TimeDistributed(Linear(hidden_size, output_size)))
+    return m
+
+
+def lstm_lm(input_size: int, hidden_size: int, output_size: int) -> Sequential:
+    """LSTM language model used by the PTB-style config (BASELINE #5)."""
+    from bigdl_tpu.nn import LSTM
+    m = Sequential()
+    m.add(Recurrent().add(LSTM(input_size, hidden_size)))
+    m.add(TimeDistributed(Linear(hidden_size, output_size)))
+    m.add(TimeDistributed(LogSoftMax()))
+    return m

@@ -1,0 +1,33 @@
+"""Text-classification CNN over word embeddings
+(reference ``example/utils/TextClassifier.scala:171``).
+
+Input: (batch, seq_len, embedding_dim) GloVe-embedded token sequences.
+The reference reshapes to a (embedding_dim, 1, seq_len) image and runs
+SpatialConvolution as a temporal conv; here TemporalConvolution maps
+directly onto a single MXU matmul per window.
+"""
+
+from bigdl_tpu.nn import (Sequential, Reshape, Transpose, SpatialConvolution,
+                          SpatialMaxPooling, ReLU, Linear, LogSoftMax)
+
+
+def text_classifier(class_num: int, embedding_dim: int = 200,
+                    sequence_length: int = 1000) -> Sequential:
+    m = Sequential()
+    # (batch, seq, embed) -> (batch, embed, 1, seq) image
+    m.add(Transpose([(2, 3)]))
+    m.add(Reshape((embedding_dim, 1, sequence_length)))
+    m.add(SpatialConvolution(embedding_dim, 128, 5, 1))
+    m.add(ReLU())
+    m.add(SpatialMaxPooling(5, 1, 5, 1))
+    m.add(SpatialConvolution(128, 128, 5, 1))
+    m.add(ReLU())
+    m.add(SpatialMaxPooling(5, 1, 5, 1))
+    m.add(SpatialConvolution(128, 128, 5, 1))
+    m.add(ReLU())
+    m.add(SpatialMaxPooling(35, 1, 35, 1))
+    m.add(Reshape((128,)))
+    m.add(Linear(128, 100))
+    m.add(Linear(100, class_num))
+    m.add(LogSoftMax())
+    return m

@@ -1,0 +1,74 @@
+"""VGG family (reference ``models/vgg/VggForCifar10.scala:22,71,124``)."""
+
+from bigdl_tpu.nn import (Sequential, SpatialConvolution, SpatialMaxPooling,
+                          SpatialBatchNormalization, BatchNormalization, ReLU,
+                          Dropout, View, Linear, LogSoftMax, Threshold)
+
+
+def vgg_for_cifar10(class_num: int = 10) -> Sequential:
+    """VGG-16-style BN+Dropout net for 32x32 CIFAR-10 images."""
+    m = Sequential()
+
+    def conv_bn_relu(n_in, n_out):
+        m.add(SpatialConvolution(n_in, n_out, 3, 3, 1, 1, 1, 1))
+        m.add(SpatialBatchNormalization(n_out, 1e-3))
+        m.add(ReLU())
+
+    conv_bn_relu(3, 64); m.add(Dropout(0.3))
+    conv_bn_relu(64, 64)
+    m.add(SpatialMaxPooling(2, 2, 2, 2).ceil())
+    conv_bn_relu(64, 128); m.add(Dropout(0.4))
+    conv_bn_relu(128, 128)
+    m.add(SpatialMaxPooling(2, 2, 2, 2).ceil())
+    conv_bn_relu(128, 256); m.add(Dropout(0.4))
+    conv_bn_relu(256, 256); m.add(Dropout(0.4))
+    conv_bn_relu(256, 256)
+    m.add(SpatialMaxPooling(2, 2, 2, 2).ceil())
+    conv_bn_relu(256, 512); m.add(Dropout(0.4))
+    conv_bn_relu(512, 512); m.add(Dropout(0.4))
+    conv_bn_relu(512, 512)
+    m.add(SpatialMaxPooling(2, 2, 2, 2).ceil())
+    conv_bn_relu(512, 512); m.add(Dropout(0.4))
+    conv_bn_relu(512, 512); m.add(Dropout(0.4))
+    conv_bn_relu(512, 512)
+    m.add(SpatialMaxPooling(2, 2, 2, 2).ceil())
+    m.add(View(512))
+
+    m.add(Dropout(0.5))
+    m.add(Linear(512, 512))
+    m.add(BatchNormalization(512))
+    m.add(ReLU())
+    m.add(Dropout(0.5))
+    m.add(Linear(512, class_num))
+    m.add(LogSoftMax())
+    return m
+
+
+def _vgg_imagenet(block_convs, class_num: int) -> Sequential:
+    m = Sequential()
+    n_in = 3
+    widths = (64, 128, 256, 512, 512)
+    for width, n_convs in zip(widths, block_convs):
+        for _ in range(n_convs):
+            m.add(SpatialConvolution(n_in, width, 3, 3, 1, 1, 1, 1))
+            m.add(ReLU())
+            n_in = width
+        m.add(SpatialMaxPooling(2, 2, 2, 2))
+    m.add(View(512 * 7 * 7))
+    m.add(Linear(512 * 7 * 7, 4096))
+    m.add(Threshold(0, 1e-6))
+    m.add(Dropout(0.5))
+    m.add(Linear(4096, 4096))
+    m.add(Threshold(0, 1e-6))
+    m.add(Dropout(0.5))
+    m.add(Linear(4096, class_num))
+    m.add(LogSoftMax())
+    return m
+
+
+def vgg16(class_num: int = 1000) -> Sequential:
+    return _vgg_imagenet((2, 2, 3, 3, 3), class_num)
+
+
+def vgg19(class_num: int = 1000) -> Sequential:
+    return _vgg_imagenet((2, 2, 4, 4, 4), class_num)

@@ -42,7 +42,8 @@ from bigdl_tpu.nn.structural import (Identity, Echo, Contiguous, Reshape,
                                      MaskedSelect, Max, Min, Mean, Sum,
                                      Replicate, Padding, SpatialZeroPadding,
                                      GradientReversal, Scale, Bottle, MM, MV,
-                                     DotProduct, Pack, Reverse)
+                                     DotProduct, Pack, Reverse,
+                                     MulConstant, AddConstant)
 from bigdl_tpu.nn.table import (Concat, ConcatTable, ParallelTable, MapTable,
                                 JoinTable, SplitTable, SelectTable,
                                 NarrowTable, FlattenTable, MixtureTable,

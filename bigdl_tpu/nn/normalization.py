@@ -121,9 +121,13 @@ class SpatialCrossMapLRN(Module):
         half = (self.size - 1) // 2
         pad_lo, pad_hi = half, self.size - 1 - half
         padded = jnp.pad(sq, ((0, 0), (pad_lo, pad_hi), (0, 0), (0, 0)))
-        window = jax.lax.reduce_window(
-            padded, 0.0, jax.lax.add, (1, self.size, 1, 1), (1, 1, 1, 1),
-            "valid")
+        # static unrolled window sum over the small channel window; avoids
+        # lax.reduce_window over the non-minor channel dim, which the TPU
+        # backend lays out poorly (and miscompiles under AOT).
+        c = input.shape[1]
+        window = padded[:, 0:c]
+        for i in range(1, self.size):
+            window = window + padded[:, i:i + c]
         denom = (self.k + self.alpha / self.size * window) ** self.beta
         return input / denom, state
 

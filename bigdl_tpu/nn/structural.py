@@ -94,10 +94,12 @@ class View(Module):
 
     def apply(self, params, input, state, training=False, rng=None):
         sizes = self.sizes
+        if self.num_input_dims > 0 and input.ndim > self.num_input_dims:
+            batch = input.shape[:input.ndim - self.num_input_dims]
+            return jnp.reshape(input, batch + sizes), state
         n = int(np.prod([s for s in sizes if s != -1]))
-        total = int(np.prod(input.shape))
-        if -1 not in sizes and total != n and input.shape \
-                and total == n * input.shape[0]:
+        if (-1 not in sizes and input.ndim > len(sizes)
+                and int(np.prod(input.shape[1:])) == n):
             return jnp.reshape(input, (input.shape[0],) + sizes), state
         return jnp.reshape(input, sizes), state
 
@@ -466,3 +468,25 @@ class Reverse(Module):
 
     def apply(self, params, input, state, training=False, rng=None):
         return jnp.flip(input, axis=self.dimension - 1), state
+
+
+class MulConstant(Module):
+    """Multiply by a scalar constant (reference ``nn/MulConstant.scala``)."""
+
+    def __init__(self, constant_scalar: float, inplace: bool = False, name=None):
+        super().__init__(name)
+        self.constant = constant_scalar
+
+    def apply(self, params, input, state, training=False, rng=None):
+        return input * self.constant, state
+
+
+class AddConstant(Module):
+    """Add a scalar constant (reference ``nn/AddConstant.scala``)."""
+
+    def __init__(self, constant_scalar: float, inplace: bool = False, name=None):
+        super().__init__(name)
+        self.constant = constant_scalar
+
+    def apply(self, params, input, state, training=False, rng=None):
+        return input + self.constant, state

@@ -31,3 +31,7 @@ jax.config.update("jax_default_matmul_precision", "highest")
 def _seed():
     np.random.seed(0)
     yield
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running model builds")
