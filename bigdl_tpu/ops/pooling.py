@@ -45,8 +45,9 @@ def max_pool2d(x: jnp.ndarray, kernel: Tuple[int, int],
     dims[h_ax], dims[w_ax] = kh, kw
     strides = [1] * x.ndim
     strides[h_ax], strides[w_ax] = sh, sw
-    neg_inf = jnp.asarray(-jnp.inf, x.dtype)
-    return lax.reduce_window(x, neg_inf, lax.max, tuple(dims), tuple(strides),
+    # Python-scalar init value: an array init defeats XLA's monoid
+    # recognition and breaks linearization under jit(value_and_grad).
+    return lax.reduce_window(x, -jnp.inf, lax.max, tuple(dims), tuple(strides),
                              tuple(pads))
 
 
@@ -65,12 +66,12 @@ def avg_pool2d(x: jnp.ndarray, kernel: Tuple[int, int],
     dims[h_ax], dims[w_ax] = kh, kw
     strides = [1] * x.ndim
     strides[h_ax], strides[w_ax] = sh, sw
-    summed = lax.reduce_window(x, jnp.asarray(0, x.dtype), lax.add,
+    summed = lax.reduce_window(x, 0.0, lax.add,
                                tuple(dims), tuple(strides), tuple(pads))
     if count_include_pad:
         return summed / (kh * kw)
     ones = jnp.ones_like(x)
-    counts = lax.reduce_window(ones, jnp.asarray(0, x.dtype), lax.add,
+    counts = lax.reduce_window(ones, 0.0, lax.add,
                                tuple(dims), tuple(strides), tuple(pads))
     return summed / counts
 
@@ -83,8 +84,7 @@ def max_pool3d(x: jnp.ndarray, kernel, stride, padding=(0, 0, 0),
         for i, p in enumerate(padding)]
     dims = (1, 1) + tuple(kernel)
     strides = (1, 1) + tuple(stride)
-    neg_inf = jnp.asarray(-jnp.inf, x.dtype)
-    return lax.reduce_window(x, neg_inf, lax.max, dims, strides, tuple(pads))
+    return lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, tuple(pads))
 
 
 def avg_pool3d(x: jnp.ndarray, kernel, stride, padding=(0, 0, 0),
@@ -94,12 +94,12 @@ def avg_pool3d(x: jnp.ndarray, kernel, stride, padding=(0, 0, 0),
         for i, p in enumerate(padding)]
     dims = (1, 1) + tuple(kernel)
     strides = (1, 1) + tuple(stride)
-    summed = lax.reduce_window(x, jnp.asarray(0, x.dtype), lax.add, dims,
+    summed = lax.reduce_window(x, 0.0, lax.add, dims,
                                strides, tuple(pads))
     if count_include_pad:
         return summed / float(np_prod(kernel))
     ones = jnp.ones_like(x)
-    counts = lax.reduce_window(ones, jnp.asarray(0, x.dtype), lax.add, dims,
+    counts = lax.reduce_window(ones, 0.0, lax.add, dims,
                                strides, tuple(pads))
     return summed / counts
 

@@ -163,6 +163,117 @@ class Optimizer:
     def optimize(self) -> Module:
         raise NotImplementedError
 
+    # -- shared driver loop (used by Local and Distri trainers) -----------
+
+    def _drive(self, fetch_batch, run_step, reset_epoch, publish,
+               epoch_size: int) -> Dict[str, Any]:
+        """The per-iteration driver loop both trainers share (reference
+        ``optim/DistriOptimizer.scala:141-344`` / ``LocalOptimizer.scala:78``):
+        fetch, step, bookkeeping/logging, epoch rollover, trigger-gated
+        validation + checkpoint.
+
+        ``fetch_batch() -> (inputs, targets, batch_size)`` and
+        ``run_step(inputs, targets, hyper, rng) -> loss`` close over the
+        trainer's device-resident carries; ``publish()`` syncs those carries
+        back into the model/optim shells — called only when a trigger fires
+        (the reference's getModel runs only at checkpoints, ``:818``) and
+        once at the end.
+        """
+        state = _initial_driver_state()
+        stochastic = self.model.is_stochastic()
+        rng_counter = 0
+        wall_start = time.time()
+
+        while not self.end_when(state):
+            t_data = time.time_ns()
+            inputs, targets, bsz = fetch_batch()
+            self.metrics.add("get batch time", time.time_ns() - t_data)
+
+            self.optim_method.state["epoch"] = state["epoch"]
+            hyper = self.optim_method.hyper()
+            rng = (jax.random.PRNGKey(rng_counter) if stochastic else
+                   jax.random.PRNGKey(0))
+            rng_counter += 1
+
+            t0 = time.time_ns()
+            loss = float(run_step(inputs, targets, hyper, rng))
+            self.optim_method.step_done()
+            dt = time.time_ns() - t0
+            self.metrics.add("computing time for each node", dt)
+
+            state["Loss"] = loss
+            state["recordsProcessedThisEpoch"] += bsz
+            throughput = bsz / max(dt / 1e9, 1e-9)
+            logger.info(
+                "[Epoch %d %d/%d][Iteration %d] Train %d in %.4f seconds. "
+                "Throughput is %.1f records/second. Loss is %.6f.",
+                state["epoch"], state["recordsProcessedThisEpoch"],
+                epoch_size, state["neval"], bsz, dt / 1e9, throughput, loss)
+            self._summarize_train(loss, throughput, state["neval"])
+
+            # epoch rollover + reshuffle (reference DistriOptimizer:333-344)
+            if state["recordsProcessedThisEpoch"] >= epoch_size:
+                state["epoch"] += 1
+                state["recordsProcessedThisEpoch"] = 0
+                reset_epoch()
+
+            state["neval"] += 1
+
+            v_due = self._validation_due(state)
+            c_due = self._checkpoint_due(state)
+            if v_due or c_due:
+                publish()
+                if v_due:
+                    self._run_validation(state)
+                if c_due:
+                    self._run_checkpoint(state)
+
+        publish()
+        logger.info("Training finished in %.1f s.", time.time() - wall_start)
+        return state
+
+    def _publish(self, params, slots, mstate) -> None:
+        """Sync the jitted-loop carries back into the stateful shell so
+        validation/checkpoint/users see current values."""
+        self.model.params = params
+        self.model.state = mstate
+        if isinstance(self.model, Container):
+            self.model._adopt()
+        self.optim_method.set_slots(slots)
+
+    def _validation_due(self, state) -> bool:
+        return (self.validation_trigger is not None and
+                self.validation_dataset is not None and
+                self.validation_trigger(state))
+
+    def _run_validation(self, state) -> None:
+        from bigdl_tpu.optim.evaluator import evaluate_dataset
+        results = evaluate_dataset(self.model, self.validation_dataset,
+                                   self.validation_methods)
+        for method, res in results:
+            logger.info("%s is %s", method.name, res)
+            state["score"] = res.final_result()
+            self.optim_method.state["score"] = res.final_result()
+            if self.validation_summary is not None:
+                self.validation_summary.add_scalar(
+                    method.name, res.final_result(), state["neval"] - 1)
+
+    def _checkpoint_due(self, state) -> bool:
+        return self.checkpoint is not None and self.checkpoint.trigger(state)
+
+    def _run_checkpoint(self, state) -> None:
+        self.checkpoint.save(self.model, self.optim_method,
+                             state["neval"] - 1)
+
+    def _summarize_train(self, loss: float, throughput: float,
+                         neval: int) -> None:
+        if self.train_summary is None:
+            return
+        self.train_summary.add_scalar("Loss", loss, neval)
+        self.train_summary.add_scalar("Throughput", throughput, neval)
+        self.train_summary.add_scalar(
+            "LearningRate", self.optim_method.get_learning_rate(), neval)
+
     # -- factory ----------------------------------------------------------
 
     @staticmethod
@@ -242,106 +353,38 @@ class LocalOptimizer(Optimizer):
         model = self.model
         model.training()
         model._ensure_init()
-        state = _initial_driver_state()
-        epoch_size = _epoch_records(self.dataset)
 
-        params = model.params
-        mstate = model.state
-        slots = self.optim_method.slots(params)
+        carry = {"params": model.params, "mstate": model.state,
+                 "slots": self.optim_method.slots(model.params)}
         self.optim_method.state["epoch"] = 1
         if self._step_fn is None:
             self._step_fn = self._build_step()
 
-        self.dataset.shuffle()
-        data_iter = self.dataset.data(train=True)
-        stochastic = model.is_stochastic()
-        rng_counter = 0
-        wall_start = time.time()
+        it = {"data": None}
 
-        while not self.end_when(state):
-            batch = next(data_iter)
-            inputs = _to_device(batch.get_input())
-            targets = _to_device(batch.get_target())
-            bsz = batch.size()
+        def reset_epoch():
+            self.dataset.shuffle()
+            it["data"] = self.dataset.data(train=True)
 
-            self.optim_method.state["epoch"] = state["epoch"]
-            hyper = self.optim_method.hyper()
-            rng = (jax.random.PRNGKey(rng_counter) if stochastic else
-                   jax.random.PRNGKey(0))
-            rng_counter += 1
+        def fetch_batch():
+            batch = next(it["data"])
+            return (_to_device(batch.get_input()),
+                    _to_device(batch.get_target()), batch.size())
 
-            t0 = time.time_ns()
-            params, slots, mstate, loss = self._step_fn(
-                params, slots, mstate, inputs, targets, hyper, rng)
-            self.optim_method.step_done()
-            loss = float(loss)
-            dt = time.time_ns() - t0
-            self.metrics.add("computing time for each node", dt)
+        def run_step(inputs, targets, hyper, rng):
+            (carry["params"], carry["slots"], carry["mstate"],
+             loss) = self._step_fn(carry["params"], carry["slots"],
+                                   carry["mstate"], inputs, targets,
+                                   hyper, rng)
+            return loss
 
-            state["Loss"] = loss
-            state["recordsProcessedThisEpoch"] += bsz
-            throughput = bsz / max(dt / 1e9, 1e-9)
-            logger.info(
-                "[Epoch %d %d/%d][Iteration %d] Train %d in %.4f seconds. "
-                "Throughput is %.1f records/second. Loss is %.6f.",
-                state["epoch"], state["recordsProcessedThisEpoch"],
-                epoch_size, state["neval"], bsz, dt / 1e9, throughput, loss)
+        def publish():
+            self._publish(carry["params"], carry["slots"], carry["mstate"])
 
-            if self.train_summary is not None:
-                self.train_summary.add_scalar("Loss", loss, state["neval"])
-                self.train_summary.add_scalar("Throughput", throughput,
-                                              state["neval"])
-                lr = self.optim_method.get_learning_rate()
-                self.train_summary.add_scalar("LearningRate", lr,
-                                              state["neval"])
-
-            # epoch rollover + reshuffle (reference DistriOptimizer:333-344)
-            if state["recordsProcessedThisEpoch"] >= epoch_size:
-                state["epoch"] += 1
-                state["recordsProcessedThisEpoch"] = 0
-                self.dataset.shuffle()
-                data_iter = self.dataset.data(train=True)
-
-            state["neval"] += 1
-
-            # sync shell before validation/checkpoint see the params
-            self._publish(params, slots, mstate)
-            self._validate(state)
-            self._checkpoint(state)
-
-        self._publish(params, slots, mstate)
-        logger.info("Training finished in %.1f s.", time.time() - wall_start)
+        reset_epoch()
+        self._drive(fetch_batch, run_step, reset_epoch, publish,
+                    epoch_size=_epoch_records(self.dataset))
         return model
-
-    # -- helpers ----------------------------------------------------------
-
-    def _publish(self, params, slots, mstate) -> None:
-        self.model.params = params
-        self.model.state = mstate
-        if isinstance(self.model, Container):
-            self.model._adopt()
-        self.optim_method.set_slots(slots)
-
-    def _validate(self, state) -> None:
-        if (self.validation_trigger is None or
-                self.validation_dataset is None or
-                not self.validation_trigger(state)):
-            return
-        from bigdl_tpu.optim.evaluator import evaluate_dataset
-        results = evaluate_dataset(self.model, self.validation_dataset,
-                                   self.validation_methods)
-        for method, res in results:
-            logger.info("%s is %s", method.name, res)
-            state["score"] = res.final_result()
-            self.optim_method.state["score"] = res.final_result()
-            if self.validation_summary is not None:
-                self.validation_summary.add_scalar(
-                    method.name, res.final_result(), state["neval"] - 1)
-
-    def _checkpoint(self, state) -> None:
-        if self.checkpoint is not None and self.checkpoint.trigger(state):
-            self.checkpoint.save(self.model, self.optim_method,
-                                 state["neval"] - 1)
 
 
 def _epoch_records(ds: AbstractDataSet) -> int:

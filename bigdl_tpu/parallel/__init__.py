@@ -1,0 +1,12 @@
+"""bigdl_tpu.parallel — the distributed training half of the framework.
+
+Reference equivalents: ``parameters/`` (AllReduceParameter over Spark's
+BlockManager) and ``optim/DistriOptimizer.scala`` — rebuilt TPU-first as XLA
+collectives (``psum_scatter`` / ``all_gather``) under ``shard_map`` over a
+``jax.sharding.Mesh`` (SURVEY §2.4, §2.12).
+"""
+
+from bigdl_tpu.parallel.all_reduce import AllReduceParameter
+from bigdl_tpu.parallel.distri_optimizer import DistriOptimizer
+
+__all__ = ["AllReduceParameter", "DistriOptimizer"]

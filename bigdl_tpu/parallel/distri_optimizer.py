@@ -1,0 +1,238 @@
+"""DistriOptimizer: the distributed synchronous-SGD trainer.
+
+Reference equivalent: ``optim/DistriOptimizer.scala:89-330`` — per-iteration:
+weight all-gather, per-partition forward/backward, gradient scatter,
+partition-sharded optimizer update, weight republish — over Spark's
+BlockManager (``parameters/AllReduceParameter.scala:67-295``).
+
+TPU-native redesign: the whole per-iteration exchange is ONE jitted
+``shard_map`` over ``Engine.default_mesh()``'s ``data`` axis:
+
+    per-shard forward/backward  (local minibatch, replicated params)
+    → ``psum_scatter``          gradient reduce-scatter over ICI
+    → sharded optimizer update  (each device updates its 1/N parameter slice
+                                 and owns 1/N of the optimizer slots: ZeRO-1,
+                                 the reference's partition-sharded update)
+    → ``all_gather``            weight reassembly
+
+There are no per-iteration host round-trips: params stay device-resident as
+one replicated flat vector, slots stay sharded across the mesh, and the
+driver only reads back the scalar loss.  fp16 wire compression maps to an
+optional bf16 cast on the reduce-scatter (``compression='bf16'``).
+
+Straggler mitigation (reference ``:192-216,302-330``) is structurally N/A:
+XLA collectives over ICI are bulk-synchronous with no partial participation;
+the API knob on :class:`Optimizer` is kept inert for parity.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bigdl_tpu.engine import Engine
+from bigdl_tpu.dataset.dataset import ShardedDataSet
+from bigdl_tpu.nn.module import Criterion, Module
+from bigdl_tpu.optim.optimizer import Optimizer, regularization_penalty
+from bigdl_tpu.parallel.all_reduce import AllReduceParameter
+
+logger = logging.getLogger("bigdl_tpu")
+
+
+def _pmean_float(tree, axis: str):
+    """Average float leaves across the axis (keeps BatchNorm running stats
+    consistent between replicas); non-float leaves pass through (they evolve
+    identically on every shard)."""
+    def f(x):
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+            return lax.pmean(x, axis)
+        return x
+    return jax.tree_util.tree_map(f, tree)
+
+
+class DistriOptimizer(Optimizer):
+    """Data-parallel trainer over a device mesh
+    (reference ``optim/DistriOptimizer.scala:689``).
+
+    ``dataset`` must be a :class:`ShardedDataSet` whose ``partition_num``
+    equals the mesh's ``data``-axis size (the reference enforces
+    partition == node at ``DistriOptimizer.scala:492-494``).
+    """
+
+    def __init__(self, model: Module, dataset: ShardedDataSet,
+                 criterion: Criterion, mesh: Optional[Mesh] = None,
+                 compression: Optional[str] = None):
+        super().__init__(model, dataset, criterion)
+        self._mesh = mesh
+        self.compression = compression
+        self._arp: Optional[AllReduceParameter] = None
+
+    @property
+    def mesh(self) -> Mesh:
+        if self._mesh is None:
+            self._mesh = Engine.default_mesh()
+        return self._mesh
+
+    def set_mesh(self, mesh: Mesh) -> "DistriOptimizer":
+        self._mesh = mesh
+        self._step_fn = None
+        return self
+
+    # ---- the fused sharded step ----------------------------------------
+
+    def _build_step(self, arp: AllReduceParameter):
+        from bigdl_tpu.parallel.all_reduce import shard_map
+
+        model, criterion, optim = self.model, self.criterion, self.optim_method
+        mesh, axis = self.mesh, "data"
+        n = mesh.shape[axis]
+
+        def shard_step(flat_params, slots, mstate, inputs, targets, hyper, rng):
+            # distinct dropout masks per shard, like the reference's
+            # independently-seeded model replicas
+            rng = jax.random.fold_in(rng, lax.axis_index(axis))
+
+            def loss_fn(flat):
+                p = arp.unflatten(flat)
+                out, new_mstate = model.apply(p, inputs, mstate,
+                                              training=True, rng=rng)
+                loss = criterion.apply(out, targets)
+                loss = loss + regularization_penalty(model, p)
+                return loss, new_mstate
+
+            (loss, new_mstate), flat_grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(flat_params)
+
+            # reduce-scatter: own gradient slice, summed over shards
+            grad_shard = arp.reduce_scatter_gradients(flat_grads, axis) / n
+            # ZeRO-1: update only this device's parameter slice + slots
+            param_shard = arp.local_shard(flat_params, axis)
+            new_shard, new_slots = optim.pure_update(grad_shard, param_shard,
+                                                     slots, hyper)
+            # all-gather the updated weights for the next forward
+            new_flat = arp.all_gather_weights(new_shard, axis)
+
+            loss = lax.pmean(loss, axis)
+            new_mstate = _pmean_float(new_mstate, axis)
+            return new_flat, new_slots, new_mstate, loss
+
+        pspec_rep = P()
+        pspec_batch = P(axis)
+        sharded = shard_map(
+            shard_step, mesh=mesh,
+            in_specs=(pspec_rep,                          # flat params
+                      P(axis),                            # slot shards
+                      pspec_rep,                          # module state
+                      pspec_batch, pspec_batch,           # inputs, targets
+                      pspec_rep, pspec_rep),              # hyper, rng
+            out_specs=(pspec_rep, P(axis), pspec_rep, pspec_rep),
+            check_rep=False)
+        return jax.jit(sharded, donate_argnums=(0, 1, 2))
+
+    # ---- driver loop ----------------------------------------------------
+
+    def optimize(self) -> Module:
+        model, mesh = self.model, self.mesh
+        axis_size = mesh.shape["data"]
+        if self.dataset.partition_num != axis_size:
+            raise ValueError(
+                f"dataset has {self.dataset.partition_num} partitions but the "
+                f"mesh 'data' axis has {axis_size} devices — they must match "
+                "(reference DistriOptimizer.scala:492)")
+
+        model.training()
+        model._ensure_init()
+
+        arp = AllReduceParameter(model.params, axis_size, self.compression)
+        self._arp = arp
+        carry = {
+            "flat": jax.device_put(arp.flatten(model.params),
+                                   NamedSharding(mesh, P())),
+            # slots live sharded across the mesh: each device owns 1/N (ZeRO-1)
+            "slots": jax.device_put(self._flat_slots(arp),
+                                    NamedSharding(mesh, P("data"))),
+            "mstate": jax.device_put(model.state, NamedSharding(mesh, P())),
+        }
+        self.optim_method.state.setdefault("epoch", 1)
+
+        if self._step_fn is None:
+            self._step_fn = self._build_step(arp)
+
+        batch_sharding = NamedSharding(mesh, P("data"))
+        it = {"shards": None}
+
+        def reset_epoch():
+            self.dataset.shuffle()
+            it["shards"] = [self.dataset.shard_data(p, train=True)
+                            for p in range(self.dataset.partition_num)]
+
+        def fetch_batch():
+            return _global_batch(it["shards"], batch_sharding)
+
+        def run_step(inputs, targets, hyper, rng):
+            (carry["flat"], carry["slots"], carry["mstate"],
+             loss) = self._step_fn(carry["flat"], carry["slots"],
+                                   carry["mstate"], inputs, targets,
+                                   hyper, rng)
+            return loss
+
+        def publish():
+            # slots leave the device in the same per-parameter pytree format
+            # every host-side consumer (checkpoint resume, OptimMethod.update,
+            # a later LocalOptimizer) expects
+            self._sharded_slots = carry["slots"]
+            unflat_slots = jax.tree_util.tree_map(arp.unflatten,
+                                                  carry["slots"])
+            self._publish(arp.unflatten(carry["flat"]), unflat_slots,
+                          carry["mstate"])
+
+        reset_epoch()
+        self._drive(fetch_batch, run_step, reset_epoch, publish,
+                    epoch_size=self.dataset.size())
+        return model
+
+    def _flat_slots(self, arp: AllReduceParameter):
+        """Optimizer slots as flat padded vectors.  Fresh runs start from
+        zeros; a resumed/reused OptimMethod carries slots in the canonical
+        per-parameter pytree format, which is re-flattened here."""
+        cached = self.optim_method._slots
+        if cached is None:
+            return self.optim_method.init_slots(
+                jnp.zeros((arp.padded_size,), arp.dtype))
+        outer = jax.tree_util.tree_structure(
+            self.optim_method.init_slots(jnp.zeros(())))
+        subtrees = outer.flatten_up_to(cached)
+        return jax.tree_util.tree_unflatten(
+            outer, [arp.flatten(s) for s in subtrees])
+
+
+def _global_batch(shard_iters, batch_sharding):
+    """Pull one minibatch per shard, concatenate host-side into the global
+    batch, and place it sharded over the mesh's data axis (each device gets
+    exactly its shard's records — the reference's locality-preserving zip,
+    ``ZippedPartitionsWithLocalityRDD.scala:28``)."""
+    batches = [next(it) for it in shard_iters]
+    inputs = _cat([b.get_input() for b in batches])
+    targets = _cat([b.get_target() for b in batches])
+    bsz = sum(b.size() for b in batches)
+    inputs = jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, batch_sharding), inputs)
+    targets = jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, batch_sharding), targets)
+    return inputs, targets, bsz
+
+
+def _cat(parts):
+    """Concatenate per-shard activities (arrays or nested lists of arrays)
+    along the batch axis."""
+    first = parts[0]
+    if isinstance(first, (list, tuple)):
+        return type(first)(_cat([p[i] for p in parts])
+                           for i in range(len(first)))
+    return np.concatenate([np.asarray(p) for p in parts], axis=0)

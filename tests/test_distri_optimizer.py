@@ -1,0 +1,226 @@
+"""DistriOptimizer tests on the virtual 8-device CPU mesh.
+
+Reference analogs: ``optim/DistriOptimizerSpec`` (convergence on separable
+data, 4 simulated nodes in one JVM) and ``optim/RefDistriOptimizerSpec``
+(agreement with a deliberately naive single-process oracle — here the
+LocalOptimizer plays the oracle).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import bigdl_tpu.nn as nn
+import bigdl_tpu.optim as optim
+from bigdl_tpu.engine import Engine
+from bigdl_tpu.dataset import Sample, SampleToMiniBatch
+from bigdl_tpu.dataset.dataset import LocalDataSet, ShardedDataSet
+from bigdl_tpu.dataset.datasets import synthetic_separable
+from bigdl_tpu.optim.evaluator import Evaluator
+from bigdl_tpu.parallel import AllReduceParameter, DistriOptimizer
+
+N_DEV = 8
+
+
+def _mlp(din, nclass, seed=5):
+    m = (nn.Sequential()
+         .add(nn.Linear(din, 16))
+         .add(nn.Tanh())
+         .add(nn.Linear(16, nclass))
+         .add(nn.LogSoftMax()))
+    m.reset(jax.random.PRNGKey(seed))
+    return m
+
+
+class TestAllReduceParameter:
+    def test_flatten_roundtrip_with_padding(self):
+        params = {"w": jnp.arange(10, dtype=jnp.float32).reshape(2, 5),
+                  "b": jnp.ones((3,))}
+        arp = AllReduceParameter(params, 8)
+        assert arp.padded_size % 8 == 0
+        flat = arp.flatten(params)
+        assert flat.shape == (arp.padded_size,)
+        back = arp.unflatten(flat)
+        np.testing.assert_array_equal(np.asarray(back["w"]),
+                                      np.asarray(params["w"]))
+        np.testing.assert_array_equal(np.asarray(back["b"]),
+                                      np.asarray(params["b"]))
+
+    def test_collectives_shape(self):
+        """reduce-scatter + all-gather roundtrip under shard_map."""
+        from bigdl_tpu.parallel.all_reduce import shard_map
+        mesh = Engine.create_mesh((N_DEV,), ("data",))
+        params = {"w": jnp.ones((4, 5))}
+        arp = AllReduceParameter(params, N_DEV)
+
+        def f(flat):
+            shard = arp.reduce_scatter_gradients(flat, "data")
+            return arp.all_gather_weights(shard, "data")
+
+        g = shard_map(f, mesh=mesh, in_specs=P(), out_specs=P(),
+                      check_rep=False)
+        out = jax.jit(g)(arp.flatten(params))
+        # psum over 8 replicated copies = 8x
+        np.testing.assert_allclose(np.asarray(out[:20]), 8.0)
+
+    def test_bf16_compression(self):
+        params = {"w": jnp.full((16,), 3.14159)}
+        arp = AllReduceParameter(params, 8, compression="bf16")
+        assert arp.compression == "bf16"
+
+
+class TestDistriOptimizer:
+    def test_converges_on_separable_data(self):
+        samples = synthetic_separable(512, 4, n_classes=3, seed=7)
+        ds = ShardedDataSet(samples, N_DEV).transform(
+            SampleToMiniBatch(64, N_DEV))
+        model = _mlp(4, 3)
+        opt = optim.Optimizer.create(model, ds, nn.ClassNLLCriterion())
+        assert isinstance(opt, DistriOptimizer)
+        opt.set_optim_method(optim.SGD(learning_rate=0.5))
+        opt.set_end_when(optim.max_epoch(12))
+        trained = opt.optimize()
+        acc = Evaluator(trained).test(
+            samples, [optim.Top1Accuracy()], 64)[0][1].final_result()
+        assert acc > 0.9, f"distributed training failed to converge: acc={acc}"
+
+    def test_matches_local_optimizer_exactly(self):
+        """Full-batch steps: the sharded psum_scatter/update/all_gather cycle
+        must reproduce the single-process trainer bit-for-bit-ish (the
+        reference's RefOptimizer oracle strategy)."""
+        samples = synthetic_separable(64, 4, n_classes=2, seed=3)
+
+        def run(distributed):
+            model = _mlp(4, 2, seed=11)
+            if distributed:
+                ds = ShardedDataSet(samples, N_DEV).transform(
+                    SampleToMiniBatch(64, N_DEV))
+            else:
+                ds = LocalDataSet(samples).transform(SampleToMiniBatch(64))
+            opt = optim.Optimizer.create(model, ds, nn.ClassNLLCriterion())
+            opt.set_optim_method(optim.SGD(learning_rate=0.2, momentum=0.9))
+            opt.set_end_when(optim.max_iteration(6))
+            trained = opt.optimize()
+            w, _ = trained.get_parameters()
+            return np.asarray(w)
+
+        w_local = run(False)
+        w_distri = run(True)
+        np.testing.assert_allclose(w_distri, w_local, rtol=2e-4, atol=2e-5)
+
+    def test_adam_sharded_slots(self):
+        """ZeRO-1: Adam's m/v slots live sharded over the data axis."""
+        samples = synthetic_separable(128, 4, n_classes=2, seed=3)
+        ds = ShardedDataSet(samples, N_DEV).transform(
+            SampleToMiniBatch(32, N_DEV))
+        model = _mlp(4, 2)
+        opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion())
+        opt.set_optim_method(optim.Adam(learning_rate=0.05))
+        opt.set_end_when(optim.max_iteration(50))
+        trained = opt.optimize()
+        acc = Evaluator(trained).test(
+            samples, [optim.Top1Accuracy()], 32)[0][1].final_result()
+        assert acc > 0.9
+        # on-device slots are flat vectors sharded over the data axis
+        leaf = jax.tree_util.tree_leaves(opt._sharded_slots)[0]
+        spec = leaf.sharding.spec
+        assert spec and spec[0] == "data", f"slots not sharded: {spec}"
+        # published slots are in the canonical per-parameter pytree format:
+        # the optim method must remain usable host-side (e.g. local resume)
+        s_slots = opt.optim_method._slots["s"]   # Adam's first-moment slot
+        p_leaves = jax.tree_util.tree_leaves(trained.params)
+        s_leaves = jax.tree_util.tree_leaves(s_slots)
+        assert [l.shape for l in s_leaves] == [l.shape for l in p_leaves]
+        opt.optim_method.update(
+            jax.tree_util.tree_map(jnp.zeros_like, trained.params),
+            trained.params)
+
+    def test_bf16_wire_compression_converges(self):
+        """fp16-on-the-wire analog (reference FP16CompressedTensor)."""
+        samples = synthetic_separable(256, 4, n_classes=3, seed=9)
+        ds = ShardedDataSet(samples, N_DEV).transform(
+            SampleToMiniBatch(64, N_DEV))
+        model = _mlp(4, 3)
+        opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion(),
+                              compression="bf16")
+        opt.set_optim_method(optim.SGD(learning_rate=0.5))
+        opt.set_end_when(optim.max_epoch(12))
+        trained = opt.optimize()
+        acc = Evaluator(trained).test(
+            samples, [optim.Top1Accuracy()], 64)[0][1].final_result()
+        assert acc > 0.9
+
+    def test_conv_pool_model_distributed(self):
+        """LeNet-style conv+pool through the sharded fused step."""
+        from tests.test_e2e_train import synthetic_digit_images
+        samples = synthetic_digit_images(256, side=16, n_classes=4)
+        ds = ShardedDataSet(samples, N_DEV).transform(
+            SampleToMiniBatch(32, N_DEV))
+        m = (nn.Sequential()
+             .add(nn.Reshape((1, 16, 16)))
+             .add(nn.SpatialConvolution(1, 8, 3, 3, 1, 1, 1, 1))
+             .add(nn.ReLU())
+             .add(nn.SpatialMaxPooling(2, 2, 2, 2))
+             .add(nn.Reshape((8 * 8 * 8,)))
+             .add(nn.Linear(8 * 8 * 8, 4))
+             .add(nn.LogSoftMax()))
+        opt = optim.Optimizer.create(m, ds, nn.ClassNLLCriterion())
+        opt.set_optim_method(optim.SGD(learning_rate=0.1))
+        opt.set_end_when(optim.max_iteration(60))
+        trained = opt.optimize()
+        acc = Evaluator(trained).test(
+            samples, [optim.Top1Accuracy()], 32)[0][1].final_result()
+        assert acc > 0.9
+
+    def test_batchnorm_state_stays_consistent(self):
+        """BN running stats are pmean'd across shards: after training, the
+        published state must be finite and moved off its init."""
+        from tests.test_e2e_train import synthetic_digit_images
+        samples = synthetic_digit_images(128, side=8, n_classes=2)
+        ds = ShardedDataSet(samples, N_DEV).transform(
+            SampleToMiniBatch(32, N_DEV))
+        m = (nn.Sequential()
+             .add(nn.Reshape((1, 8, 8)))
+             .add(nn.SpatialConvolution(1, 4, 3, 3, 1, 1, 1, 1))
+             .add(nn.SpatialBatchNormalization(4))
+             .add(nn.ReLU())
+             .add(nn.Reshape((4 * 8 * 8,)))
+             .add(nn.Linear(4 * 8 * 8, 2))
+             .add(nn.LogSoftMax()))
+        opt = optim.Optimizer.create(m, ds, nn.ClassNLLCriterion())
+        opt.set_optim_method(optim.SGD(learning_rate=0.1))
+        opt.set_end_when(optim.max_iteration(30))
+        trained = opt.optimize()
+        bn_state = trained.state[2]
+        rm = np.asarray(bn_state["running_mean"])
+        assert np.all(np.isfinite(rm)) and np.abs(rm).sum() > 0
+
+    def test_partition_mesh_mismatch_raises(self):
+        samples = synthetic_separable(64, 4, n_classes=2)
+        ds = ShardedDataSet(samples, 4).transform(SampleToMiniBatch(32, 4))
+        opt = DistriOptimizer(_mlp(4, 2), ds, nn.ClassNLLCriterion())
+        with pytest.raises(ValueError, match="must match"):
+            opt.optimize()
+
+    def test_validation_and_checkpoint_during_distributed_run(self, tmp_path):
+        samples = synthetic_separable(256, 4, n_classes=2, seed=1)
+        ds = ShardedDataSet(samples, N_DEV).transform(
+            SampleToMiniBatch(64, N_DEV))
+        model = _mlp(4, 2)
+        opt = optim.Optimizer.create(model, ds, nn.ClassNLLCriterion())
+        opt.set_optim_method(optim.SGD(learning_rate=0.5))
+        opt.set_end_when(optim.max_epoch(6))
+        opt.set_checkpoint(str(tmp_path / "ckpt"), optim.every_epoch())
+        opt.set_validation(optim.every_epoch(),
+                           LocalDataSet(samples).transform(SampleToMiniBatch(64)),
+                           [optim.Top1Accuracy()])
+        opt.optimize()
+        latest = opt.checkpoint.latest()
+        assert latest is not None
+        from bigdl_tpu.utils import file_io
+        m2 = file_io.load(latest[0])
+        acc = Evaluator(m2).test(
+            samples, [optim.Top1Accuracy()], 64)[0][1].final_result()
+        assert acc > 0.9
