@@ -1,0 +1,20 @@
+"""Keep the driver entry points working: single-chip forward compile and the
+8-device distributed dry run."""
+
+import jax
+import numpy as np
+import pytest
+
+import __graft_entry__ as graft
+
+
+def test_dryrun_multichip_8():
+    graft.dryrun_multichip(8)
+
+
+@pytest.mark.slow
+def test_entry_forward_compiles():
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (8, 1000)
+    assert np.all(np.isfinite(np.asarray(out)))
