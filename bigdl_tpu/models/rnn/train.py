@@ -1,0 +1,100 @@
+"""RNN / LSTM language-model Train driver — BASELINE config #5.
+
+Reference equivalent: ``models/rnn/Train.scala`` — tokenized corpus →
+Dictionary → TextToLabeledSentence (LM shift pairs) → one-hot
+LabeledSentenceToSample, SimpleRNN trained with TimeDistributedCriterion
+(ClassNLL over every timestep).  ``--cell lstm`` trains the LSTM-LM (the
+PTB-style config).
+
+Run::
+
+    python -m bigdl_tpu.models.rnn.train -f <corpus.txt> --cell lstm
+    python -m bigdl_tpu.models.rnn.train --synthetic 256     # no data needed
+"""
+
+import os
+
+import numpy as np
+
+import bigdl_tpu.nn as nn
+import bigdl_tpu.optim as optim
+from bigdl_tpu.dataset.text import (Dictionary, LabeledSentenceToSample,
+                                    SentenceTokenizer, TextToLabeledSentence)
+from bigdl_tpu.models import driver_utils
+from bigdl_tpu.models.rnn import lstm_lm, simple_rnn
+
+
+def _synthetic_corpus(n: int, seed: int = 1):
+    """Deterministic bigram language: next word = (w + 1) % V with noise —
+    learnable structure for convergence checks."""
+    rng = np.random.RandomState(seed)
+    vocab = [f"w{i}" for i in range(20)]
+    sents = []
+    for _ in range(n):
+        start = rng.randint(0, 20)
+        words = [vocab[(start + i) % 20] for i in range(12)]
+        sents.append(words)
+    return sents
+
+
+def main(argv=None):
+    p = driver_utils.base_parser("Train a character/word-level RNN LM")
+    p.add_argument("--cell", choices=["rnn", "lstm"], default="rnn")
+    p.add_argument("--hidden", type=int, default=40,
+                   help="hidden size (reference hiddenSize=40)")
+    p.add_argument("--vocab", type=int, default=4000,
+                   help="max dictionary size (reference vocabSize)")
+    p.add_argument("--seq-len", type=int, default=12,
+                   help="fixed unroll length (padding/truncation)")
+    args = p.parse_args(argv)
+    driver_utils.init_logging()
+    batch = args.batch_size or 32
+
+    if args.synthetic:
+        sentences = _synthetic_corpus(args.synthetic)
+    else:
+        path = args.folder
+        if os.path.isdir(path):
+            path = os.path.join(path, "input.txt")
+        with open(path) as f:
+            text = f.read()
+        tok = SentenceTokenizer()
+        sentences = [s for s in tok(iter(text.split("\n"))) if len(s) > 2]
+
+    dictionary = Dictionary(sentences, args.vocab)
+    vocab = dictionary.vocab_size() + 1
+
+    to_lm = TextToLabeledSentence(dictionary)
+    to_sample = LabeledSentenceToSample(vocab, fixed_length=args.seq_len,
+                                        one_hot=True)
+    records = list(to_sample(to_lm(iter(sentences))))
+    split = max(1, int(len(records) * 0.9))
+    train, val = records[:split], records[split:] or records[:1]
+
+    def build():
+        if args.cell == "lstm":
+            return lstm_lm(vocab, args.hidden, vocab)
+        m = simple_rnn(vocab, args.hidden, vocab)
+        m.add(nn.TimeDistributed(nn.LogSoftMax()))
+        return m
+
+    model, method = driver_utils.load_snapshots(
+        args, build,
+        lambda: optim.Adagrad(learning_rate=args.learning_rate or 0.1,
+                              learning_rate_decay=0.001))
+
+    ds = driver_utils.make_dataset(train, args, batch)
+    criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                            size_average=True)
+    opt = optim.Optimizer.create(model, ds, criterion)
+    opt.set_optim_method(method)
+    driver_utils.configure(opt, args, default_epochs=30, app_name="rnn")
+    opt.set_validation(optim.every_epoch(), val, [optim.Loss(criterion)],
+                       batch_size=batch)
+    trained = opt.optimize()
+    print("Training done.")
+    return trained
+
+
+if __name__ == "__main__":
+    main()

@@ -136,6 +136,8 @@ class Optimizer:
                        methods: List[ValidationMethod],
                        batch_size: Optional[int] = None) -> "Optimizer":
         self.validation_trigger = trigger
+        if isinstance(dataset, (list, tuple)):
+            dataset = LocalDataSet(dataset)
         if batch_size is not None and not _yields_minibatches(dataset):
             from bigdl_tpu.dataset.transformer import SampleToMiniBatch
             dataset = dataset.transform(SampleToMiniBatch(batch_size))
@@ -161,7 +163,71 @@ class Optimizer:
         return self
 
     def optimize(self) -> Module:
+        """Train with failure retry (reference
+        ``optim/DistriOptimizer.scala:750-816``): on a non-argument error the
+        latest ``model.N``/``optimMethod.N`` snapshot is restored and training
+        resumes, up to ``bigdl.failure.retryTimes`` attempts."""
+        from bigdl_tpu.utils import config
+        retry_times = config.get_int("bigdl.failure.retryTimes", 5)
+        retry_interval = config.get_float("bigdl.failure.retryTimeInterval",
+                                          120.0)
+        attempt = 0
+        while True:
+            try:
+                return self._optimize()
+            except (ValueError, TypeError, KeyboardInterrupt):
+                # reference: IllegalArgumentException aborts immediately
+                raise
+            except Exception:
+                attempt += 1
+                if attempt >= retry_times:
+                    raise
+                restored = self._restore_latest_checkpoint()
+                if not restored and self._params_dead():
+                    # the jitted step donates its carries: without a snapshot
+                    # to reload, the in-memory params are gone — retrying
+                    # would fail on deleted buffers, so surface the original
+                    raise
+                logger.exception(
+                    "Training failed (attempt %d/%d); %s and retrying in "
+                    "%.0fs", attempt, retry_times,
+                    "restored latest checkpoint" if restored else
+                    "resuming from last published state", retry_interval)
+                time.sleep(retry_interval)
+
+    def _optimize(self) -> Module:
         raise NotImplementedError
+
+    def _params_dead(self) -> bool:
+        """True if any live model parameter buffer was donated-and-deleted
+        by a partially-completed jitted step."""
+        for leaf in jax.tree_util.tree_leaves(self.model._params):
+            if getattr(leaf, "is_deleted", lambda: False)():
+                return True
+        return False
+
+    def _restore_latest_checkpoint(self) -> bool:
+        """Reload the newest model.N/optimMethod.N snapshot into the live
+        model/optim shells (reference ``DistriOptimizer.scala:766-788``).
+        Returns False when there is nothing to restore (no checkpoint
+        configured, or no snapshot written yet)."""
+        if self.checkpoint is None:
+            return False
+        latest = self.checkpoint.latest()
+        if latest is None:
+            return False
+        from bigdl_tpu.utils import file_io
+        model_path, optim_path, n = latest
+        loaded_model = file_io.load(model_path)
+        loaded_optim = file_io.load(optim_path)
+        self.model.params = loaded_model.params
+        self.model.state = loaded_model.state
+        if isinstance(self.model, Container):
+            self.model._adopt()
+        self.optim_method.state = loaded_optim.state
+        self.optim_method.set_slots(loaded_optim._slots)
+        logger.info("Restored snapshot model.%d / optimMethod.%d", n, n)
+        return True
 
     # -- shared driver loop (used by Local and Distri trainers) -----------
 
@@ -180,8 +246,13 @@ class Optimizer:
         once at the end.
         """
         state = _initial_driver_state()
+        # resume: continue the counters a restored OptimMethod carries
+        # (reference Train drivers pass --stateSnapshot and the optim state's
+        # epoch/evalCounter pick up where the snapshot left off)
+        state["neval"] = self.optim_method.state.get("evalCounter", 0) + 1
+        state["epoch"] = self.optim_method.state.get("epoch", 1)
         stochastic = self.model.is_stochastic()
-        rng_counter = 0
+        rng_counter = state["neval"] - 1
         wall_start = time.time()
 
         while not self.end_when(state):
@@ -218,15 +289,25 @@ class Optimizer:
                 reset_epoch()
 
             state["neval"] += 1
+            # keep the snapshot's epoch current across the rollover so a
+            # resumed run continues at the right epoch
+            self.optim_method.state["epoch"] = state["epoch"]
 
             v_due = self._validation_due(state)
             c_due = self._checkpoint_due(state)
-            if v_due or c_due:
+            p_due = (self.train_summary is not None and
+                     getattr(self.train_summary, "save_parameters_due",
+                             lambda s: False)(state))
+            if v_due or c_due or p_due:
                 publish()
                 if v_due:
                     self._run_validation(state)
                 if c_due:
                     self._run_checkpoint(state)
+                if p_due:
+                    # weight histograms (reference DistriOptimizer:426-456)
+                    self.train_summary.save_parameters(self.model,
+                                                       state["neval"] - 1)
 
         publish()
         logger.info("Training finished in %.1f s.", time.time() - wall_start)
@@ -349,14 +430,14 @@ class LocalOptimizer(Optimizer):
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
-    def optimize(self) -> Module:
+    def _optimize(self) -> Module:
         model = self.model
         model.training()
         model._ensure_init()
 
         carry = {"params": model.params, "mstate": model.state,
                  "slots": self.optim_method.slots(model.params)}
-        self.optim_method.state["epoch"] = 1
+        self.optim_method.state.setdefault("epoch", 1)
         if self._step_fn is None:
             self._step_fn = self._build_step()
 

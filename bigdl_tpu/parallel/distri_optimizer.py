@@ -137,7 +137,7 @@ class DistriOptimizer(Optimizer):
 
     # ---- driver loop ----------------------------------------------------
 
-    def optimize(self) -> Module:
+    def _optimize(self) -> Module:
         model, mesh = self.model, self.mesh
         axis_size = mesh.shape["data"]
         if self.dataset.partition_num != axis_size:

@@ -1,0 +1,77 @@
+"""The ``bigdl.*`` configuration-property tier.
+
+Reference equivalent: JVM system properties documented in
+``docs/docs/UserGuide/configuration.md:28-41`` and read ad hoc across the
+tree (``utils/Engine.scala:113-137``, ``parameters/AllReduceParameter.scala:34``,
+``optim/DistriOptimizer.scala:751-752``).
+
+TPU-native form: environment variables ``BIGDL_<DOTTED_NAME>`` (dots →
+underscores, upper-cased) with programmatic overrides via :func:`set_property`.
+The property names keep the reference's dotted vocabulary so its docs map 1:1.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+# name -> default; the reference's table (configuration.md:28-41) minus the
+# JVM/Spark-only knobs that have no TPU analog (thread-pool sizes, nio).
+_DEFAULTS: Dict[str, Any] = {
+    "bigdl.engineType": "tpu",
+    "bigdl.localMode": False,
+    "bigdl.coreNumber": None,              # discovered from jax
+    "bigdl.failure.retryTimes": 5,
+    "bigdl.failure.retryTimeInterval": 120,  # seconds
+    "bigdl.check.singleton": False,
+    "bigdl.summary.flushSecs": 2.0,
+    "bigdl.compilation.cacheDir": None,    # jax persistent compile cache
+}
+
+_OVERRIDES: Dict[str, Any] = {}
+
+
+def _env_key(name: str) -> str:
+    return name.replace(".", "_").upper()
+
+
+def get_property(name: str, default: Optional[Any] = None) -> Any:
+    """Resolution order: set_property override > env var > table default."""
+    if name in _OVERRIDES:
+        return _OVERRIDES[name]
+    env = os.environ.get(_env_key(name))
+    if env is not None:
+        return env
+    if name in _DEFAULTS and _DEFAULTS[name] is not None:
+        return _DEFAULTS[name]
+    return default
+
+
+def get_int(name: str, default: int = 0) -> int:
+    v = get_property(name, default)
+    return int(v)
+
+
+def get_float(name: str, default: float = 0.0) -> float:
+    v = get_property(name, default)
+    return float(v)
+
+
+def get_bool(name: str, default: bool = False) -> bool:
+    v = get_property(name, default)
+    if isinstance(v, bool):
+        return v
+    return str(v).lower() in ("1", "true", "yes", "on")
+
+
+def set_property(name: str, value: Any) -> None:
+    _OVERRIDES[name] = value
+
+
+def clear_property(name: str) -> None:
+    _OVERRIDES.pop(name, None)
+
+
+def known_properties() -> Dict[str, Any]:
+    """The full table with current values (for diagnostics)."""
+    return {k: get_property(k) for k in _DEFAULTS}

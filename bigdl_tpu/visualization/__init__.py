@@ -1,0 +1,17 @@
+"""bigdl_tpu.visualization — TensorBoard-compatible training visualization.
+
+Reference equivalent: ``visualization/`` (Summary/TrainSummary/
+ValidationSummary over a TFRecord event writer with masked CRC32C framing,
+``visualization/tensorboard/FileWriter.scala:30``, ``RecordWriter.scala:30-57``).
+"""
+
+from bigdl_tpu.visualization.crc32c import crc32c, masked_crc32c
+from bigdl_tpu.visualization.file_writer import FileWriter, read_records
+from bigdl_tpu.visualization.summary import (Summary, TrainSummary,
+                                             ValidationSummary,
+                                             scalar_summary,
+                                             histogram_summary)
+
+__all__ = ["FileWriter", "Summary", "TrainSummary", "ValidationSummary",
+           "crc32c", "masked_crc32c", "read_records", "scalar_summary",
+           "histogram_summary"]

@@ -1,0 +1,63 @@
+"""Smoke tests for the model-zoo Train drivers (synthetic data mode).
+
+Reference analog: the Train mains are exercised in integration jobs; here
+each CLI runs a few iterations end-to-end on the virtual mesh, and the
+LeNet driver round-trips its --model/--state resume flags.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.models.lenet import train as lenet_train
+from bigdl_tpu.models.vgg import train as vgg_train
+from bigdl_tpu.models.resnet import train as resnet_train
+from bigdl_tpu.models.rnn import train as rnn_train
+from bigdl_tpu.models.textclassifier import train as tc_train
+
+
+class TestTrainDrivers:
+    def test_lenet_synthetic_converges(self):
+        model = lenet_train.main(["--synthetic", "256", "-b", "64",
+                                  "-e", "4", "-r", "0.2"])
+        w, _ = model.get_parameters()
+        assert np.all(np.isfinite(np.asarray(w)))
+
+    def test_lenet_checkpoint_resume_flags(self, tmp_path):
+        ckpt = str(tmp_path / "ckpt")
+        lenet_train.main(["--synthetic", "128", "-b", "64", "-e", "2",
+                          "--checkpoint", ckpt])
+        snaps = sorted(f for f in os.listdir(ckpt) if f.startswith("model."))
+        assert snaps, "no snapshot written"
+        n = snaps[-1].split(".")[1]
+        model = lenet_train.main([
+            "--synthetic", "128", "-b", "64", "-e", "4",
+            "--model", os.path.join(ckpt, f"model.{n}"),
+            "--state", os.path.join(ckpt, f"optimMethod.{n}")])
+        w, _ = model.get_parameters()
+        assert np.all(np.isfinite(np.asarray(w)))
+
+    def test_vgg_synthetic_smoke(self):
+        vgg_train.main(["--synthetic", "64", "-b", "16",
+                        "--max-iteration", "3"])
+
+    def test_vgg_distributed_partitions(self):
+        vgg_train.main(["--synthetic", "128", "-b", "32",
+                        "--max-iteration", "3", "--partitions", "8"])
+
+    def test_resnet_cifar_synthetic_smoke(self):
+        resnet_train.main(["--synthetic", "64", "-b", "16", "--depth", "20",
+                           "--max-iteration", "3"])
+
+    def test_rnn_lm_synthetic(self):
+        rnn_train.main(["--synthetic", "128", "-b", "32", "-e", "2",
+                        "--cell", "rnn"])
+
+    def test_lstm_lm_synthetic(self):
+        rnn_train.main(["--synthetic", "64", "-b", "16",
+                        "--max-iteration", "4", "--cell", "lstm"])
+
+    def test_textclassifier_synthetic_smoke(self):
+        tc_train.main(["--synthetic", "32", "-b", "8",
+                       "--max-iteration", "2"])
