@@ -20,6 +20,7 @@ from bigdl_tpu.nn.conv import (SpatialConvolution, SpatialShareConvolution,
                                SpatialConvolutionMap)
 from bigdl_tpu.nn.pooling import (SpatialMaxPooling, SpatialAveragePooling,
                                   VolumetricMaxPooling, RoiPooling)
+from bigdl_tpu.ops.nms import Nms, nms_mask
 from bigdl_tpu.nn.activation import (ReLU, ReLU6, LeakyReLU, ELU, PReLU,
                                      RReLU, Tanh, TanhShrink, Sigmoid,
                                      LogSigmoid, SoftMax, SoftMin, LogSoftMax,

@@ -50,8 +50,12 @@ class Linear(Module):
         fan_in, fan_out = self.input_size, self.output_size
         if self.init_weight is not None:
             w = jnp.asarray(self.init_weight)
-            if w.shape == (self.output_size, self.input_size):
-                w = w.T  # accept reference (out, in) layout
+            # native layout is (in, out); the reference's (out, in) is
+            # accepted and transposed.  Square matrices are ambiguous and
+            # assumed native.
+            if (w.shape != (self.input_size, self.output_size) and
+                    w.shape == (self.output_size, self.input_size)):
+                w = w.T
         else:
             w = self.weight_init_method(k1, (self.input_size, self.output_size),
                                         fan_in, fan_out)

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import itertools
 import pickle
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -148,7 +149,11 @@ class Module:
     # ---- imperative shell ----------------------------------------------
 
     def forward(self, input: Activity, rng=None) -> Activity:
-        """Stateful forward (reference ``AbstractModule.forward:213``)."""
+        """Stateful forward (reference ``AbstractModule.forward:213``).
+        Wall time accumulates into ``forward_time`` (the reference's
+        per-module nanosecond timing, ``AbstractModule:193-204``); the
+        device is synced for an honest measurement — this shell is the
+        debugging/parity path, not the fused training hot loop."""
         self._ensure_init()
         if rng is None and self.is_stochastic() and self.train_mode:
             rng = jax.random.PRNGKey(
@@ -156,7 +161,10 @@ class Module:
                 .generate_state(1)[0])
         self._last_rng = rng
         self._fwd_state_in = self._state
+        t0 = time.time_ns()
         out, new_state = self._jitted()(self._params, input, self._state, rng)
+        jax.block_until_ready(out)
+        self.forward_time += time.time_ns() - t0
         if self.train_mode:
             self._state = new_state
         self.output = out
@@ -176,8 +184,11 @@ class Module:
             out, _ = self.apply(p, x, state_in, training=self.train_mode, rng=rng)
             return out
 
+        t0 = time.time_ns()
         _, vjp = jax.vjp(f, self._params, input)
         pgrads, gin = vjp(grad_output)
+        jax.block_until_ready(gin)
+        self.backward_time += time.time_ns() - t0
         pgrads = self._scale_grads(pgrads)
         self._grads = tree_add(self._grads, pgrads)
         self.grad_input = gin

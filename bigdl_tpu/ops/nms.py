@@ -1,0 +1,74 @@
+"""Non-maximum suppression for object detection.
+
+Reference equivalent: ``nn/Nms.scala`` — sort by score, greedily keep the
+highest-scoring box and suppress boxes whose IoU with a kept box exceeds the
+threshold.
+
+TPU-first form: a fixed-shape ``lax.fori_loop`` over the score-sorted boxes
+producing a suppression mask — no data-dependent shapes, so it compiles
+under jit (the host-side ``Nms`` shell then extracts indices, mirroring the
+reference's buffer-filling API).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def _pairwise_iou(boxes: jnp.ndarray) -> jnp.ndarray:
+    """(N, 4) xyxy boxes → (N, N) IoU (torch-style +1 extents, matching the
+    reference's ``getAreas``)."""
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    areas = (x2 - x1 + 1.0) * (y2 - y1 + 1.0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    iw = jnp.maximum(ix2 - ix1 + 1.0, 0.0)
+    ih = jnp.maximum(iy2 - iy1 + 1.0, 0.0)
+    inter = iw * ih
+    return inter / (areas[:, None] + areas[None, :] - inter)
+
+
+def nms_mask(boxes: jnp.ndarray, scores: jnp.ndarray,
+             iou_threshold: float) -> jnp.ndarray:
+    """Jit-friendly core: (N, 4) boxes + (N,) scores → (N,) bool keep mask
+    (in ORIGINAL box order)."""
+    n = boxes.shape[0]
+    if n == 0:
+        return jnp.zeros((0,), bool)
+    order = jnp.argsort(-scores)
+    iou = _pairwise_iou(boxes[order])
+    idx = jnp.arange(n)
+
+    def body(i, suppressed):
+        overlaps = (iou[i] > iou_threshold) & (idx > i)
+        new = suppressed | overlaps
+        # a suppressed anchor suppresses nothing
+        return jnp.where(suppressed[i], suppressed, new)
+
+    suppressed = lax.fori_loop(0, n, body, jnp.zeros((n,), bool))
+    keep_sorted = ~suppressed
+    return jnp.zeros((n,), bool).at[order].set(keep_sorted)
+
+
+class Nms:
+    """Host-side shell with the reference's call shape
+    (``Nms.nms(scores, boxes, thresh, indices) -> count``): returns kept
+    indices in descending-score order."""
+
+    def nms(self, scores, boxes, thresh: float,
+            indices: Optional[np.ndarray] = None) -> int:
+        scores = jnp.asarray(scores).reshape(-1)
+        boxes = jnp.asarray(boxes).reshape(-1, 4)
+        keep = np.asarray(nms_mask(boxes, scores, thresh))
+        order = np.argsort(-np.asarray(scores), kind="stable")
+        kept = [int(i) for i in order if keep[i]]
+        if indices is not None:
+            indices[:len(kept)] = kept
+        self.last_indices = np.asarray(kept, dtype=np.int64)
+        return len(kept)

@@ -11,7 +11,8 @@ from bigdl_tpu.optim.trigger import (Trigger, every_epoch, several_iteration,
                                      min_loss)
 from bigdl_tpu.optim.validation_method import (ValidationMethod,
                                                ValidationResult, Top1Accuracy,
-                                               Top5Accuracy, Loss, MAE)
+                                               Top5Accuracy, Loss, MAE,
+                                               TreeNNAccuracy)
 from bigdl_tpu.optim.regularizer import (Regularizer, L1Regularizer,
                                          L2Regularizer, L1L2Regularizer)
 from bigdl_tpu.optim.metrics import Metrics
@@ -29,7 +30,7 @@ __all__ = [
     "EpochSchedule", "Regime", "Plateau", "Trigger", "every_epoch",
     "several_iteration", "max_epoch", "max_iteration", "max_score",
     "min_loss", "ValidationMethod", "ValidationResult", "Top1Accuracy",
-    "Top5Accuracy", "Loss", "MAE", "Regularizer", "L1Regularizer",
+    "Top5Accuracy", "Loss", "MAE", "TreeNNAccuracy", "Regularizer", "L1Regularizer",
     "L2Regularizer", "L1L2Regularizer", "Metrics", "Optimizer",
     "LocalOptimizer", "Checkpoint", "Evaluator", "Validator",
     "LocalValidator", "DistriValidator", "evaluate_dataset", "Predictor",

@@ -44,19 +44,36 @@ def _eval_forward(model: Module):
 
 
 def evaluate_dataset(model: Module, dataset,
-                     methods: Sequence[ValidationMethod]
+                     methods: Sequence[ValidationMethod],
+                     mesh=None
                      ) -> List[Tuple[ValidationMethod, ValidationResult]]:
     """Run ``methods`` over an eval dataset (MiniBatch stream or Sample
-    stream + batching applied by the caller)."""
+    stream + batching applied by the caller).
+
+    ``mesh``: shard each batch over the mesh's ``data`` axis so the forward
+    runs data-parallel across devices (the reference evaluates inside the
+    cluster, ``optim/Evaluator.scala:37-74``; here XLA's SPMD partitioner
+    owns the split).  Batches not divisible by the axis size fall back to
+    single-device execution."""
     was_training = model.train_mode
     model.evaluate()
+    batch_sharding = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        batch_sharding = NamedSharding(mesh, P("data"))
+        axis_size = mesh.shape["data"]
     try:
         fwd = _eval_forward(model)
         totals: List[ValidationResult] = [None] * len(methods)
         it = dataset.data(train=False) if isinstance(
             dataset, AbstractDataSet) else iter(dataset)
         for batch in it:
-            inputs = _to_device(batch.get_input())
+            if batch_sharding is not None and batch.size() % axis_size == 0:
+                inputs = jax.tree_util.tree_map(
+                    lambda x: jax.device_put(np.asarray(x), batch_sharding),
+                    batch.get_input())
+            else:
+                inputs = _to_device(batch.get_input())
             targets = batch.get_target()
             out = np.asarray(fwd(inputs))
             for i, m in enumerate(methods):

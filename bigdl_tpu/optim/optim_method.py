@@ -537,6 +537,10 @@ class LBFGS(OptimMethod):
     (reference ``optim/LBFGS.scala``; inherently sequential — host-driven,
     operating on the flattened parameter vector like the reference)."""
 
+    # the trainer uses the host-driven optimize(feval, x) path instead of
+    # the fused pure_update step (line search re-evaluates the loss)
+    requires_feval = True
+
     def __init__(self, max_iter: int = 20, max_eval: Optional[float] = None,
                  tolerance_fun: float = 1e-5, tolerance_x: float = 1e-9,
                  n_correction: int = 100, learning_rate: float = 1.0,

@@ -327,10 +327,16 @@ class Optimizer:
                 self.validation_dataset is not None and
                 self.validation_trigger(state))
 
+    def _eval_mesh(self):
+        """Mesh for sharded validation forwards; the distributed trainer
+        overrides this with its training mesh."""
+        return None
+
     def _run_validation(self, state) -> None:
         from bigdl_tpu.optim.evaluator import evaluate_dataset
         results = evaluate_dataset(self.model, self.validation_dataset,
-                                   self.validation_methods)
+                                   self.validation_methods,
+                                   mesh=self._eval_mesh())
         for method, res in results:
             logger.info("%s is %s", method.name, res)
             state["score"] = res.final_result()
@@ -413,6 +419,8 @@ class LocalOptimizer(Optimizer):
     def _build_step(self):
         model, criterion = self.model, self.criterion
         optim = self.optim_method
+        if getattr(optim, "requires_feval", False):
+            return self._build_feval_step()
 
         def step(params, slots, mstate, inputs, targets, hyper, rng):
             def loss_fn(p):
@@ -429,6 +437,32 @@ class LocalOptimizer(Optimizer):
             return new_params, new_slots, new_mstate, loss
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _build_feval_step(self):
+        """Host-driven step for multi-evaluation methods (LBFGS line
+        search): one jitted loss+grad function, called repeatedly by the
+        method's own inner loop.  Module state (BatchNorm statistics) is
+        held fixed within a step — LBFGS is a full-batch method in the
+        reference too (``optim/LBFGS.scala``)."""
+        model, criterion = self.model, self.criterion
+        optim = self.optim_method
+
+        @jax.jit
+        def value_and_grad(params, mstate, inputs, targets, rng):
+            def loss_fn(p):
+                out, _ = model.apply(p, inputs, mstate, training=True,
+                                     rng=rng)
+                loss = criterion.apply(out, targets)
+                return loss + regularization_penalty(model, p)
+            return jax.value_and_grad(loss_fn)(params)
+
+        def step(params, slots, mstate, inputs, targets, hyper, rng):
+            def feval(p):
+                return value_and_grad(p, mstate, inputs, targets, rng)
+            new_params, losses = optim.optimize(feval, params)
+            return new_params, slots, mstate, losses[-1]
+
+        return step
 
     def _optimize(self) -> Module:
         model = self.model

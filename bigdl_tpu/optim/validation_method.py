@@ -118,3 +118,32 @@ class MAE(ValidationMethod):
         pred = out.argmax(axis=-1) + 1
         err = float(np.abs(pred - tgt).sum())
         return ValidationResult(err, tgt.shape[0], self.name)
+
+
+class TreeNNAccuracy(ValidationMethod):
+    """Accuracy of a Tree/Recursive NN measured at the ROOT node only
+    (reference ``TreeNNAccuracy``, ``optim/ValidationMethod.scala:118``):
+    output (B, nodes, C) — node 1 is the root; binary single-logit outputs
+    threshold at 0.5, multi-class outputs argmax; labels 1-based."""
+
+    name = "TreeNNAccuracy"
+
+    def apply(self, output, target) -> ValidationResult:
+        out = np.asarray(output)
+        tgt = np.asarray(target)
+        if tgt.ndim >= 2:
+            tgt = tgt[:, 0]
+        tgt = tgt.reshape(-1)
+        if out.ndim == 3:
+            root = out[:, 0]              # (B, C)
+        elif out.ndim == 2:
+            root = out[0][None, :]        # single sample: first node row
+            tgt = tgt[:1]
+        else:
+            raise ValueError(f"TreeNNAccuracy: bad output rank {out.ndim}")
+        if root.shape[-1] == 1:
+            pred = (root[..., 0] >= 0.5).astype(np.int64)
+        else:
+            pred = root.argmax(axis=-1) + 1
+        correct = int((pred == tgt.astype(np.int64)).sum())
+        return ValidationResult(correct, tgt.shape[0], self.name)

@@ -197,6 +197,11 @@ class DistriOptimizer(Optimizer):
                     epoch_size=self.dataset.size())
         return model
 
+    def _eval_mesh(self):
+        """Validation forwards run sharded over the training mesh (the
+        reference evaluates inside the cluster, ``optim/Evaluator.scala``)."""
+        return self.mesh
+
     def _flat_slots(self, arp: AllReduceParameter):
         """Optimizer slots as flat padded vectors.  Fresh runs start from
         zeros; a resumed/reused OptimMethod carries slots in the canonical

@@ -1,0 +1,6 @@
+"""bigdl_tpu.utils.tf — TensorFlow GraphDef interop (reference ``utils/tf/``)."""
+
+from bigdl_tpu.utils.tf.loader import TensorflowLoader, load
+from bigdl_tpu.utils.tf import saver
+
+__all__ = ["TensorflowLoader", "load", "saver"]

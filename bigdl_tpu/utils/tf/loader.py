@@ -1,0 +1,257 @@
+"""TensorFlow GraphDef importer.
+
+Reference equivalent: ``utils/tf/TensorflowLoader.scala:38,85,126,210-230`` —
+parse a GraphDef protobuf, build a directed graph of NodeDefs, greedily
+pattern-match registered op subgraphs (Conv2D+BiasAdd, MatMul+BiasAdd, …)
+and emit a Graph model with the pretrained weights copied in.
+
+TPU-native notes: TF's NHWC activations and HWIO conv kernels are ALSO this
+framework's native layouts (``ops/convolution.py``), so weights import
+without transposition; ``format="NHWC"`` layers run the imported graph in
+its original layout — no layout shims.
+
+The protobuf parsing itself is delegated to the installed ``tensorflow``
+package (proto definitions only — no TF session or runtime executes).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.graph import Graph, ModuleNode
+
+
+def _tf():
+    try:
+        import tensorflow as tf
+        return tf
+    except ImportError as e:  # pragma: no cover - tf is in the image
+        raise ImportError(
+            "TensorFlow GraphDef import needs the tensorflow package for "
+            "protobuf parsing") from e
+
+
+def _const_value(node) -> np.ndarray:
+    from tensorflow.python.framework import tensor_util
+    return tensor_util.MakeNdarray(node.attr["value"].tensor)
+
+
+def _strides_hw(node) -> tuple:
+    s = list(node.attr["strides"].list.i)
+    if node.attr["data_format"].s in (b"NCHW",):
+        return int(s[2]), int(s[3])
+    return int(s[1]), int(s[2])
+
+
+def _ksize_hw(node) -> tuple:
+    k = list(node.attr["ksize"].list.i)
+    if node.attr["data_format"].s in (b"NCHW",):
+        return int(k[2]), int(k[3])
+    return int(k[1]), int(k[2])
+
+
+def _data_format(node) -> str:
+    return "NCHW" if node.attr["data_format"].s == b"NCHW" else "NHWC"
+
+
+class TensorflowLoader:
+    """Pattern-matching GraphDef → Graph converter."""
+
+    def __init__(self, graph_def, inputs: List[str], outputs: List[str]):
+        self.graph_def = graph_def
+        self.inputs = [i.split(":")[0] for i in inputs]
+        self.outputs = [o.split(":")[0] for o in outputs]
+        self.nodes = {n.name: n for n in graph_def.node}
+        self._consumers: Dict[str, int] = {}
+        for n in graph_def.node:
+            for i in n.input:
+                self._consumers[i.split(":")[0].lstrip("^")] = \
+                    self._consumers.get(i.split(":")[0].lstrip("^"), 0) + 1
+        self._converted: Dict[str, ModuleNode] = {}
+        self._input_nodes: List[ModuleNode] = []
+
+    # -- public ----------------------------------------------------------
+
+    @staticmethod
+    def load(path_or_graphdef, inputs: List[str],
+             outputs: List[str]) -> Graph:
+        """(reference ``TensorflowLoader.load:85``)."""
+        if isinstance(path_or_graphdef, str):
+            tf = _tf()
+            gd = tf.compat.v1.GraphDef()
+            with open(path_or_graphdef, "rb") as f:
+                gd.ParseFromString(f.read())
+        else:
+            gd = path_or_graphdef
+        loader = TensorflowLoader(gd, inputs, outputs)
+        return loader.build()
+
+    def build(self) -> Graph:
+        out_nodes = [self._convert(name) for name in self.outputs]
+        if not self._input_nodes:
+            raise ValueError("no graph inputs found among " +
+                             ", ".join(self.inputs))
+        return Graph(self._input_nodes, out_nodes)
+
+    # -- conversion ------------------------------------------------------
+
+    def _in(self, node, i: int):
+        return self.nodes[node.input[i].split(":")[0].lstrip("^")]
+
+    def _convert(self, name: str) -> ModuleNode:
+        name = name.split(":")[0]
+        if name in self._converted:
+            return self._converted[name]
+        node = self.nodes[name]
+        mn = self._emit(node)
+        self._converted[name] = mn
+        return mn
+
+    def _emit(self, node) -> ModuleNode:
+        op = node.op
+        if node.name in self.inputs or op in ("Placeholder",
+                                              "PlaceholderV2"):
+            mn = ModuleNode(nn.Identity(name=node.name))
+            self._input_nodes.append(mn)
+            return mn
+        handler = getattr(self, f"_op_{op.lower()}", None)
+        if handler is None:
+            raise ValueError(
+                f"unsupported TF op {op!r} at node {node.name!r} "
+                "(reference TensorflowToBigDL pattern not implemented)")
+        return handler(node)
+
+    def _unary(self, node, module) -> ModuleNode:
+        module.name = node.name
+        return ModuleNode(module).inputs(self._convert(node.input[0]))
+
+    # -- op handlers -----------------------------------------------------
+
+    def _op_identity(self, node):
+        return self._unary(node, nn.Identity())
+
+    def _op_relu(self, node):
+        return self._unary(node, nn.ReLU())
+
+    def _op_relu6(self, node):
+        return self._unary(node, nn.ReLU6())
+
+    def _op_tanh(self, node):
+        return self._unary(node, nn.Tanh())
+
+    def _op_sigmoid(self, node):
+        return self._unary(node, nn.Sigmoid())
+
+    def _op_softmax(self, node):
+        return self._unary(node, nn.SoftMax())
+
+    def _op_squeeze(self, node):
+        dims = [int(d) for d in node.attr["squeeze_dims"].list.i]
+        if dims:
+            raise ValueError(
+                f"Squeeze {node.name}: explicit squeeze_dims unsupported "
+                "(axis-numbering differs; squeeze all unit dims instead)")
+        return self._unary(node, nn.Squeeze())
+
+    def _op_reshape(self, node):
+        shape_node = self._in(node, 1)
+        if shape_node.op != "Const":
+            raise ValueError("Reshape with dynamic shape is unsupported")
+        shape = [int(s) for s in _const_value(shape_node)]
+        m = (nn.InferReshape(shape) if -1 in shape[1:]
+             else nn.Reshape(tuple(shape[1:]), batch_mode=True))
+        m.name = node.name
+        return ModuleNode(m).inputs(self._convert(node.input[0]))
+
+    def _op_matmul(self, node, bias: Optional[np.ndarray] = None,
+                   name: Optional[str] = None):
+        w_node = self._in(node, 1)
+        if w_node.op != "Const":
+            raise ValueError(f"MatMul {node.name}: non-Const weights")
+        if node.attr["transpose_a"].b:
+            raise ValueError(f"MatMul {node.name}: transpose_a unsupported")
+        w = _const_value(w_node)       # TF (in, out) == native layout
+        if node.attr["transpose_b"].b:
+            w = w.T
+        lin = nn.Linear(w.shape[0], w.shape[1], with_bias=bias is not None,
+                        init_weight=w, init_bias=bias,
+                        name=name or node.name)
+        return ModuleNode(lin).inputs(self._convert(node.input[0]))
+
+    def _op_conv2d(self, node, bias: Optional[np.ndarray] = None,
+                   name: Optional[str] = None):
+        w_node = self._in(node, 1)
+        if w_node.op != "Const":
+            raise ValueError(f"Conv2D {node.name}: non-Const weights")
+        dil = list(node.attr["dilations"].list.i)
+        if dil and any(d != 1 for d in dil):
+            raise ValueError(f"Conv2D {node.name}: dilations {dil} "
+                             "unsupported by the import patterns")
+        w = _const_value(w_node)       # HWIO == native layout
+        kh, kw, n_in, n_out = w.shape
+        sh, sw = _strides_hw(node)
+        same = node.attr["padding"].s == b"SAME"
+        conv = nn.SpatialConvolution(
+            n_in, n_out, kw, kh, sw, sh,
+            pad_w=-1 if same else 0, pad_h=-1 if same else 0,
+            init_weight=w, init_bias=bias, with_bias=bias is not None,
+            format=_data_format(node), name=name or node.name)
+        return ModuleNode(conv).inputs(self._convert(node.input[0]))
+
+    def _op_biasadd(self, node):
+        pre = self._in(node, 0)
+        b_node = self._in(node, 1)
+        if b_node.op == "Const" and pre.op in ("Conv2D", "MatMul"):
+            # fuse: Conv2D/MatMul + BiasAdd -> one layer (reference
+            # TensorflowToBigDL's Conv2D/FullConnection patterns)
+            bias = _const_value(b_node)
+            handler = (self._op_conv2d if pre.op == "Conv2D"
+                       else self._op_matmul)
+            mn = handler(pre, bias=bias, name=node.name)
+            if self._consumers.get(pre.name, 0) == 1:
+                # safe to alias only when the BiasAdd is the sole consumer
+                # of the raw Conv2D/MatMul output
+                self._converted[pre.name] = mn
+            return mn
+        return self._op_add(node)
+
+    def _op_add(self, node):
+        a, b = self._in(node, 0), self._in(node, 1)
+        if b.op == "Const":
+            v = _const_value(b)
+            if v.ndim == 0:
+                return self._unary(node, nn.AddConstant(float(v)))
+            raise ValueError(f"Add {node.name}: tensor Const addend "
+                             "unsupported")
+        m = nn.CAddTable()
+        m.name = node.name
+        return ModuleNode(m).inputs(self._convert(node.input[0]),
+                                    self._convert(node.input[1]))
+
+    _op_addv2 = _op_add
+
+    def _op_maxpool(self, node):
+        return self._pool(node, nn.SpatialMaxPooling)
+
+    def _op_avgpool(self, node):
+        return self._pool(node, nn.SpatialAveragePooling)
+
+    def _pool(self, node, cls):
+        kh, kw = _ksize_hw(node)
+        sh, sw = _strides_hw(node)
+        if node.attr["padding"].s == b"SAME":
+            raise ValueError(
+                f"{node.op} {node.name}: SAME pooling import is unsupported "
+                "(express it as explicit padding in the source graph)")
+        m = cls(kw, kh, sw, sh, format=_data_format(node))
+        m.name = node.name
+        if cls is nn.SpatialAveragePooling:
+            m.count_include_pad = False
+        return ModuleNode(m).inputs(self._convert(node.input[0]))
+
+
+def load(path_or_graphdef, inputs: List[str], outputs: List[str]) -> Graph:
+    return TensorflowLoader.load(path_or_graphdef, inputs, outputs)

@@ -1,0 +1,139 @@
+"""TensorFlow GraphDef exporter.
+
+Reference equivalent: ``utils/tf/TensorflowSaver.scala`` +
+``BigDLToTensorflow.scala`` — walk the model, emit one TF op (or fused op
+pair) per layer with the trained weights as Const nodes, write a GraphDef
+a stock TF runtime (or this package's loader) can execute.
+
+Graph construction uses ``tf.compat.v1`` proto building only — no TF
+session runs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import bigdl_tpu.nn as nn
+
+
+def save(model, input_shape: Sequence[Optional[int]], path: str) -> None:
+    """Export ``model`` (Sequential or Graph over the supported layer set)
+    to a binary GraphDef at ``path``.  ``input_shape`` includes the batch
+    dim (None for dynamic).  The graph's input is named ``input``, output
+    ``output``."""
+    import tensorflow as tf
+
+    model._ensure_init()
+    g = tf.Graph()
+    with g.as_default():
+        x = tf.compat.v1.placeholder(tf.float32, shape=input_shape,
+                                     name="input")
+        out = _emit_module(tf, model, x)
+        tf.identity(out, name="output")
+    with open(path, "wb") as f:
+        f.write(g.as_graph_def().SerializeToString())
+
+
+def _np(a) -> np.ndarray:
+    return np.asarray(a)
+
+
+def _emit_module(tf, module, x):
+    if isinstance(module, nn.Sequential):
+        for child in module.children:
+            x = _emit_module(tf, child, x)
+        return x
+    if isinstance(module, nn.module.Container) and hasattr(module,
+                                                           "executions"):
+        return _emit_graph(tf, module, x)
+
+    p = module.params
+    if isinstance(module, nn.Linear):
+        w = tf.constant(_np(p["weight"]))          # (in, out): TF layout
+        y = tf.matmul(x, w)
+        if module.with_bias:
+            y = tf.nn.bias_add(y, tf.constant(_np(p["bias"])))
+        return y
+    if isinstance(module, nn.SpatialConvolution):
+        if module.n_group != 1:
+            raise ValueError("grouped conv export unsupported")
+        w = tf.constant(_np(p["weight"]))          # HWIO: TF layout
+        pad = ("SAME" if module.pad_w == -1 else
+               ("VALID" if (module.pad_w, module.pad_h) == (0, 0) else None))
+        if pad is None:
+            raise ValueError(
+                f"conv {module.name}: explicit padding export unsupported")
+        if module.format == "NHWC":
+            strides = [1, module.stride_h, module.stride_w, 1]
+        else:
+            strides = [1, 1, module.stride_h, module.stride_w]
+        y = tf.nn.conv2d(x, w, strides=strides, padding=pad,
+                         data_format=module.format)
+        if module.with_bias:
+            y = tf.nn.bias_add(y, tf.constant(_np(p["bias"])),
+                               data_format=module.format)
+        return y
+    if isinstance(module, nn.SpatialMaxPooling):
+        return _pool(tf, module, x, tf.nn.max_pool2d)
+    if isinstance(module, nn.SpatialAveragePooling):
+        return _pool(tf, module, x, tf.nn.avg_pool2d)
+    if isinstance(module, nn.ReLU):
+        return tf.nn.relu(x)
+    if isinstance(module, nn.ReLU6):
+        return tf.nn.relu6(x)
+    if isinstance(module, nn.Tanh):
+        return tf.tanh(x)
+    if isinstance(module, nn.Sigmoid):
+        return tf.sigmoid(x)
+    if isinstance(module, nn.SoftMax):
+        return tf.nn.softmax(x)
+    if isinstance(module, nn.LogSoftMax):
+        return tf.nn.log_softmax(x)
+    if isinstance(module, (nn.Reshape, nn.View)):
+        size = module.size if isinstance(module, nn.Reshape) else module.sizes
+        return tf.reshape(x, [-1] + [int(s) for s in size])
+    if isinstance(module, nn.Squeeze):
+        if module.dim is not None:
+            raise ValueError("per-dim Squeeze export unsupported")
+        return tf.squeeze(x)
+    if isinstance(module, (nn.Identity, nn.Dropout)):
+        return tf.identity(x)   # Dropout exports as inference-time identity
+    raise ValueError(
+        f"layer {type(module).__name__} has no GraphDef export mapping "
+        "(reference BigDLToTensorflow scope)")
+
+
+def _pool(tf, module, x, op):
+    if module.pad_w or module.pad_h:
+        raise ValueError("padded pooling export unsupported")
+    if module.format == "NHWC":
+        ksize = [1, module.kh, module.kw, 1]
+        strides = [1, module.dh, module.dw, 1]
+    else:
+        ksize = [1, 1, module.kh, module.kw]
+        strides = [1, 1, module.dh, module.dw]
+    return op(x, ksize=ksize, strides=strides, padding="VALID",
+              data_format=module.format)
+
+
+def _emit_graph(tf, graph, x):
+    outputs = {}
+    for node in graph.executions:
+        if node in graph.input_nodes or not node.prev:
+            outputs[id(node)] = _emit_module(tf, node.element, x)
+            continue
+        ins = [outputs[id(p)] for p in node.prev]
+        m = node.element
+        if isinstance(m, nn.CAddTable):
+            outputs[id(node)] = tf.add_n(ins)
+        elif isinstance(m, nn.JoinTable):
+            # our JoinTable dimension is 1-based over the full tensor
+            outputs[id(node)] = tf.concat(ins, axis=m.dimension - 1)
+        else:
+            if len(ins) != 1:
+                raise ValueError(
+                    f"multi-input layer {type(m).__name__} unsupported")
+            outputs[id(node)] = _emit_module(tf, m, ins[0])
+    return outputs[id(graph.output_nodes[0])]

@@ -1,0 +1,117 @@
+"""Round-2 'make the dead APIs real' coverage: per-module timings,
+TreeNNAccuracy, Nms, the LBFGS trainer path, and mesh-sharded evaluation."""
+
+import jax
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+import bigdl_tpu.optim as optim
+from bigdl_tpu.engine import Engine
+from bigdl_tpu.dataset import LocalDataSet, Sample, SampleToMiniBatch
+from bigdl_tpu.dataset.datasets import synthetic_separable
+from bigdl_tpu.optim.evaluator import Evaluator, evaluate_dataset
+from bigdl_tpu.ops.nms import Nms, nms_mask
+
+
+class TestModuleTiming:
+    def test_forward_backward_times_populate(self):
+        m = nn.Linear(4, 3)
+        x = np.ones((2, 4), np.float32)
+        out = m.forward(x)
+        m.backward(x, np.ones_like(np.asarray(out)))
+        assert m.forward_time > 0
+        assert m.backward_time > 0
+        times = m.get_times()
+        assert times[0][1] == m.forward_time
+        m.reset_times()
+        assert m.forward_time == 0 and m.backward_time == 0
+
+
+class TestTreeNNAccuracy:
+    def test_root_node_multiclass(self):
+        # (B=2, nodes=3, C=4): root predictions are argmax+1 = 2 and 4
+        out = np.zeros((2, 3, 4), np.float32)
+        out[0, 0, 1] = 5.0
+        out[1, 0, 3] = 5.0
+        target = np.array([[2.0, 9, 9], [1.0, 9, 9]])
+        r = optim.TreeNNAccuracy().apply(out, target)
+        assert r.final_result() == 0.5
+
+    def test_root_node_binary(self):
+        out = np.array([[[0.9], [0.1]], [[0.2], [0.8]]], np.float32)
+        target = np.array([[1.0, 0.0], [0.0, 1.0]])
+        r = optim.TreeNNAccuracy().apply(out, target)
+        assert r.final_result() == 1.0
+
+    def test_mergeable(self):
+        a = optim.ValidationResult(1, 2, "TreeNNAccuracy")
+        b = optim.ValidationResult(1, 2, "TreeNNAccuracy")
+        assert (a + b).final_result() == 0.5
+
+
+class TestNms:
+    def test_suppresses_overlapping(self):
+        boxes = np.array([[0, 0, 10, 10],
+                          [1, 1, 11, 11],      # IoU ~0.68 with box 0
+                          [50, 50, 60, 60]], np.float32)
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        keep = np.asarray(nms_mask(boxes, scores, 0.5))
+        assert keep.tolist() == [True, False, True]
+
+    def test_reference_call_shape(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11],
+                          [50, 50, 60, 60], [0, 0, 9, 9]], np.float32)
+        scores = np.array([0.5, 0.9, 0.3, 0.8], np.float32)
+        buf = np.zeros(4, np.int64)
+        n = Nms().nms(scores, boxes, 0.5, buf)
+        assert n == 2
+        assert buf[:n].tolist() == [1, 2]   # score order, overlaps suppressed
+
+    def test_under_jit(self):
+        boxes = np.random.RandomState(0).uniform(
+            0, 100, size=(16, 4)).astype(np.float32)
+        boxes[:, 2:] = boxes[:, :2] + 10
+        scores = np.random.RandomState(1).uniform(size=16).astype(np.float32)
+        keep = jax.jit(nms_mask, static_argnums=2)(boxes, scores, 0.3)
+        assert np.asarray(keep).dtype == bool
+
+
+class TestLBFGSTrainerPath:
+    def test_lbfgs_through_optimizer_create(self):
+        samples = synthetic_separable(128, 4, n_classes=2, seed=3)
+        ds = LocalDataSet(samples).transform(SampleToMiniBatch(128))
+        model = (nn.Sequential().add(nn.Linear(4, 8)).add(nn.Tanh())
+                 .add(nn.Linear(8, 2)).add(nn.LogSoftMax()))
+        opt = optim.Optimizer.create(model, ds, nn.ClassNLLCriterion())
+        opt.set_optim_method(optim.LBFGS(max_iter=8))
+        opt.set_end_when(optim.max_iteration(4))
+        trained = opt.optimize()
+        acc = Evaluator(trained).test(
+            samples, [optim.Top1Accuracy()], 64)[0][1].final_result()
+        assert acc > 0.95, f"LBFGS path failed to converge: acc={acc}"
+
+
+class TestShardedEval:
+    def test_mesh_eval_matches_single_device(self):
+        samples = synthetic_separable(128, 4, n_classes=3, seed=5)
+        model = (nn.Sequential().add(nn.Linear(4, 8)).add(nn.Tanh())
+                 .add(nn.Linear(8, 3)).add(nn.LogSoftMax()))
+        model._ensure_init()
+        mesh = Engine.create_mesh((8,), ("data",))
+        ds = LocalDataSet(samples).transform(SampleToMiniBatch(32))
+        single = evaluate_dataset(model, ds, [optim.Top1Accuracy()])
+        ds2 = LocalDataSet(samples).transform(SampleToMiniBatch(32))
+        sharded = evaluate_dataset(model, ds2, [optim.Top1Accuracy()],
+                                   mesh=mesh)
+        assert (single[0][1].final_result() ==
+                sharded[0][1].final_result())
+
+    def test_indivisible_batch_falls_back(self):
+        samples = synthetic_separable(30, 4, n_classes=2, seed=5)
+        model = (nn.Sequential().add(nn.Linear(4, 2)).add(nn.LogSoftMax()))
+        model._ensure_init()
+        mesh = Engine.create_mesh((8,), ("data",))
+        ds = LocalDataSet(samples).transform(SampleToMiniBatch(30))
+        res = evaluate_dataset(model, ds, [optim.Top1Accuracy()], mesh=mesh)
+        assert 0.0 <= res[0][1].final_result() <= 1.0

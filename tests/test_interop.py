@@ -1,0 +1,211 @@
+"""Interop tests: Torch .t7 round-trips and TF GraphDef import/export with
+golden parity against real TensorFlow execution.
+
+Reference analogs: ``utils/TorchFileSpec`` and
+``utils/tf/TensorflowLoaderSpec`` / ``TensorflowSaverSpec`` (load a graph,
+run both sides on the same input, assert element-wise closeness).
+"""
+
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.utils import torch_file
+
+tf = pytest.importorskip("tensorflow")
+
+
+class TestTorchFile:
+    def test_tensor_roundtrip(self, tmp_path):
+        p = str(tmp_path / "t.t7")
+        for dtype in (np.float32, np.float64, np.int64, np.uint8):
+            arr = (np.arange(24).reshape(2, 3, 4) * 1.5).astype(dtype)
+            torch_file.save(p, arr)
+            back = torch_file.load(p)
+            assert back.dtype == arr.dtype
+            np.testing.assert_array_equal(back, arr)
+
+    def test_table_roundtrip(self, tmp_path):
+        p = str(tmp_path / "t.t7")
+        obj = {"weight": np.ones((3, 2), np.float32), "n": 7,
+               "name": "linear", "flag": True, "nested": [1.5, 2.5]}
+        torch_file.save(p, obj)
+        back = torch_file.load(p)
+        assert back["n"] == 7 and back["name"] == "linear"
+        assert back["flag"] is True
+        assert back["nested"] == [1.5, 2.5]
+        np.testing.assert_array_equal(back["weight"], obj["weight"])
+
+    def test_aliased_tensor_memoised(self, tmp_path):
+        p = str(tmp_path / "t.t7")
+        w = np.random.RandomState(0).normal(size=(4, 4)).astype(np.float32)
+        torch_file.save(p, {"a": w, "b": w})
+        back = torch_file.load(p)
+        assert back["a"] is back["b"], "aliasing lost in round-trip"
+
+    def test_list_roundtrip(self, tmp_path):
+        p = str(tmp_path / "t.t7")
+        torch_file.save(p, [1, 2, 3])
+        assert torch_file.load(p) == [1, 2, 3]
+
+
+def _run_tf(graph_def, feed_name, x, out_name):
+    tf.compat.v1.reset_default_graph()
+    with tf.compat.v1.Session() as sess:
+        tf.import_graph_def(graph_def, name="")
+        out = sess.graph.get_tensor_by_name(out_name + ":0")
+        return sess.run(out, {feed_name + ":0": x})
+
+
+def _mlp_graphdef():
+    g = tf.Graph()
+    rng = np.random.RandomState(0)
+    with g.as_default():
+        x = tf.compat.v1.placeholder(tf.float32, [None, 8], name="input")
+        w1 = tf.constant(rng.normal(size=(8, 16)).astype(np.float32))
+        b1 = tf.constant(rng.normal(size=(16,)).astype(np.float32))
+        h = tf.nn.relu(tf.nn.bias_add(tf.matmul(x, w1), b1))
+        w2 = tf.constant(rng.normal(size=(16, 4)).astype(np.float32))
+        b2 = tf.constant(rng.normal(size=(4,)).astype(np.float32))
+        y = tf.nn.softmax(tf.nn.bias_add(tf.matmul(h, w2), b2),
+                          name="output")
+    return g.as_graph_def()
+
+
+def _cnn_graphdef():
+    g = tf.Graph()
+    rng = np.random.RandomState(1)
+    with g.as_default():
+        x = tf.compat.v1.placeholder(tf.float32, [None, 16, 16, 3],
+                                     name="input")
+        k1 = tf.constant(rng.normal(size=(3, 3, 3, 8)).astype(np.float32) * .2)
+        b1 = tf.constant(rng.normal(size=(8,)).astype(np.float32) * .1)
+        h = tf.nn.relu(tf.nn.bias_add(
+            tf.nn.conv2d(x, k1, strides=[1, 1, 1, 1], padding="SAME"), b1))
+        h = tf.nn.max_pool2d(h, ksize=[1, 2, 2, 1], strides=[1, 2, 2, 1],
+                             padding="VALID")
+        h = tf.reshape(h, [-1, 8 * 8 * 8])
+        w = tf.constant(rng.normal(size=(8 * 8 * 8, 5)).astype(np.float32) * .1)
+        y = tf.tanh(tf.matmul(h, w), name="output")
+    return g.as_graph_def()
+
+
+class TestTensorflowLoader:
+    def test_mlp_golden_parity(self):
+        from bigdl_tpu.utils.tf import TensorflowLoader
+        gd = _mlp_graphdef()
+        model = TensorflowLoader.load(gd, ["input"], ["output"])
+        x = np.random.RandomState(2).normal(size=(6, 8)).astype(np.float32)
+        ours = np.asarray(model.evaluate().forward(x))
+        theirs = _run_tf(gd, "input", x, "output")
+        np.testing.assert_allclose(ours, theirs, rtol=1e-5, atol=1e-5)
+
+    def test_cnn_golden_parity(self):
+        from bigdl_tpu.utils.tf import TensorflowLoader
+        gd = _cnn_graphdef()
+        model = TensorflowLoader.load(gd, ["input"], ["output"])
+        x = np.random.RandomState(3).normal(
+            size=(2, 16, 16, 3)).astype(np.float32)
+        ours = np.asarray(model.evaluate().forward(x))
+        theirs = _run_tf(gd, "input", x, "output")
+        np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-4)
+
+    def test_unsupported_op_reports_name(self):
+        from bigdl_tpu.utils.tf import TensorflowLoader
+        g = tf.Graph()
+        with g.as_default():
+            x = tf.compat.v1.placeholder(tf.float32, [None, 4], name="input")
+            tf.math.cumsum(x, name="output")
+        with pytest.raises(ValueError, match="Cumsum"):
+            TensorflowLoader.load(g.as_graph_def(), ["input"], ["output"])
+
+
+class TestTensorflowSaver:
+    def test_export_roundtrip_through_tf(self, tmp_path):
+        """Export a trained-ish model, execute it with REAL TensorFlow,
+        compare with our forward (reference TensorflowSaverSpec)."""
+        from bigdl_tpu.utils.tf import saver
+        model = (nn.Sequential()
+                 .add(nn.SpatialConvolution(3, 8, 3, 3, 1, 1, -1, -1,
+                                            format="NHWC"))
+                 .add(nn.ReLU())
+                 .add(nn.SpatialMaxPooling(2, 2, 2, 2, format="NHWC"))
+                 .add(nn.Reshape((8 * 8 * 8,), batch_mode=True))
+                 .add(nn.Linear(8 * 8 * 8, 4))
+                 .add(nn.LogSoftMax()))
+        model._ensure_init()
+        path = str(tmp_path / "model.pb")
+        saver.save(model, [None, 16, 16, 3], path)
+
+        gd = tf.compat.v1.GraphDef()
+        with open(path, "rb") as f:
+            gd.ParseFromString(f.read())
+        x = np.random.RandomState(4).normal(
+            size=(2, 16, 16, 3)).astype(np.float32)
+        theirs = _run_tf(gd, "input", x, "output")
+        ours = np.asarray(model.evaluate().forward(x))
+        np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-4)
+
+    def test_import_of_our_export(self, tmp_path):
+        """save -> load round-trip through the GraphDef format."""
+        from bigdl_tpu.utils.tf import TensorflowLoader, saver
+        model = (nn.Sequential()
+                 .add(nn.Linear(6, 12)).add(nn.Tanh())
+                 .add(nn.Linear(12, 3)).add(nn.SoftMax()))
+        model._ensure_init()
+        path = str(tmp_path / "mlp.pb")
+        saver.save(model, [None, 6], path)
+        back = TensorflowLoader.load(path, ["input"], ["output"])
+        x = np.random.RandomState(5).normal(size=(4, 6)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(back.evaluate().forward(x)),
+            np.asarray(model.evaluate().forward(x)), rtol=1e-5, atol=1e-6)
+
+
+class TestTorchFileRegressions:
+    def test_distinct_lists_not_aliased(self, tmp_path):
+        p = str(tmp_path / "t.t7")
+        torch_file.save(p, {"a": [1, 2], "b": [3, 4]})
+        back = torch_file.load(p)
+        assert back["a"] == [1, 2] and back["b"] == [3, 4]
+
+    def test_nonfinite_numbers_load(self, tmp_path):
+        p = str(tmp_path / "t.t7")
+        torch_file.save(p, {"nan": float("nan"), "inf": float("inf")})
+        back = torch_file.load(p)
+        assert np.isnan(back["nan"]) and np.isinf(back["inf"])
+
+
+class TestLoaderRegressions:
+    def test_conv_fanout_not_contaminated_by_bias(self):
+        """BiasAdd fusion must not alias the raw Conv2D output when it has
+        other consumers."""
+        from bigdl_tpu.utils.tf import TensorflowLoader
+        g = tf.Graph()
+        rng = np.random.RandomState(7)
+        with g.as_default():
+            x = tf.compat.v1.placeholder(tf.float32, [None, 8], name="input")
+            w = tf.constant(rng.normal(size=(8, 8)).astype(np.float32))
+            b = tf.constant(np.full((8,), 100.0, np.float32))
+            mm = tf.matmul(x, w)
+            biased = tf.nn.bias_add(mm, b)
+            raw = tf.nn.relu(mm)
+            tf.add(biased, raw, name="output")
+        gd = g.as_graph_def()
+        model = TensorflowLoader.load(gd, ["input"], ["output"])
+        xv = rng.normal(size=(3, 8)).astype(np.float32)
+        ours = np.asarray(model.evaluate().forward(xv))
+        theirs = _run_tf(gd, "input", xv, "output")
+        np.testing.assert_allclose(ours, theirs, rtol=1e-5, atol=1e-5)
+
+    def test_dilated_conv_rejected(self):
+        from bigdl_tpu.utils.tf import TensorflowLoader
+        g = tf.Graph()
+        with g.as_default():
+            x = tf.compat.v1.placeholder(tf.float32, [None, 16, 16, 3],
+                                         name="input")
+            k = tf.constant(np.ones((3, 3, 3, 4), np.float32))
+            tf.nn.conv2d(x, k, strides=[1, 1, 1, 1], padding="SAME",
+                         dilations=[1, 2, 2, 1], name="output")
+        with pytest.raises(ValueError, match="dilations"):
+            TensorflowLoader.load(g.as_graph_def(), ["input"], ["output"])
