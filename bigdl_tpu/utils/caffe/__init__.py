@@ -1,0 +1,6 @@
+"""bigdl_tpu.utils.caffe — Caffe model interop (reference ``utils/caffe/``)."""
+
+from bigdl_tpu.utils.caffe.loader import CaffeLoader, load_caffe
+from bigdl_tpu.utils.caffe import persister
+
+__all__ = ["CaffeLoader", "load_caffe", "persister"]

@@ -1,0 +1,239 @@
+"""Caffe model importer.
+
+Reference equivalent: ``utils/caffe/CaffeLoader.scala:56,267`` — parse a
+prototxt (text) + caffemodel (binary) pair, convert layer-by-layer through
+registered converters into a Graph, and copy the trained blobs.
+
+The protobuf schema is a trimmed transcription of BVLC caffe.proto with the
+original field numbers (``caffe_minimal.proto``; the reference vendors the
+generated ``caffe/Caffe.java``).  Caffe's NCHW activations and OIHW conv
+kernels map onto the native layers via one transpose to HWIO.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.graph import Graph, ModuleNode
+from bigdl_tpu.utils.caffe import caffe_minimal_pb2 as pb
+
+
+def _blob_array(blob) -> np.ndarray:
+    data = np.asarray(blob.data, dtype=np.float32)
+    if blob.HasField("shape"):
+        return data.reshape(tuple(blob.shape.dim))
+    dims = [d for d in (blob.num, blob.channels, blob.height, blob.width)
+            if d > 0]
+    return data.reshape(tuple(dims) if dims else (-1,))
+
+
+class _ChannelSoftMax(nn.Module):
+    """Softmax over axis 1 — caffe's default normalization axis for any
+    blob rank (our ``nn.SoftMax`` normalizes the last axis, which only
+    coincides for 2-D blobs)."""
+
+    def apply(self, params, input, state, training=False, rng=None):
+        import jax
+        return jax.nn.softmax(input, axis=1), state
+
+
+def _conv_geom(cp):
+    kh = cp.kernel_h if cp.HasField("kernel_h") else (
+        cp.kernel_size[0] if cp.kernel_size else 1)
+    kw = cp.kernel_w if cp.HasField("kernel_w") else (
+        cp.kernel_size[-1] if cp.kernel_size else 1)
+    sh = cp.stride_h if cp.HasField("stride_h") else (
+        cp.stride[0] if cp.stride else 1)
+    sw = cp.stride_w if cp.HasField("stride_w") else (
+        cp.stride[-1] if cp.stride else 1)
+    ph = cp.pad_h if cp.HasField("pad_h") else (cp.pad[0] if cp.pad else 0)
+    pw = cp.pad_w if cp.HasField("pad_w") else (cp.pad[-1] if cp.pad else 0)
+    return kh, kw, sh, sw, ph, pw
+
+
+class CaffeLoader:
+    """(reference ``CaffeLoader.scala:56``)."""
+
+    def __init__(self, def_path: str, model_path: Optional[str] = None):
+        from google.protobuf import text_format
+        self.net = pb.NetParameter()
+        with open(def_path) as f:
+            text_format.Merge(f.read(), self.net)
+        self.blobs: Dict[str, List[np.ndarray]] = {}
+        if model_path:
+            weights = pb.NetParameter()
+            with open(model_path, "rb") as f:
+                weights.ParseFromString(f.read())
+            for layer in weights.layer:
+                if layer.blobs:
+                    self.blobs[layer.name] = [_blob_array(b)
+                                              for b in layer.blobs]
+
+    # -- graph construction ----------------------------------------------
+
+    def load(self) -> Graph:
+        """Convert to a Graph following bottom/top blob topology
+        (reference ``CaffeLoader.createCaffeGraph:267``)."""
+        tops: Dict[str, ModuleNode] = {}   # blob name -> producing node
+        inputs: List[ModuleNode] = []
+
+        for name in self.net.input:
+            node = ModuleNode(nn.Identity(name=name))
+            tops[name] = node
+            inputs.append(node)
+
+        last: Optional[ModuleNode] = None
+        for layer in self.net.layer:
+            if any(rule.phase == pb.TRAIN for rule in layer.include):
+                # TRAIN-only layer: alias its tops to the bottom so TEST
+                # consumers of an in-place top still resolve
+                for top in layer.top:
+                    if layer.bottom:
+                        tops[top] = tops[layer.bottom[0]]
+                continue
+            if layer.type == "Input":
+                node = ModuleNode(nn.Identity(name=layer.name))
+                for top in layer.top:
+                    tops[top] = node
+                inputs.append(node)
+                continue
+            node = ModuleNode(self._convert(layer))
+            preds = [self._pred(tops, layer, i)
+                     for i in range(len(layer.bottom))]
+            if preds:
+                node.inputs(*preds)
+            for top in layer.top:
+                tops[top] = node
+            last = node
+
+        if not inputs:
+            raise ValueError("prototxt declares no inputs "
+                             "(need input:/Input layers)")
+        return Graph(inputs, [last])
+
+    def _pred(self, tops, layer, i: int) -> ModuleNode:
+        """Predecessor node for bottom i, inserting a scale node for
+        Eltwise SUM coefficients (a - b imports as a + (-1)*b)."""
+        node = tops[layer.bottom[i]]
+        if layer.type == "Eltwise":
+            ep = layer.eltwise_param
+            coeffs = list(ep.coeff)
+            if coeffs and ep.operation == pb.EltwiseParameter.SUM:
+                c = coeffs[i] if i < len(coeffs) else 1.0
+                if c != 1.0:
+                    scaled = ModuleNode(nn.MulConstant(
+                        float(c), name=f"{layer.name}_coeff{i}"))
+                    scaled.inputs(node)
+                    return scaled
+        return node
+
+    # -- layer converters (reference Converter/LayerConverter) -----------
+
+    def _convert(self, layer) -> Optional[nn.Module]:
+        t = layer.type
+        name = layer.name
+        blobs = self.blobs.get(name, [])
+        if t == "Convolution":
+            cp = layer.convolution_param
+            kh, kw, sh, sw, ph, pw = _conv_geom(cp)
+            if any(d != 1 for d in cp.dilation):
+                raise ValueError(f"{name}: dilated caffe conv unsupported")
+            w = b = None
+            n_in = None
+            if blobs:
+                w = blobs[0]                       # OIHW
+                n_in = w.shape[1] * cp.group
+                w = np.transpose(w, (2, 3, 1, 0))  # -> HWIO
+                if cp.bias_term and len(blobs) > 1:
+                    b = blobs[1].reshape(-1)
+            if n_in is None:
+                raise ValueError(
+                    f"{name}: cannot infer input planes without a "
+                    "caffemodel blob")
+            return nn.SpatialConvolution(
+                n_in, int(cp.num_output), kw, kh, sw, sh, pw, ph,
+                n_group=int(cp.group), with_bias=bool(cp.bias_term),
+                init_weight=w, init_bias=b, name=name)
+        if t == "InnerProduct":
+            ip = layer.inner_product_param
+            if not blobs:
+                raise ValueError(f"{name}: InnerProduct needs weights")
+            w = blobs[0]                           # (out, in)
+            if ip.transpose:
+                w = w.T
+            b = blobs[1].reshape(-1) if (ip.bias_term and
+                                         len(blobs) > 1) else None
+            flat_in = int(w.shape[1])
+            lin = nn.Linear(flat_in, int(ip.num_output),
+                            with_bias=bool(ip.bias_term),
+                            init_weight=np.ascontiguousarray(w.T),
+                            init_bias=b, name=name)
+            # caffe flattens (N, C, H, W) implicitly at axis 1
+            seq = nn.Sequential(name=f"{name}_flatten")
+            seq.add(nn.InferReshape([0, -1])).add(lin)
+            return seq
+        if t == "Pooling":
+            pp = layer.pooling_param
+            kh = int(pp.kernel_h or pp.kernel_size)
+            kw = int(pp.kernel_w or pp.kernel_size)
+            sh = int(pp.stride_h or pp.stride)
+            sw = int(pp.stride_w or pp.stride)
+            ph = int(pp.pad_h or pp.pad)
+            pw = int(pp.pad_w or pp.pad)
+            if pp.global_pooling:
+                raise ValueError(f"{name}: global pooling unsupported")
+            if pp.pool == pb.PoolingParameter.MAX:
+                m = nn.SpatialMaxPooling(kw, kh, sw, sh, pw, ph, name=name)
+            else:
+                m = nn.SpatialAveragePooling(kw, kh, sw, sh, pw, ph,
+                                             name=name)
+            return m.ceil()   # caffe pooling uses ceil-mode output sizes
+        if t == "ReLU":
+            return nn.ReLU(name=name)
+        if t == "TanH":
+            return nn.Tanh(name=name)
+        if t == "Sigmoid":
+            return nn.Sigmoid(name=name)
+        if t == "Softmax":
+            axis = int(layer.softmax_param.axis) if layer.HasField(
+                "softmax_param") else 1
+            if axis != 1:
+                raise ValueError(f"{name}: Softmax axis {axis} unsupported")
+            return _ChannelSoftMax(name=name)
+        if t == "LRN":
+            lp = layer.lrn_param
+            if lp.norm_region == pb.LRNParameter.WITHIN_CHANNEL:
+                return nn.SpatialWithinChannelLRN(
+                    int(lp.local_size), float(lp.alpha), float(lp.beta),
+                    name=name)
+            return nn.SpatialCrossMapLRN(int(lp.local_size), float(lp.alpha),
+                                         float(lp.beta), float(lp.k),
+                                         name=name)
+        if t == "Dropout":
+            return nn.Dropout(float(layer.dropout_param.dropout_ratio),
+                              name=name)
+        if t == "Concat":
+            axis = int(layer.concat_param.axis)
+            return nn.JoinTable(axis + 1, name=name)   # 0-based -> 1-based
+        if t == "Eltwise":
+            ep = layer.eltwise_param
+            if list(ep.coeff) and ep.operation != pb.EltwiseParameter.SUM:
+                raise ValueError(f"{name}: Eltwise coeff is only defined "
+                                 "for SUM")
+            if ep.operation == pb.EltwiseParameter.SUM:
+                return nn.CAddTable(name=name)
+            if ep.operation == pb.EltwiseParameter.MAX:
+                return nn.CMaxTable(name=name)
+            return nn.CMulTable(name=name)
+        if t == "Flatten":
+            return nn.InferReshape([0, -1], name=name)
+        raise ValueError(f"unsupported caffe layer type {t!r} at {name!r} "
+                         "(reference CaffeLoader converter not implemented)")
+
+
+def load_caffe(def_path: str, model_path: Optional[str] = None) -> Graph:
+    """(reference ``Module.loadCaffe``)."""
+    return CaffeLoader(def_path, model_path).load()

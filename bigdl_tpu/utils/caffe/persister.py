@@ -1,0 +1,138 @@
+"""Caffe model exporter.
+
+Reference equivalent: ``utils/caffe/CaffePersister.scala`` — walk the model
+and emit a prototxt (structure) + caffemodel (structure + trained blobs)
+pair for the supported layer subset.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.utils.caffe import caffe_minimal_pb2 as pb
+
+
+def _blob(arr: np.ndarray):
+    b = pb.BlobProto()
+    b.shape.dim.extend(int(s) for s in arr.shape)
+    b.data.extend(float(v) for v in np.asarray(arr, np.float32).ravel())
+    return b
+
+
+def _flatten_chain(model) -> List[nn.Module]:
+    if isinstance(model, nn.Sequential):
+        out = []
+        for c in model.children:
+            out.extend(_flatten_chain(c))
+        return out
+    return [model]
+
+
+def save(model, def_path: str, model_path: str,
+         input_shape: Optional[List[int]] = None) -> None:
+    """Export a Sequential chain to prototxt + caffemodel
+    (reference ``CaffePersister.persist``)."""
+    from google.protobuf import text_format
+
+    model._ensure_init()
+    net = pb.NetParameter()
+    net.name = getattr(model, "name", "bigdl_tpu")
+    if input_shape is not None:
+        net.input.append("data")
+        shape = net.input_shape.add()
+        shape.dim.extend(int(s) for s in input_shape)
+
+    bottom = "data"
+    for i, m in enumerate(_flatten_chain(model)):
+        layer = net.layer.add()
+        layer.name = m.name
+        layer.bottom.append(bottom)
+        top = f"blob{i}"
+        layer.top.append(top)
+        bottom = top
+        _fill(layer, m)
+
+    with open(def_path, "w") as f:
+        # blobs stay out of the prototxt (structure only)
+        structure = pb.NetParameter()
+        structure.CopyFrom(net)
+        for layer in structure.layer:
+            del layer.blobs[:]
+        f.write(text_format.MessageToString(structure))
+    with open(model_path, "wb") as f:
+        f.write(net.SerializeToString())
+
+
+def _fill(layer, m) -> None:
+    p = m.params if m._params is not None else {}
+    if isinstance(m, nn.SpatialConvolution):
+        layer.type = "Convolution"
+        cp = layer.convolution_param
+        cp.num_output = m.n_output_plane
+        cp.bias_term = m.with_bias
+        cp.kernel_h, cp.kernel_w = m.kernel_h, m.kernel_w
+        cp.stride_h, cp.stride_w = m.stride_h, m.stride_w
+        if m.pad_w == -1 or m.pad_h == -1:
+            raise ValueError(f"{m.name}: caffe has no SAME padding")
+        cp.pad_h, cp.pad_w = m.pad_h, m.pad_w
+        cp.group = m.n_group
+        w = np.transpose(np.asarray(p["weight"]), (3, 2, 0, 1))  # HWIO->OIHW
+        layer.blobs.append(_blob(w))
+        if m.with_bias:
+            layer.blobs.append(_blob(np.asarray(p["bias"])))
+    elif isinstance(m, nn.Linear):
+        layer.type = "InnerProduct"
+        ip = layer.inner_product_param
+        ip.num_output = m.output_size
+        ip.bias_term = m.with_bias
+        layer.blobs.append(_blob(np.asarray(p["weight"]).T))  # -> (out, in)
+        if m.with_bias:
+            layer.blobs.append(_blob(np.asarray(p["bias"])))
+    elif isinstance(m, nn.SpatialMaxPooling):
+        layer.type = "Pooling"
+        pp = layer.pooling_param
+        pp.pool = pb.PoolingParameter.MAX
+        pp.kernel_h, pp.kernel_w = m.kh, m.kw
+        pp.stride_h, pp.stride_w = m.dh, m.dw
+        pp.pad_h, pp.pad_w = m.pad_h, m.pad_w
+    elif isinstance(m, nn.SpatialAveragePooling):
+        layer.type = "Pooling"
+        pp = layer.pooling_param
+        pp.pool = pb.PoolingParameter.AVE
+        pp.kernel_h, pp.kernel_w = m.kh, m.kw
+        pp.stride_h, pp.stride_w = m.dh, m.dw
+        pp.pad_h, pp.pad_w = m.pad_h, m.pad_w
+    elif isinstance(m, nn.ReLU):
+        layer.type = "ReLU"
+    elif isinstance(m, nn.Tanh):
+        layer.type = "TanH"
+    elif isinstance(m, nn.Sigmoid):
+        layer.type = "Sigmoid"
+    elif isinstance(m, nn.SoftMax):
+        layer.type = "Softmax"
+    elif isinstance(m, nn.SpatialCrossMapLRN):
+        layer.type = "LRN"
+        lp = layer.lrn_param
+        lp.local_size = m.size
+        lp.alpha, lp.beta, lp.k = m.alpha, m.beta, m.k
+    elif isinstance(m, nn.Dropout):
+        layer.type = "Dropout"
+        layer.dropout_param.dropout_ratio = m.p
+    elif isinstance(m, (nn.Reshape, nn.View, nn.InferReshape)):
+        size = (m.size if not isinstance(m, nn.View) else m.sizes)
+        if len([s for s in size if s != 0]) != 1:
+            # caffe Flatten collapses all per-sample dims to one; any other
+            # reshape has no caffe counterpart
+            raise ValueError(
+                f"{m.name}: reshape to {tuple(size)} has no caffe mapping "
+                "(only per-sample flatten exports as Flatten)")
+        layer.type = "Flatten"
+    elif isinstance(m, nn.Identity):
+        layer.type = "Input"
+    else:
+        raise ValueError(
+            f"layer {type(m).__name__} has no caffe export mapping "
+            "(reference CaffePersister scope)")

@@ -1,0 +1,66 @@
+"""LoggerFilter: console-noise redirection to a log file.
+
+Reference equivalent: ``utils/LoggerFilter.scala:34`` — log4j configuration
+that keeps the console at ERROR for chatty frameworks while appending
+everything to ``bigdl.log``; invoked at the top of every Train main.
+
+Properties (reference ``bigdl.utils.LoggerFilter.*``):
+- ``bigdl.utils.LoggerFilter.disable``    — leave logging untouched
+- ``bigdl.utils.LoggerFilter.logFile``    — path (default ./bigdl.log)
+- ``bigdl.utils.LoggerFilter.enableSparkLog`` — here: whether chatty
+  third-party loggers (jax/tensorflow) also go to the file
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Sequence
+
+# the chatty frameworks whose INFO spam is kept off the console
+# (the reference lists org.apache.spark.*; here it is the XLA stack)
+_CHATTY = ("jax", "jax._src", "tensorflow", "absl")
+
+
+def redirect_spark_info_logs(log_file: Optional[str] = None,
+                             chatty: Sequence[str] = _CHATTY) -> str:
+    """Keep the console readable: chatty loggers print only >= ERROR, while
+    EVERYTHING (bigdl_tpu + chatty, >= INFO) is appended to the log file.
+    Returns the log file path.  Name kept from the reference
+    (``LoggerFilter.redirectSparkInfoLogs``)."""
+    from bigdl_tpu.utils import config
+
+    if config.get_bool("bigdl.utils.LoggerFilter.disable", False):
+        return ""
+    path = (log_file or
+            config.get_property("bigdl.utils.LoggerFilter.logFile") or
+            os.path.join(os.getcwd(), "bigdl.log"))
+
+    fmt = logging.Formatter(
+        "%(asctime)s %(levelname)s %(name)s - %(message)s")
+    file_handler = logging.FileHandler(path)
+    file_handler.setLevel(logging.INFO)
+    file_handler.setFormatter(fmt)
+
+    console = logging.StreamHandler()
+    console.setLevel(logging.INFO)
+    console.setFormatter(fmt)
+
+    bigdl = logging.getLogger("bigdl_tpu")
+    bigdl.setLevel(logging.INFO)
+    bigdl.handlers = [file_handler, console]
+    bigdl.propagate = False
+
+    include_chatty = config.get_bool(
+        "bigdl.utils.LoggerFilter.enableSparkLog", True)
+    err_console = logging.StreamHandler()
+    err_console.setLevel(logging.ERROR)
+    err_console.setFormatter(fmt)
+    for name in chatty:
+        lg = logging.getLogger(name)
+        # detach from the root handler chain so INFO spam cannot reach the
+        # console; errors still print, INFO goes to the file
+        lg.propagate = False
+        lg.handlers = ([file_handler] if include_chatty else []) + [err_console]
+        lg.setLevel(logging.INFO)
+    return path

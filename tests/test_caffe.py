@@ -1,0 +1,122 @@
+"""Caffe interop: persister → loader round-trip with forward parity, and
+prototxt parsing (reference ``CaffeLoaderSpec`` / ``CaffePersisterSpec``)."""
+
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.utils.caffe import CaffeLoader, load_caffe, persister
+
+
+def _cnn():
+    m = (nn.Sequential()
+         .add(nn.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1, name="conv1"))
+         .add(nn.ReLU(name="relu1"))
+         .add(nn.SpatialMaxPooling(2, 2, 2, 2, name="pool1"))
+         .add(nn.SpatialCrossMapLRN(5, 1.0, 0.75, 1.0, name="lrn1"))
+         .add(nn.Reshape((8 * 8 * 8,), batch_mode=True, name="flat"))
+         .add(nn.Linear(8 * 8 * 8, 10, name="fc1"))
+         .add(nn.SoftMax(name="prob")))
+    m._ensure_init()
+    return m
+
+
+class TestCaffeRoundTrip:
+    def test_cnn_export_import_forward_parity(self, tmp_path):
+        model = _cnn()
+        proto = str(tmp_path / "net.prototxt")
+        weights = str(tmp_path / "net.caffemodel")
+        persister.save(model, proto, weights, input_shape=[1, 3, 16, 16])
+
+        back = load_caffe(proto, weights)
+        x = np.random.RandomState(0).normal(
+            size=(2, 3, 16, 16)).astype(np.float32)
+        ours = np.asarray(model.evaluate().forward(x))
+        theirs = np.asarray(back.evaluate().forward(x))
+        np.testing.assert_allclose(theirs, ours, rtol=1e-5, atol=1e-5)
+
+    def test_prototxt_is_text_and_structure_only(self, tmp_path):
+        model = _cnn()
+        proto = str(tmp_path / "net.prototxt")
+        weights = str(tmp_path / "net.caffemodel")
+        persister.save(model, proto, weights, input_shape=[1, 3, 16, 16])
+        text = open(proto).read()
+        assert 'type: "Convolution"' in text
+        assert "blobs" not in text
+        # binary weights larger than structure
+        import os
+        assert os.path.getsize(weights) > os.path.getsize(proto)
+
+    def test_mlp_roundtrip(self, tmp_path):
+        m = (nn.Sequential()
+             .add(nn.Linear(6, 12, name="ip1")).add(nn.Tanh(name="t"))
+             .add(nn.Linear(12, 3, name="ip2")).add(nn.SoftMax(name="p")))
+        m._ensure_init()
+        proto = str(tmp_path / "m.prototxt")
+        weights = str(tmp_path / "m.caffemodel")
+        persister.save(m, proto, weights, input_shape=[1, 6])
+        back = load_caffe(proto, weights)
+        x = np.random.RandomState(1).normal(size=(4, 6)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(back.evaluate().forward(x)),
+            np.asarray(m.evaluate().forward(x)), rtol=1e-5, atol=1e-6)
+
+    def test_unsupported_layer_reports_type(self, tmp_path):
+        proto = tmp_path / "bad.prototxt"
+        proto.write_text(
+            'name: "bad"\ninput: "data"\n'
+            'input_shape { dim: 1 dim: 4 }\n'
+            'layer { name: "x" type: "PReLU" bottom: "data" top: "x" }\n')
+        with pytest.raises(ValueError, match="PReLU"):
+            load_caffe(str(proto))
+
+    def test_train_phase_layers_skipped(self, tmp_path):
+        m = (nn.Sequential().add(nn.Linear(4, 2, name="ip")).add(
+            nn.SoftMax(name="p")))
+        m._ensure_init()
+        proto = str(tmp_path / "m.prototxt")
+        weights = str(tmp_path / "m.caffemodel")
+        persister.save(m, proto, weights, input_shape=[1, 4])
+        # append a TRAIN-only layer to the prototxt
+        with open(proto, "a") as f:
+            f.write('layer { name: "drop" type: "Dropout" bottom: "blob1" '
+                    'top: "blob1" include { phase: TRAIN } }\n')
+        back = load_caffe(proto, weights)
+        x = np.ones((1, 4), np.float32)
+        out = np.asarray(back.evaluate().forward(x))
+        assert out.shape == (1, 2)
+
+
+class TestCaffeRegressions:
+    def test_eltwise_sum_coeff_subtraction(self, tmp_path):
+        proto = tmp_path / "sub.prototxt"
+        proto.write_text(
+            'name: "sub"\ninput: "a"\ninput: "b"\n'
+            'input_shape { dim: 1 dim: 4 }\ninput_shape { dim: 1 dim: 4 }\n'
+            'layer { name: "diff" type: "Eltwise" bottom: "a" bottom: "b" '
+            'top: "diff" eltwise_param { operation: SUM coeff: 1 coeff: -1 } }\n')
+        net = load_caffe(str(proto))
+        a = np.asarray([[1., 2., 3., 4.]], np.float32)
+        b = np.asarray([[0.5, 0.5, 0.5, 0.5]], np.float32)
+        out = np.asarray(net.evaluate().forward([a, b]))
+        np.testing.assert_allclose(out, a - b)
+
+    def test_channel_softmax_on_4d(self, tmp_path):
+        proto = tmp_path / "sm.prototxt"
+        proto.write_text(
+            'name: "sm"\ninput: "data"\n'
+            'input_shape { dim: 1 dim: 3 dim: 2 dim: 2 }\n'
+            'layer { name: "prob" type: "Softmax" bottom: "data" top: "prob" }\n')
+        net = load_caffe(str(proto))
+        x = np.random.RandomState(0).normal(size=(1, 3, 2, 2)).astype(np.float32)
+        out = np.asarray(net.evaluate().forward(x))
+        np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-5)
+
+    def test_non_flatten_reshape_export_rejected(self, tmp_path):
+        from bigdl_tpu.models.lenet import lenet5
+        m = lenet5(10)
+        m._ensure_init()
+        with pytest.raises(ValueError, match="no caffe mapping"):
+            persister.save(m, str(tmp_path / "x.prototxt"),
+                           str(tmp_path / "x.caffemodel"),
+                           input_shape=[1, 784])

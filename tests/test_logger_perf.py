@@ -1,0 +1,31 @@
+"""LoggerFilter + perf harness coverage."""
+
+import logging
+import os
+
+from bigdl_tpu.models import perf
+from bigdl_tpu.utils.logger_filter import redirect_spark_info_logs
+
+
+def test_logger_filter_writes_file(tmp_path):
+    log = str(tmp_path / "bigdl.log")
+    path = redirect_spark_info_logs(log)
+    assert path == log
+    logging.getLogger("bigdl_tpu").info("hello from the driver")
+    for h in logging.getLogger("bigdl_tpu").handlers:
+        h.flush()
+    assert "hello from the driver" in open(log).read()
+    # restore default handlers for other tests
+    logging.getLogger("bigdl_tpu").handlers = []
+    logging.getLogger("bigdl_tpu").propagate = True
+
+
+def test_perf_harness_lenet():
+    opt = perf.main(["-m", "lenet5", "-b", "32", "-i", "3"])
+    assert opt.metrics.get("computing time for each node") > 0
+
+
+def test_perf_harness_distributed():
+    opt = perf.main(["-m", "lenet5", "-b", "32", "-i", "3",
+                     "--partitions", "8"])
+    assert opt.metrics.get("computing time for each node") > 0
