@@ -57,10 +57,11 @@ def base_parser(description: str) -> argparse.ArgumentParser:
 
 
 def init_logging() -> None:
-    """(reference ``LoggerFilter.redirectSparkInfoLogs`` in every Train)."""
-    logging.basicConfig(
-        level=logging.INFO,
-        format="%(asctime)s %(levelname)s %(name)s - %(message)s")
+    """Driver logging bootstrap: console + ``bigdl.log`` via LoggerFilter
+    (the reference calls ``LoggerFilter.redirectSparkInfoLogs`` at the top
+    of every Train main)."""
+    from bigdl_tpu.utils.logger_filter import redirect_spark_info_logs
+    redirect_spark_info_logs()
 
 
 def load_snapshots(args, build_model: Callable, build_optim: Callable):

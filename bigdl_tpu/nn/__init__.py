@@ -21,6 +21,8 @@ from bigdl_tpu.nn.conv import (SpatialConvolution, SpatialShareConvolution,
 from bigdl_tpu.nn.pooling import (SpatialMaxPooling, SpatialAveragePooling,
                                   VolumetricMaxPooling, RoiPooling)
 from bigdl_tpu.ops.nms import Nms, nms_mask
+from bigdl_tpu.nn.attention import (MultiHeadAttention,
+                                    scaled_dot_product_attention)
 from bigdl_tpu.nn.activation import (ReLU, ReLU6, LeakyReLU, ELU, PReLU,
                                      RReLU, Tanh, TanhShrink, Sigmoid,
                                      LogSigmoid, SoftMax, SoftMin, LogSoftMax,

@@ -1,0 +1,93 @@
+"""Attention layers.
+
+The reference has NO attention op (SURVEY §5.7: sequence handling is
+``Recurrent`` unrolling only) — this module is the TPU-native long-context
+extension the rebuild treats as first-class: a standard multi-head attention
+whose sequence dimension can be sharded across the mesh's ``seq`` axis via
+ring attention (``bigdl_tpu/parallel/ring_attention.py``).
+
+Shapes follow (batch, time, dim); heads split the last dim.  All matmuls are
+batched (B*H GEMMs) so XLA tiles them onto the MXU.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn import init as init_methods
+from bigdl_tpu.nn.module import Module
+
+
+def scaled_dot_product_attention(q: jnp.ndarray, k: jnp.ndarray,
+                                 v: jnp.ndarray,
+                                 causal: bool = False,
+                                 mask: Optional[jnp.ndarray] = None
+                                 ) -> jnp.ndarray:
+    """(B, T, H, Dh) q/k/v -> (B, T, H, Dh); softmax over the key axis."""
+    dh = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(dh)
+    # finite mask value: a fully-masked row (all-padding) must softmax to
+    # uniform junk rather than NaN (-inf rows give 0/0)
+    neg_big = jnp.asarray(jnp.finfo(scores.dtype).min, scores.dtype)
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        cm = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        scores = jnp.where(cm[None, None], scores, neg_big)
+    if mask is not None:
+        scores = jnp.where(mask, scores, neg_big)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+class MultiHeadAttention(Module):
+    """Self-attention over (B, T, D) input; table input (q_src, kv_src)
+    gives cross-attention."""
+
+    def __init__(self, hidden_size: int, n_head: int, causal: bool = False,
+                 with_bias: bool = True, name=None):
+        super().__init__(name)
+        if hidden_size % n_head != 0:
+            raise ValueError(f"hidden {hidden_size} % heads {n_head} != 0")
+        self.hidden_size = hidden_size
+        self.n_head = n_head
+        self.head_dim = hidden_size // n_head
+        self.causal = causal
+        self.with_bias = with_bias
+
+    def _init_params(self, rng):
+        ks = jax.random.split(rng, 4)
+        d = self.hidden_size
+        xavier = init_methods.Xavier()
+        p = {}
+        for key, name in zip(ks, ("wq", "wk", "wv", "wo")):
+            p[name] = xavier(key, (d, d), d, d)
+        if self.with_bias:
+            for name in ("bq", "bk", "bv", "bo"):
+                p[name] = jnp.zeros((d,))
+        return p
+
+    def _project(self, params, x, w, b):
+        y = x @ params[w]
+        if self.with_bias:
+            y = y + params[b]
+        bsz, t, _ = y.shape
+        return y.reshape(bsz, t, self.n_head, self.head_dim)
+
+    def apply(self, params, input, state, training=False, rng=None):
+        if isinstance(input, (list, tuple)):
+            q_src, kv_src = input[0], input[1]
+        else:
+            q_src = kv_src = input
+        q = self._project(params, q_src, "wq", "bq")
+        k = self._project(params, kv_src, "wk", "bk")
+        v = self._project(params, kv_src, "wv", "bv")
+        out = scaled_dot_product_attention(q, k, v, causal=self.causal)
+        bsz, t = out.shape[0], out.shape[1]
+        out = out.reshape(bsz, t, self.hidden_size) @ params["wo"]
+        if self.with_bias:
+            out = out + params["bo"]
+        return out, state

@@ -8,5 +8,8 @@ collectives (``psum_scatter`` / ``all_gather``) under ``shard_map`` over a
 
 from bigdl_tpu.parallel.all_reduce import AllReduceParameter
 from bigdl_tpu.parallel.distri_optimizer import DistriOptimizer
+from bigdl_tpu.parallel.ring_attention import (ring_attention,
+                                               ring_self_attention)
 
-__all__ = ["AllReduceParameter", "DistriOptimizer"]
+__all__ = ["AllReduceParameter", "DistriOptimizer", "ring_attention",
+           "ring_self_attention"]

@@ -1,0 +1,108 @@
+"""Ring attention: sequence/context parallelism over the mesh's ``seq`` axis.
+
+No reference equivalent — the reference caps sequence length at single-node
+memory (SURVEY §5.7); this is the TPU-native long-context path the rebuild
+adds as a first-class capability.
+
+Design (Liu et al., Ring Attention with Blockwise Transformers): each device
+holds a T/N slice of q, k, v.  N steps of a ring: compute blockwise
+attention of the local queries against the currently-held k/v block with an
+online (streaming) softmax, then ``lax.ppermute`` the k/v block to the next
+device over ICI.  Peak memory is O(T/N) per device and the k/v transfer
+overlaps with the block matmuls.
+
+The online-softmax accumulators are the flash-attention triple (running max
+``m``, normalizer ``l``, unnormalized output ``o``).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bigdl_tpu.parallel.all_reduce import shard_map
+
+
+def _ring_attention_shard(q, k, v, axis_name: str, causal: bool):
+    """Per-shard body.  q/k/v: (B, T_local, H, Dh) — the local sequence
+    slice; runs inside shard_map over ``axis_name``."""
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    bsz, t, h, dh = q.shape
+    scale = 1.0 / math.sqrt(dh)
+    q_pos = my * t + jnp.arange(t)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    neg_big = jnp.asarray(jnp.finfo(q.dtype).min, q.dtype)
+
+    def step(i, carry):
+        k_cur, v_cur, m, l, o = carry
+        # the block that started on device (my - i) is now on my
+        src = (my - i) % n
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cur) * scale
+        if causal:
+            k_pos = src * t + jnp.arange(t)
+            allowed = q_pos[:, None] >= k_pos[None, :]
+            scores = jnp.where(allowed[None, None], scores, neg_big)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new[..., None])
+        l = l * alpha + p.sum(axis=-1)
+        o = o * alpha[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v_cur)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return k_nxt, v_nxt, m_new, l, o
+
+    m0 = jnp.full((bsz, h, t), neg_big, q.dtype)
+    l0 = jnp.zeros((bsz, h, t), q.dtype)
+    o0 = jnp.zeros((bsz, h, t, dh), q.dtype)
+    _, _, m, l, o = lax.fori_loop(0, n, step, (k, v, m0, l0, o0))
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3))      # -> (B, T_local, H, Dh)
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq",
+                   causal: bool = False):
+    """Full-sequence attention with q/k/v sharded on dim 1 over ``axis``.
+
+    Inputs are global (B, T, H, Dh) arrays (or already-sharded); output is
+    sharded the same way.  Numerically matches
+    :func:`bigdl_tpu.nn.attention.scaled_dot_product_attention`.
+    """
+    spec = P(None, axis)
+    fn = shard_map(
+        partial(_ring_attention_shard, axis_name=axis, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False)
+    return fn(q, k, v)
+
+
+def ring_self_attention(mha, params, x, mesh: Mesh, axis: str = "seq"):
+    """Run a :class:`~bigdl_tpu.nn.attention.MultiHeadAttention` layer's
+    forward with the sequence dim sharded over ``axis``.
+
+    The q/k/v/out projections are per-position (shard-local); only the
+    attention itself communicates, via the ring.
+    """
+    def shard_fn(p, xs):
+        q = mha._project(p, xs, "wq", "bq")
+        k = mha._project(p, xs, "wk", "bk")
+        v = mha._project(p, xs, "wv", "bv")
+        out = _ring_attention_shard(q, k, v, axis_name=axis,
+                                    causal=mha.causal)
+        bsz, t = out.shape[0], out.shape[1]
+        out = out.reshape(bsz, t, mha.hidden_size) @ p["wo"]
+        if mha.with_bias:
+            out = out + p["bo"]
+        return out
+
+    fn = shard_map(shard_fn, mesh=mesh,
+                   in_specs=(P(), P(None, axis)),
+                   out_specs=P(None, axis), check_rep=False)
+    return fn(params, x)

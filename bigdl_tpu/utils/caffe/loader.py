@@ -79,13 +79,13 @@ class CaffeLoader:
         (reference ``CaffeLoader.createCaffeGraph:267``)."""
         tops: Dict[str, ModuleNode] = {}   # blob name -> producing node
         inputs: List[ModuleNode] = []
+        produced: List[str] = []           # blob names, production order
+        consumed: set = set()
 
         for name in self.net.input:
             node = ModuleNode(nn.Identity(name=name))
             tops[name] = node
             inputs.append(node)
-
-        last: Optional[ModuleNode] = None
         for layer in self.net.layer:
             if any(rule.phase == pb.TRAIN for rule in layer.include):
                 # TRAIN-only layer: alias its tops to the bottom so TEST
@@ -105,14 +105,27 @@ class CaffeLoader:
                      for i in range(len(layer.bottom))]
             if preds:
                 node.inputs(*preds)
+            consumed.update(b for b in layer.bottom)
             for top in layer.top:
                 tops[top] = node
-            last = node
+                produced.append(top)
 
         if not inputs:
             raise ValueError("prototxt declares no inputs "
                              "(need input:/Input layers)")
-        return Graph(inputs, [last])
+        # outputs = dangling tops: produced blobs nothing consumes
+        # (in-place layers re-produce their bottom name, so dedupe keeping
+        # the LAST producer via the tops map)
+        out_nodes, seen = [], set()
+        for name in produced:
+            if name in consumed or name in seen:
+                continue
+            seen.add(name)
+            out_nodes.append(tops[name])
+        if not out_nodes:
+            raise ValueError("prototxt has no output layer (every top is "
+                             "consumed, or the net is input-only)")
+        return Graph(inputs, out_nodes)
 
     def _pred(self, tops, layer, i: int) -> ModuleNode:
         """Predecessor node for bottom i, inserting a scale node for
@@ -190,7 +203,11 @@ class CaffeLoader:
             else:
                 m = nn.SpatialAveragePooling(kw, kh, sw, sh, pw, ph,
                                              name=name)
-            return m.ceil()   # caffe pooling uses ceil-mode output sizes
+            # caffe default is ceil-mode output sizing; round_mode: FLOOR
+            # (BVLC PoolingParameter field 13) selects floor
+            if pp.round_mode == pb.PoolingParameter.FLOOR:
+                return m
+            return m.ceil()
         if t == "ReLU":
             return nn.ReLU(name=name)
         if t == "TanH":
@@ -200,12 +217,18 @@ class CaffeLoader:
         if t == "Softmax":
             axis = int(layer.softmax_param.axis) if layer.HasField(
                 "softmax_param") else 1
+            if axis == -1:
+                return nn.SoftMax(name=name)    # last-axis (our exporter)
             if axis != 1:
                 raise ValueError(f"{name}: Softmax axis {axis} unsupported")
             return _ChannelSoftMax(name=name)
         if t == "LRN":
             lp = layer.lrn_param
             if lp.norm_region == pb.LRNParameter.WITHIN_CHANNEL:
+                if abs(float(lp.k) - 1.0) > 1e-9:
+                    raise ValueError(
+                        f"{name}: within-channel LRN with k={lp.k} "
+                        "unsupported (k is fixed at 1)")
                 return nn.SpatialWithinChannelLRN(
                     int(lp.local_size), float(lp.alpha), float(lp.beta),
                     name=name)

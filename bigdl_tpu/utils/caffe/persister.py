@@ -91,20 +91,17 @@ def _fill(layer, m) -> None:
         layer.blobs.append(_blob(np.asarray(p["weight"]).T))  # -> (out, in)
         if m.with_bias:
             layer.blobs.append(_blob(np.asarray(p["bias"])))
-    elif isinstance(m, nn.SpatialMaxPooling):
+    elif isinstance(m, (nn.SpatialMaxPooling, nn.SpatialAveragePooling)):
         layer.type = "Pooling"
         pp = layer.pooling_param
-        pp.pool = pb.PoolingParameter.MAX
+        pp.pool = (pb.PoolingParameter.MAX
+                   if isinstance(m, nn.SpatialMaxPooling)
+                   else pb.PoolingParameter.AVE)
         pp.kernel_h, pp.kernel_w = m.kh, m.kw
         pp.stride_h, pp.stride_w = m.dh, m.dw
         pp.pad_h, pp.pad_w = m.pad_h, m.pad_w
-    elif isinstance(m, nn.SpatialAveragePooling):
-        layer.type = "Pooling"
-        pp = layer.pooling_param
-        pp.pool = pb.PoolingParameter.AVE
-        pp.kernel_h, pp.kernel_w = m.kh, m.kw
-        pp.stride_h, pp.stride_w = m.dh, m.dw
-        pp.pad_h, pp.pad_w = m.pad_h, m.pad_w
+        pp.round_mode = (pb.PoolingParameter.CEIL if m.ceil_mode
+                         else pb.PoolingParameter.FLOOR)
     elif isinstance(m, nn.ReLU):
         layer.type = "ReLU"
     elif isinstance(m, nn.Tanh):
@@ -113,6 +110,11 @@ def _fill(layer, m) -> None:
         layer.type = "Sigmoid"
     elif isinstance(m, nn.SoftMax):
         layer.type = "Softmax"
+        # our SoftMax normalizes the LAST axis; record that explicitly so
+        # the round-trip (and axis-aware caffe) keeps the semantics
+        layer.softmax_param.axis = -1
+    elif type(m).__name__ == "_ChannelSoftMax":
+        layer.type = "Softmax"      # caffe default axis 1 == this module
     elif isinstance(m, nn.SpatialCrossMapLRN):
         layer.type = "LRN"
         lp = layer.lrn_param

@@ -120,3 +120,22 @@ class TestCaffeRegressions:
             persister.save(m, str(tmp_path / "x.prototxt"),
                            str(tmp_path / "x.caffemodel"),
                            input_shape=[1, 784])
+
+    def test_floor_pooling_roundtrip_preserves_shape(self, tmp_path):
+        """round_mode FLOOR survives export->import (caffe defaults to
+        ceil; shape-changing silently without round_mode)."""
+        m = (nn.Sequential()
+             .add(nn.SpatialConvolution(1, 2, 3, 3, name="c"))
+             .add(nn.SpatialMaxPooling(2, 2, 2, 2, name="p"))  # floor: 6->3
+             .add(nn.InferReshape([0, -1], name="f"))
+             .add(nn.Linear(2 * 6 * 6, 2, name="ip"))
+             .add(nn.SoftMax(name="sm")))
+        m._ensure_init()
+        x = np.random.RandomState(2).normal(size=(1, 1, 15, 15)).astype(np.float32)
+        ours = np.asarray(m.evaluate().forward(x))   # pool 13->6 floor
+        proto = str(tmp_path / "f.prototxt")
+        weights = str(tmp_path / "f.caffemodel")
+        persister.save(m, proto, weights, input_shape=[1, 1, 15, 15])
+        back = load_caffe(proto, weights)
+        theirs = np.asarray(back.evaluate().forward(x))
+        np.testing.assert_allclose(theirs, ours, rtol=1e-5, atol=1e-5)
