@@ -160,6 +160,30 @@ class DataSet:
         return ShardedDataSet(records, partition_num)
 
     @staticmethod
+    def seq_file_folder(path: str) -> "LocalDataSet":
+        """Hadoop SequenceFile tree of JPEG records (reference
+        ``SeqFileFolder.files``, ``dataset/DataSet.scala:500-558``): every
+        ``*.seq`` under ``path``; records decode to BGR
+        :class:`~bigdl_tpu.dataset.image.LabeledImage`."""
+        import io
+        import os as _os
+        from bigdl_tpu.dataset.image import LabeledImage
+        from bigdl_tpu.dataset.seqfile import read_image_seqfile
+        from PIL import Image
+
+        records = []
+        for root, _, files in sorted(_os.walk(path)):
+            for fname in sorted(files):
+                if not fname.endswith(".seq"):
+                    continue
+                for _, label, data in read_image_seqfile(
+                        _os.path.join(root, fname)):
+                    rgb = np.asarray(Image.open(io.BytesIO(data))
+                                     .convert("RGB"), dtype=np.float32)
+                    records.append(LabeledImage(rgb[..., ::-1], label))
+        return LocalDataSet(records)
+
+    @staticmethod
     def image_folder(path: str, scale_to: int = 256) -> "LocalDataSet":
         """Label-per-subdirectory image tree (reference
         ``ImageFolder.paths``, ``dataset/DataSet.scala:419``).  Labels are

@@ -1,0 +1,128 @@
+"""Multi-threaded image-to-batch assembly + prefetching transformer.
+
+Reference equivalent: ``dataset/image/MTLabeledBGRImgToBatch.scala:46`` —
+the parallel CPU path that crops/flips/normalizes decoded images into the
+training batch concurrently with compute.
+
+Two pieces:
+- :func:`assemble_batch` — pack N HWC uint8 images into one float32 NCHW
+  batch (normalize + crop + optional hflip), dispatched to the native
+  std::thread implementation (``native/batch.cc``) when built, else numpy.
+- :class:`Prefetch` — a transformer that runs its upstream iterator in a
+  background thread with a bounded queue, so host-side batch prep overlaps
+  device steps (the reference's MT pipeline role in the driver loop).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import queue
+import threading
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu.dataset.native import load_native
+from bigdl_tpu.dataset.transformer import Transformer
+
+
+def assemble_batch(images: Sequence[np.ndarray],
+                   crop: Tuple[int, int],
+                   offsets: np.ndarray,
+                   flips: np.ndarray,
+                   mean: Sequence[float],
+                   std: Sequence[float],
+                   n_threads: int = 4) -> np.ndarray:
+    """images: HWC uint8 arrays (any sizes >= crop); offsets: (N, 2) int32
+    (y, x) crop origins; flips: (N,) uint8.  Returns (N, C, crop_h, crop_w)
+    float32: out = (crop(img) - mean) / std, optionally h-flipped."""
+    n = len(images)
+    ch, cw = crop
+    channels = images[0].shape[2] if images[0].ndim == 3 else 1
+    imgs = [np.ascontiguousarray(
+        im if im.ndim == 3 else im[:, :, None], dtype=np.uint8)
+        for im in images]
+    offsets = np.ascontiguousarray(offsets, dtype=np.int32)
+    flips = np.ascontiguousarray(flips, dtype=np.uint8)
+    mean_a = np.asarray(mean, np.float32)
+    std_a = np.asarray(std, np.float32)
+    out = np.empty((n, channels, ch, cw), np.float32)
+
+    lib = load_native()
+    if lib is not None:
+        ptrs = (ctypes.c_void_p * n)(
+            *[im.ctypes.data_as(ctypes.c_void_p) for im in imgs])
+        heights = np.asarray([im.shape[0] for im in imgs], np.int32)
+        widths = np.asarray([im.shape[1] for im in imgs], np.int32)
+        lib.assemble_batch(
+            ptrs,
+            heights.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+            widths.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+            n, channels, ch, cw,
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+            flips.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+            mean_a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            std_a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            int(n_threads))
+        return out
+
+    for i, im in enumerate(imgs):
+        oy, ox = int(offsets[i, 0]), int(offsets[i, 1])
+        patch = im[oy:oy + ch, ox:ox + cw].astype(np.float32)
+        if flips[i]:
+            patch = patch[:, ::-1]
+        out[i] = ((patch - mean_a) / std_a).transpose(2, 0, 1)
+    return out
+
+
+class Prefetch(Transformer):
+    """Run the upstream iterator in a daemon thread with a bounded queue
+    (the MT producer half of MTLabeledBGRImgToBatch)."""
+
+    def __init__(self, depth: int = 4):
+        self.depth = depth
+
+    def __call__(self, it: Iterator) -> Iterator:
+        q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+        _END = object()
+
+        def put(item) -> bool:
+            """Bounded put that gives up when the consumer is gone."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def producer():
+            try:
+                for item in it:
+                    if not put(item):
+                        return        # consumer abandoned the generator
+                put(_END)
+            except BaseException as e:  # surface upstream errors downstream
+                put(e)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            # early exit (break/exception/GeneratorExit): release the
+            # producer so it does not pin the upstream iterator forever
+            stop.set()
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass

@@ -1,0 +1,82 @@
+"""ctypes bindings for the native runtime library (``native/``).
+
+Reference equivalent: the role of the ``bigdl-core`` MKL-JNI submodule —
+native code for the CPU-side hot paths.  On TPU the numeric hot path is
+XLA's, so the native layer covers what still runs on host CPUs: SequenceFile
+IO and multi-threaded batch assembly.
+
+The library is built on demand with ``make`` (g++); every entry point has a
+pure-Python fallback so the framework works without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libbigdl_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def load_native() -> Optional[ctypes.CDLL]:
+    """The shared library, building it on first use; None when unavailable
+    (no sources, no compiler, ...)."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.path.isdir(_NATIVE_DIR):
+            # always invoke make: it is a no-op when the .so is fresh and
+            # rebuilds when the C++ sources changed (stale-binary guard)
+            try:
+                subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                               capture_output=True, timeout=120)
+            except Exception:
+                pass  # fall through: a previously-built .so may still load
+        if not os.path.exists(_LIB_PATH):
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        lib.seqfile_open.restype = ctypes.c_void_p
+        lib.seqfile_open.argtypes = [ctypes.c_char_p]
+        lib.seqfile_next.restype = ctypes.c_int
+        lib.seqfile_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_int)]
+        lib.seqfile_close.argtypes = [ctypes.c_void_p]
+        lib.seqfile_create.restype = ctypes.c_void_p
+        lib.seqfile_create.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                       ctypes.c_char_p, ctypes.c_char_p]
+        lib.seqfile_append.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_int, ctypes.c_char_p,
+                                       ctypes.c_int]
+        lib.seqfile_close_writer.argtypes = [ctypes.c_void_p]
+        lib.assemble_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p),           # images
+            ctypes.POINTER(ctypes.c_int),              # heights
+            ctypes.POINTER(ctypes.c_int),              # widths
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int),              # offsets
+            ctypes.POINTER(ctypes.c_ubyte),            # flips
+            ctypes.POINTER(ctypes.c_float),            # mean
+            ctypes.POINTER(ctypes.c_float),            # std
+            ctypes.POINTER(ctypes.c_float),            # out
+            ctypes.c_int]                              # n_threads
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return load_native() is not None

@@ -1,0 +1,207 @@
+"""Hadoop SequenceFile records: the reference's ImageNet storage format.
+
+Reference equivalents: ``dataset/DataSet.scala:500-558`` (``SeqFileFolder``
+— training reads Hadoop SequenceFiles of JPEG bytes) and the seq-file
+reader/writer in ``dataset/image/``.
+
+Reading prefers the native C++ implementation (``native/seqfile.cc`` via
+ctypes); a pure-Python reader/writer covers toolchain-less environments and
+fixture generation.  Keys are Hadoop ``Text`` payloads (here: "path label"
+strings), values are raw byte blobs (the JPEG), with the ``BytesWritable``
+4-byte length prefix the reference's writer produces.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterator, List, Optional, Tuple
+
+from bigdl_tpu.dataset.native import load_native
+
+SYNC = bytes(range(16))          # fixed sync marker for files we write
+
+
+# ---------------------------------------------------------------------------
+# pure-Python implementation
+# ---------------------------------------------------------------------------
+
+def _read_vlong(f) -> Optional[int]:
+    b = f.read(1)
+    if not b:
+        return None
+    first = struct.unpack("b", b)[0]
+    if first >= -112:
+        return first
+    neg = first < -120
+    n = -(first + 120) if neg else -(first + 112)
+    v = 0
+    for byte in f.read(n):
+        v = (v << 8) | byte
+    return ~v if neg else v
+
+
+def _write_vlong(f, v: int) -> None:
+    if -112 <= v <= 127:
+        f.write(struct.pack("b", v))
+        return
+    length = -112
+    if v < 0:
+        v = ~v
+        length = -120
+    tmp = v
+    n = 0
+    while tmp:
+        tmp >>= 8
+        n += 1
+    f.write(struct.pack("b", length - n))
+    for i in range(n - 1, -1, -1):
+        f.write(bytes([(v >> (8 * i)) & 0xFF]))
+
+
+def _write_text(f, s: bytes) -> None:
+    _write_vlong(f, len(s))
+    f.write(s)
+
+
+def _read_text(f) -> bytes:
+    n = _read_vlong(f)
+    if n is None or n < 0:
+        raise IOError("truncated Text")
+    return f.read(n)
+
+
+def py_read_records(path: str) -> Iterator[Tuple[bytes, bytes]]:
+    """(key, value) byte pairs from an uncompressed SequenceFile."""
+    with open(path, "rb") as f:
+        if f.read(3) != b"SEQ":
+            raise IOError(f"{path} is not a SequenceFile")
+        version = f.read(1)[0]
+        if version < 5:
+            raise IOError(f"unsupported SequenceFile version {version}")
+        _read_text(f)            # key class
+        _read_text(f)            # value class
+        compressed, block = f.read(1)[0], f.read(1)[0]
+        if compressed or block:
+            raise IOError("compressed SequenceFiles are unsupported")
+        (meta,) = struct.unpack(">i", f.read(4))
+        for _ in range(meta):
+            _read_text(f)
+            _read_text(f)
+        sync = f.read(16)
+        while True:
+            raw = f.read(4)
+            if len(raw) < 4:
+                return
+            (rec_len,) = struct.unpack(">i", raw)
+            if rec_len == -1:
+                marker = f.read(16)
+                if marker != sync:
+                    raise IOError(f"bad sync marker in {path}")
+                continue
+            (key_len,) = struct.unpack(">i", f.read(4))
+            if key_len < 0 or key_len > rec_len:
+                raise IOError(f"corrupt SequenceFile record in {path}")
+            key = f.read(key_len)
+            value = f.read(rec_len - key_len)
+            yield key, value
+
+
+def py_write_records(path: str, records, key_class: str = "org.apache.hadoop.io.Text",
+                     value_class: str = "org.apache.hadoop.io.BytesWritable"
+                     ) -> None:
+    with open(path, "wb") as f:
+        f.write(b"SEQ")
+        f.write(bytes([6]))
+        _write_text(f, key_class.encode())
+        _write_text(f, value_class.encode())
+        f.write(b"\x00\x00")
+        f.write(struct.pack(">i", 0))
+        f.write(SYNC)
+        since = 0
+        for key, value in records:
+            if since > 2000:
+                f.write(struct.pack(">i", -1))
+                f.write(SYNC)
+                since = 0
+            f.write(struct.pack(">i", len(key) + len(value)))
+            f.write(struct.pack(">i", len(key)))
+            f.write(key)
+            f.write(value)
+            since += len(key) + len(value) + 8
+
+
+# ---------------------------------------------------------------------------
+# native-preferred public API
+# ---------------------------------------------------------------------------
+
+def read_records(path: str) -> Iterator[Tuple[bytes, bytes]]:
+    """(key, value) pairs; native reader when available."""
+    import ctypes
+    lib = load_native()
+    if lib is None:
+        yield from py_read_records(path)
+        return
+    handle = lib.seqfile_open(path.encode())
+    if not handle:
+        raise IOError(f"cannot open SequenceFile {path}")
+    try:
+        key_p = ctypes.c_char_p()
+        val_p = ctypes.c_char_p()
+        klen = ctypes.c_int()
+        vlen = ctypes.c_int()
+        while True:
+            rc = lib.seqfile_next(handle, ctypes.byref(key_p),
+                                  ctypes.byref(klen), ctypes.byref(val_p),
+                                  ctypes.byref(vlen))
+            if rc == 0:
+                return
+            if rc < 0:
+                raise IOError(f"corrupt SequenceFile {path}")
+            yield (ctypes.string_at(key_p, klen.value),
+                   ctypes.string_at(val_p, vlen.value))
+    finally:
+        lib.seqfile_close(handle)
+
+
+def write_records(path: str, records) -> None:
+    """Write (key, value) byte pairs; native writer when available."""
+    lib = load_native()
+    if lib is None:
+        py_write_records(path, records)
+        return
+    handle = lib.seqfile_create(path.encode(),
+                                b"org.apache.hadoop.io.Text",
+                                b"org.apache.hadoop.io.BytesWritable", SYNC)
+    if not handle:
+        raise IOError(f"cannot create SequenceFile {path}")
+    try:
+        for key, value in records:
+            lib.seqfile_append(handle, key, len(key), value, len(value))
+    finally:
+        lib.seqfile_close_writer(handle)
+
+
+# ---------------------------------------------------------------------------
+# image-folder convenience (reference SeqFileFolder protocol)
+# ---------------------------------------------------------------------------
+
+def write_image_seqfile(path: str, entries: List[Tuple[str, float, bytes]]
+                        ) -> None:
+    """entries: (name, label, image bytes).  Key Text = "name label",
+    value = BytesWritable framing (4-byte BE length + data), matching the
+    reference's ImageNet seq-file writer."""
+    def gen():
+        for name, label, data in entries:
+            key = f"{name} {label:g}".encode()
+            value = struct.pack(">i", len(data)) + data
+            yield key, value
+    write_records(path, gen())
+
+
+def read_image_seqfile(path: str) -> Iterator[Tuple[str, float, bytes]]:
+    for key, value in read_records(path):
+        text = key.decode()
+        name, _, label = text.rpartition(" ")
+        (n,) = struct.unpack(">i", value[:4])
+        yield name, float(label), value[4:4 + n]

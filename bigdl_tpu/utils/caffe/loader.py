@@ -80,13 +80,14 @@ class CaffeLoader:
         tops: Dict[str, ModuleNode] = {}   # blob name -> producing node
         inputs: List[ModuleNode] = []
         produced: List[str] = []           # blob names, production order
-        consumed: set = set()
+        last_prod: Dict[str, int] = {}     # blob -> layer index of producer
+        last_cons: Dict[str, int] = {}     # blob -> layer index of consumer
 
         for name in self.net.input:
             node = ModuleNode(nn.Identity(name=name))
             tops[name] = node
             inputs.append(node)
-        for layer in self.net.layer:
+        for idx, layer in enumerate(self.net.layer):
             if any(rule.phase == pb.TRAIN for rule in layer.include):
                 # TRAIN-only layer: alias its tops to the bottom so TEST
                 # consumers of an in-place top still resolve
@@ -105,22 +106,28 @@ class CaffeLoader:
                      for i in range(len(layer.bottom))]
             if preds:
                 node.inputs(*preds)
-            consumed.update(b for b in layer.bottom)
+            for b in layer.bottom:
+                last_cons[b] = idx
             for top in layer.top:
                 tops[top] = node
                 produced.append(top)
+                last_prod[top] = idx
 
         if not inputs:
             raise ValueError("prototxt declares no inputs "
                              "(need input:/Input layers)")
-        # outputs = dangling tops: produced blobs nothing consumes
-        # (in-place layers re-produce their bottom name, so dedupe keeping
-        # the LAST producer via the tops map)
+        # outputs = dangling tops: a blob is an output when its final
+        # producer is not followed by a consumer.  In-place layers
+        # (bottom == top) consume and re-produce the same name at the same
+        # index, so >= keeps a trailing in-place layer's blob alive while a
+        # mid-chain one (consumed by a later layer) is dropped.
         out_nodes, seen = [], set()
         for name in produced:
-            if name in consumed or name in seen:
+            if name in seen:
                 continue
             seen.add(name)
+            if name in last_cons and last_prod[name] < last_cons[name]:
+                continue
             out_nodes.append(tops[name])
         if not out_nodes:
             raise ValueError("prototxt has no output layer (every top is "

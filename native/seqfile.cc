@@ -1,0 +1,210 @@
+// Hadoop SequenceFile reader/writer (uncompressed, version 6).
+//
+// Reference equivalent: the Hadoop-SequenceFile ImageNet pipeline the
+// reference trains from (dataset/DataSet.scala:500-558 SeqFileFolder,
+// dataset/image/SeqFileReader) — there provided by hadoop-client; here a
+// small native implementation with a C ABI for ctypes.
+//
+// Layout (uncompressed):
+//   "SEQ" <version u8> <keyClass Text> <valueClass Text>
+//   <compressed u8=0> <blockCompressed u8=0>
+//   <metadata count i32-BE> (k/v Text pairs)
+//   <16-byte sync marker>
+//   records: <recordLen i32-BE> <keyLen i32-BE> <key bytes> <value bytes>
+//   every ~sync interval: <-1 i32-BE> <16-byte sync marker>
+// Text = vint length + utf8 bytes (hadoop WritableUtils vint encoding).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Reader {
+  FILE* f = nullptr;
+  uint8_t sync[16];
+  std::vector<char> key;
+  std::vector<char> value;
+};
+
+struct Writer {
+  FILE* f = nullptr;
+  uint8_t sync[16];
+  long since_sync = 0;
+};
+
+int32_t read_i32be(FILE* f, bool* ok) {
+  uint8_t b[4];
+  if (fread(b, 1, 4, f) != 4) { *ok = false; return 0; }
+  *ok = true;
+  return (int32_t)((uint32_t)b[0] << 24 | (uint32_t)b[1] << 16 |
+                   (uint32_t)b[2] << 8 | (uint32_t)b[3]);
+}
+
+void write_i32be(FILE* f, int32_t v) {
+  uint8_t b[4] = {(uint8_t)((uint32_t)v >> 24), (uint8_t)((uint32_t)v >> 16),
+                  (uint8_t)((uint32_t)v >> 8), (uint8_t)v};
+  fwrite(b, 1, 4, f);
+}
+
+// hadoop WritableUtils::readVInt
+bool read_vlong(FILE* f, int64_t* out) {
+  int c = fgetc(f);
+  if (c == EOF) return false;
+  int8_t first = (int8_t)c;
+  if (first >= -112) { *out = first; return true; }
+  bool neg = first < -120;
+  int len = neg ? -(first + 120) : -(first + 112);
+  uint64_t v = 0;
+  for (int i = 0; i < len; i++) {
+    c = fgetc(f);
+    if (c == EOF) return false;
+    v = (v << 8) | (uint8_t)c;
+  }
+  *out = neg ? ~(int64_t)v : (int64_t)v;
+  return true;
+}
+
+void write_vlong(FILE* f, int64_t v) {
+  if (v >= -112 && v <= 127) { fputc((int)(int8_t)v, f); return; }
+  int len = -112;
+  if (v < 0) { v = ~v; len = -120; }
+  uint64_t tmp = (uint64_t)v;
+  while (tmp != 0) { tmp >>= 8; len--; }
+  fputc((int)(int8_t)len, f);
+  int n = (len < -120) ? -(len + 120) : -(len + 112);
+  for (int i = n - 1; i >= 0; i--) fputc((int)((v >> (8 * i)) & 0xFF), f);
+}
+
+bool read_text(FILE* f, std::string* out) {
+  int64_t n;
+  if (!read_vlong(f, &n) || n < 0) return false;
+  out->resize((size_t)n);
+  return n == 0 || fread(&(*out)[0], 1, (size_t)n, f) == (size_t)n;
+}
+
+void write_text(FILE* f, const char* s) {
+  size_t n = strlen(s);
+  write_vlong(f, (int64_t)n);
+  fwrite(s, 1, n, f);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* seqfile_open(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  char magic[3];
+  if (fread(magic, 1, 3, f) != 3 || memcmp(magic, "SEQ", 3) != 0) {
+    fclose(f);
+    return nullptr;
+  }
+  int version = fgetc(f);
+  if (version < 5) { fclose(f); return nullptr; }
+  Reader* r = new Reader();
+  r->f = f;
+  std::string key_cls, val_cls;
+  if (!read_text(f, &key_cls) || !read_text(f, &val_cls)) {
+    fclose(f); delete r; return nullptr;
+  }
+  int compressed = fgetc(f);
+  int block = fgetc(f);
+  if (compressed != 0 || block != 0) { fclose(f); delete r; return nullptr; }
+  bool ok;
+  int32_t meta = read_i32be(f, &ok);
+  if (!ok) { fclose(f); delete r; return nullptr; }
+  for (int32_t i = 0; i < meta; i++) {
+    std::string k, v;
+    if (!read_text(f, &k) || !read_text(f, &v)) {
+      fclose(f); delete r; return nullptr;
+    }
+  }
+  if (fread(r->sync, 1, 16, f) != 16) { fclose(f); delete r; return nullptr; }
+  return r;
+}
+
+// 1 = record produced, 0 = EOF, -1 = corrupt
+int seqfile_next(void* handle, const char** key, int* klen,
+                 const char** value, int* vlen) {
+  Reader* r = (Reader*)handle;
+  for (;;) {
+    bool ok;
+    int32_t rec_len = read_i32be(r->f, &ok);
+    if (!ok) return 0;
+    if (rec_len == -1) {  // sync escape
+      uint8_t sync[16];
+      if (fread(sync, 1, 16, r->f) != 16) return 0;
+      if (memcmp(sync, r->sync, 16) != 0) return -1;
+      continue;
+    }
+    int32_t key_len = read_i32be(r->f, &ok);
+    if (!ok || key_len < 0 || key_len > rec_len) return -1;
+    r->key.resize((size_t)key_len);
+    r->value.resize((size_t)(rec_len - key_len));
+    if (key_len && fread(r->key.data(), 1, (size_t)key_len, r->f) !=
+                       (size_t)key_len)
+      return -1;
+    size_t v = (size_t)(rec_len - key_len);
+    if (v && fread(r->value.data(), 1, v, r->f) != v) return -1;
+    *key = r->key.data();
+    *klen = key_len;
+    *value = r->value.data();
+    *vlen = (int)v;
+    return 1;
+  }
+}
+
+void seqfile_close(void* handle) {
+  Reader* r = (Reader*)handle;
+  if (r) {
+    if (r->f) fclose(r->f);
+    delete r;
+  }
+}
+
+void* seqfile_create(const char* path, const char* key_class,
+                     const char* value_class, const uint8_t* sync16) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  Writer* w = new Writer();
+  w->f = f;
+  memcpy(w->sync, sync16, 16);
+  fwrite("SEQ", 1, 3, f);
+  fputc(6, f);  // version
+  write_text(f, key_class);
+  write_text(f, value_class);
+  fputc(0, f);  // not compressed
+  fputc(0, f);  // not block-compressed
+  write_i32be(f, 0);  // no metadata
+  fwrite(w->sync, 1, 16, f);
+  return w;
+}
+
+void seqfile_append(void* handle, const char* key, int klen,
+                    const char* value, int vlen) {
+  Writer* w = (Writer*)handle;
+  if (w->since_sync > 2000) {  // hadoop SYNC_INTERVAL ballpark
+    write_i32be(w->f, -1);
+    fwrite(w->sync, 1, 16, w->f);
+    w->since_sync = 0;
+  }
+  write_i32be(w->f, klen + vlen);
+  write_i32be(w->f, klen);
+  fwrite(key, 1, (size_t)klen, w->f);
+  fwrite(value, 1, (size_t)vlen, w->f);
+  w->since_sync += klen + vlen + 8;
+}
+
+void seqfile_close_writer(void* handle) {
+  Writer* w = (Writer*)handle;
+  if (w) {
+    if (w->f) fclose(w->f);
+    delete w;
+  }
+}
+
+}  // extern "C"

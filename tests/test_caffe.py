@@ -139,3 +139,20 @@ class TestCaffeRegressions:
         back = load_caffe(proto, weights)
         theirs = np.asarray(back.evaluate().forward(x))
         np.testing.assert_allclose(theirs, ours, rtol=1e-5, atol=1e-5)
+
+    def test_trailing_inplace_layer_is_output(self, tmp_path):
+        """A net ending in an in-place layer (bottom == top) must load with
+        that blob as the output."""
+        m = (nn.Sequential().add(nn.Linear(4, 6, name="ip")))
+        m._ensure_init()
+        proto = str(tmp_path / "ip.prototxt")
+        weights = str(tmp_path / "ip.caffemodel")
+        persister.save(m, proto, weights, input_shape=[1, 4])
+        with open(proto, "a") as f:
+            f.write('layer { name: "relu" type: "ReLU" bottom: "blob0" '
+                    'top: "blob0" }\n')
+        net = load_caffe(proto, weights)
+        x = np.random.RandomState(3).normal(size=(2, 4)).astype(np.float32)
+        out = np.asarray(net.evaluate().forward(x))
+        assert out.shape == (2, 6)
+        assert np.all(out >= 0), "trailing in-place ReLU not applied"

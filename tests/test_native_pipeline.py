@@ -1,0 +1,149 @@
+"""Native data-pipeline tests: SequenceFile cross-implementation round-trip,
+MT batch assembly vs numpy reference, and the prefetch transformer."""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.dataset import seqfile
+from bigdl_tpu.dataset.mt_batch import Prefetch, assemble_batch
+from bigdl_tpu.dataset.native import native_available
+
+
+class TestSeqFile:
+    def _entries(self):
+        rng = np.random.RandomState(0)
+        return [(f"img_{i}.jpg", float(i % 10 + 1),
+                 rng.bytes(rng.randint(10, 2000))) for i in range(32)]
+
+    def test_python_roundtrip(self, tmp_path):
+        p = str(tmp_path / "a.seq")
+        recs = [(b"k%d" % i, b"v" * i) for i in range(64)]
+        seqfile.py_write_records(p, iter(recs))
+        back = list(seqfile.py_read_records(p))
+        assert back == recs
+
+    def test_native_reads_python_file(self, tmp_path):
+        if not native_available():
+            pytest.skip("native library unavailable")
+        p = str(tmp_path / "b.seq")
+        recs = [(b"key%d" % i, bytes([i % 256]) * (i * 7 % 300))
+                for i in range(128)]
+        seqfile.py_write_records(p, iter(recs))
+        back = list(seqfile.read_records(p))   # native path
+        assert back == recs
+
+    def test_python_reads_native_file(self, tmp_path):
+        if not native_available():
+            pytest.skip("native library unavailable")
+        p = str(tmp_path / "c.seq")
+        recs = [(b"k%d" % i, b"x" * (i * 13 % 500)) for i in range(100)]
+        seqfile.write_records(p, iter(recs))   # native writer
+        back = list(seqfile.py_read_records(p))
+        assert back == recs
+
+    def test_image_seqfile_protocol(self, tmp_path):
+        p = str(tmp_path / "imgs.seq")
+        entries = self._entries()
+        seqfile.write_image_seqfile(p, entries)
+        back = list(seqfile.read_image_seqfile(p))
+        assert len(back) == len(entries)
+        for (n0, l0, d0), (n1, l1, d1) in zip(entries, back):
+            assert n0 == n1 and l0 == l1 and d0 == d1
+
+
+class TestAssembleBatch:
+    def _ref(self, images, crop, offsets, flips, mean, std):
+        ch, cw = crop
+        out = []
+        for i, im in enumerate(images):
+            oy, ox = offsets[i]
+            patch = im[oy:oy + ch, ox:ox + cw].astype(np.float32)
+            if flips[i]:
+                patch = patch[:, ::-1]
+            out.append(((patch - np.asarray(mean, np.float32)) /
+                        np.asarray(std, np.float32)).transpose(2, 0, 1))
+        return np.stack(out)
+
+    def test_matches_numpy_reference(self):
+        rng = np.random.RandomState(1)
+        images = [rng.randint(0, 256, size=(40 + i % 3, 44 + i % 5, 3))
+                  .astype(np.uint8) for i in range(16)]
+        offsets = np.stack([rng.randint(0, 8, size=16),
+                            rng.randint(0, 8, size=16)], axis=1)
+        flips = rng.randint(0, 2, size=16).astype(np.uint8)
+        mean, std = (104.0, 117.0, 123.0), (57.0, 58.0, 59.0)
+        got = assemble_batch(images, (32, 32), offsets, flips, mean, std,
+                             n_threads=4)
+        ref = self._ref(images, (32, 32), offsets, flips, mean, std)
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+    def test_grey_single_channel(self):
+        rng = np.random.RandomState(2)
+        images = [rng.randint(0, 256, size=(28, 28)).astype(np.uint8)
+                  for _ in range(4)]
+        offsets = np.zeros((4, 2), np.int32)
+        flips = np.zeros(4, np.uint8)
+        out = assemble_batch(images, (28, 28), offsets, flips, (33.0,),
+                             (77.0,), n_threads=2)
+        assert out.shape == (4, 1, 28, 28)
+
+
+class TestPrefetch:
+    def test_order_preserved(self):
+        pf = Prefetch(depth=2)
+        assert list(pf(iter(range(100)))) == list(range(100))
+
+    def test_upstream_error_propagates(self):
+        def gen():
+            yield 1
+            raise RuntimeError("upstream boom")
+
+        pf = Prefetch()
+        it = pf(gen())
+        assert next(it) == 1
+        with pytest.raises(RuntimeError, match="upstream boom"):
+            next(it)
+
+    def test_consumer_abandonment_releases_producer(self):
+        import threading
+        started = threading.active_count()
+        pf = Prefetch(depth=2)
+        it = pf(iter(range(10000)))
+        next(it)
+        it.close()   # abandon
+        import time
+        for _ in range(50):
+            if threading.active_count() <= started:
+                break
+            time.sleep(0.05)
+        assert threading.active_count() <= started, "producer thread leaked"
+
+
+@pytest.mark.skipif(__import__("shutil").which("g++") is None,
+                    reason="no C++ toolchain")
+def test_native_library_builds():
+    assert native_available(), "native toolchain present but lib missing"
+
+
+class TestSeqFileFolder:
+    def test_dataset_from_seqfiles(self, tmp_path):
+        """End-to-end: write JPEG seq-files, read back as a DataSet."""
+        import io
+        from PIL import Image
+        from bigdl_tpu.dataset.dataset import DataSet
+
+        rng = np.random.RandomState(3)
+        entries = []
+        for i in range(6):
+            arr = rng.randint(0, 256, size=(16, 16, 3)).astype(np.uint8)
+            buf = io.BytesIO()
+            Image.fromarray(arr).save(buf, format="JPEG")
+            entries.append((f"img{i}", float(i % 3 + 1), buf.getvalue()))
+        seqfile.write_image_seqfile(str(tmp_path / "part-0.seq"), entries[:3])
+        seqfile.write_image_seqfile(str(tmp_path / "part-1.seq"), entries[3:])
+
+        ds = DataSet.seq_file_folder(str(tmp_path))
+        assert ds.size() == 6
+        imgs = list(ds.data(train=False))
+        assert imgs[0].data.shape == (16, 16, 3)
+        assert {im.label for im in imgs} == {1.0, 2.0, 3.0}
