@@ -28,18 +28,21 @@ def _log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def build_train_step(model, criterion, optim_method, hyper, module=None):
+def build_train_step(model, criterion, optim_method, hyper, module=None,
+                     precision=None):
     """The production fused step — identical shape to
-    LocalOptimizer._build_step: forward + loss (+ regularizers) + backward +
-    the OptimMethod's pure update, all in one jit."""
+    LocalOptimizer._build_step: forward (at the requested precision) + loss
+    (+ regularizers) + backward + the OptimMethod's pure update, one jit."""
     import jax
-    from bigdl_tpu.optim.optimizer import regularization_penalty
+    from bigdl_tpu.optim.optimizer import (mixed_precision_forward,
+                                           regularization_penalty)
 
     reg_module = module if module is not None else model
 
     def step(params, slots, mstate, inputs, targets):
         def loss_fn(p):
-            out, new_mstate = model.apply(p, inputs, mstate, training=True)
+            out, new_mstate = mixed_precision_forward(
+                model, p, inputs, mstate, precision, True, None)
             loss = criterion.apply(out, targets)
             loss = loss + regularization_penalty(reg_module, p)
             return loss, new_mstate
@@ -54,7 +57,7 @@ def build_train_step(model, criterion, optim_method, hyper, module=None):
 
 
 def bench_model(model, batch, input_shape, n_classes, steps=10, warmup=3,
-                flops_per_image=None, logits=False):
+                flops_per_image=None, logits=False, precision=None):
     import jax
     import jax.numpy as jnp
     import bigdl_tpu.nn as nn
@@ -70,7 +73,7 @@ def bench_model(model, batch, input_shape, n_classes, steps=10, warmup=3,
     # Linear logits (imagenet variants) get a LogSoftMax appended in-step.
     target = _WithLogSoftMax(model, nn.LogSoftMax()) if logits else model
     step_fn = build_train_step(target, criterion, method, method.hyper(),
-                               module=model)
+                               module=model, precision=precision)
 
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.uniform(-1, 1, size=(batch,) + input_shape)
@@ -118,8 +121,11 @@ class _WithLogSoftMax:
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=128)
     ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--precision", choices=["fp32", "bf16"], default="bf16",
+                    help="compute precision of the fused step (bf16 is the "
+                         "TPU-first default: MXU-native, fp32 master weights)")
     ap.add_argument("--quick", action="store_true",
                     help="LeNet only (CI smoke)")
     args = ap.parse_args()
@@ -146,11 +152,12 @@ def main():
 
     # ResNet-50/ImageNet synthetic — the north-star protocol.
     # ~4.09 GFLOPs/image forward; training ~3x forward.
+    precision = None if args.precision == "fp32" else args.precision
     model = model_init(resnet(1000, depth=50, dataset=DatasetType.IMAGENET))
     r50 = bench_model(model, args.batch, (3, 224, 224), 1000,
                       steps=args.steps, flops_per_image=3 * 4.09e9,
-                      logits=True)
-    _log(f"resnet50 (batch {args.batch}): {r50}")
+                      logits=True, precision=precision)
+    _log(f"resnet50 (batch {args.batch}, {args.precision}): {r50}")
     if "tflops" in r50:
         # bf16 peak of one v5e chip ~197 TFLOP/s
         _log(f"  achieved {r50['tflops']:.1f} TFLOP/s "
@@ -163,9 +170,10 @@ def main():
     if os.path.exists(baseline_path):
         with open(baseline_path) as f:
             base = json.load(f)
-        # only comparable at the batch size the baseline was pinned at
+        # only comparable at the batch size/precision the baseline pinned
         if (base.get("resnet50_train_images_per_sec") and
-                base.get("batch") == args.batch):
+                base.get("batch") == args.batch and
+                base.get("precision", "bf16") == args.precision):
             vs = value / base["resnet50_train_images_per_sec"]
 
     print(json.dumps({"metric": "resnet50_train_images_per_sec",

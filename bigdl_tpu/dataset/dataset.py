@@ -163,25 +163,23 @@ class DataSet:
     def seq_file_folder(path: str) -> "LocalDataSet":
         """Hadoop SequenceFile tree of JPEG records (reference
         ``SeqFileFolder.files``, ``dataset/DataSet.scala:500-558``): every
-        ``*.seq`` under ``path``; records decode to BGR
-        :class:`~bigdl_tpu.dataset.image.LabeledImage`."""
-        import io
+        ``*.seq`` under ``path``.  Records hold the COMPRESSED bytes
+        (ImageNet scale must not decode up-front); a built-in transformer
+        decodes to BGR :class:`~bigdl_tpu.dataset.image.LabeledImage`
+        per epoch pass."""
         import os as _os
-        from bigdl_tpu.dataset.image import LabeledImage
+        from bigdl_tpu.dataset.image import BytesToBGRImg, LabeledImageBytes
         from bigdl_tpu.dataset.seqfile import read_image_seqfile
-        from PIL import Image
 
         records = []
         for root, _, files in sorted(_os.walk(path)):
             for fname in sorted(files):
                 if not fname.endswith(".seq"):
                     continue
-                for _, label, data in read_image_seqfile(
+                for name, label, data in read_image_seqfile(
                         _os.path.join(root, fname)):
-                    rgb = np.asarray(Image.open(io.BytesIO(data))
-                                     .convert("RGB"), dtype=np.float32)
-                    records.append(LabeledImage(rgb[..., ::-1], label))
-        return LocalDataSet(records)
+                    records.append(LabeledImageBytes(name, label, data))
+        return LocalDataSet(records, [BytesToBGRImg()])
 
     @staticmethod
     def image_folder(path: str, scale_to: int = 256) -> "LocalDataSet":

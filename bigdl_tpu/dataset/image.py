@@ -32,6 +32,32 @@ class LocalImgPath:
         self.label = label
 
 
+class LabeledImageBytes:
+    """Compressed (JPEG/PNG) bytes + label: the seq-file record form — kept
+    compressed in memory, decoded per pass (reference keeps byte records in
+    the cached RDD and decodes in the transformer chain)."""
+
+    __slots__ = ("name", "label", "bytes")
+
+    def __init__(self, name: str, label: float, data: bytes):
+        self.name = name
+        self.label = label
+        self.bytes = data
+
+
+class BytesToBGRImg:
+    """Decode LabeledImageBytes → BGR LabeledImage (reference
+    ``BytesToBGRImg``)."""
+
+    def __call__(self, it):
+        import io
+        from PIL import Image
+        for rec in it:
+            rgb = np.asarray(Image.open(io.BytesIO(rec.bytes))
+                             .convert("RGB"), dtype=np.float32)
+            yield LabeledImage(rgb[..., ::-1], rec.label)
+
+
 class LabeledImage:
     """Float HWC image + label (reference ``LabeledBGRImage`` /
     ``LabeledGreyImage``, ``dataset/image/Types.scala``)."""

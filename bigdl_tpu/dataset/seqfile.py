@@ -186,14 +186,33 @@ def write_records(path: str, records) -> None:
 # image-folder convenience (reference SeqFileFolder protocol)
 # ---------------------------------------------------------------------------
 
+def _text_frame(payload: bytes) -> bytes:
+    """Hadoop ``Text`` serialization: vint length + utf8 bytes."""
+    import io as _io
+    buf = _io.BytesIO()
+    _write_vlong(buf, len(payload))
+    buf.write(payload)
+    return buf.getvalue()
+
+
+def _text_unframe(raw: bytes) -> bytes:
+    import io as _io
+    buf = _io.BytesIO(raw)
+    n = _read_vlong(buf)
+    if n is None or n < 0:
+        raise IOError("corrupt Text key")
+    return buf.read(n)
+
+
 def write_image_seqfile(path: str, entries: List[Tuple[str, float, bytes]]
                         ) -> None:
-    """entries: (name, label, image bytes).  Key Text = "name label",
-    value = BytesWritable framing (4-byte BE length + data), matching the
-    reference's ImageNet seq-file writer."""
+    """entries: (name, label, image bytes).  Key = Text("name label") with
+    the vint length prefix Hadoop's ``Text.readFields`` expects; value =
+    BytesWritable framing (4-byte BE length + data) — byte-compatible with
+    the reference's ImageNet seq-file writer."""
     def gen():
         for name, label, data in entries:
-            key = f"{name} {label:g}".encode()
+            key = _text_frame(f"{name} {label:g}".encode())
             value = struct.pack(">i", len(data)) + data
             yield key, value
     write_records(path, gen())
@@ -201,7 +220,7 @@ def write_image_seqfile(path: str, entries: List[Tuple[str, float, bytes]]
 
 def read_image_seqfile(path: str) -> Iterator[Tuple[str, float, bytes]]:
     for key, value in read_records(path):
-        text = key.decode()
+        text = _text_unframe(key).decode()
         name, _, label = text.rpartition(" ")
         (n,) = struct.unpack(">i", value[:4])
         yield name, float(label), value[4:4 + n]

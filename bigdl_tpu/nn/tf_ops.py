@@ -1,0 +1,95 @@
+"""TF-import helper ops.
+
+Reference equivalents: ``nn/tf/{Const, Fill, Shape, SplitAndSelect,
+StrideSlice}.scala`` — small ops the TensorFlow importer needs to express
+GraphDef nodes that have no Torch-layer counterpart.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.module import Module
+
+
+class Const(Module):
+    """Emit a fixed tensor, ignoring the input (reference
+    ``nn/tf/Const.scala``: the input only rides the graph topology)."""
+
+    def __init__(self, value, name=None):
+        super().__init__(name)
+        self.value = np.asarray(value)
+
+    def apply(self, params, input, state, training=False, rng=None):
+        return jnp.asarray(self.value), state
+
+
+class Fill(Module):
+    """Fill a shape with a scalar.  Input: Table (shape vector, value)
+    (reference ``nn/tf/Fill.scala``).  The output shape must be static for
+    XLA, so the shell forward runs eagerly (shape read from a concrete
+    array); inside a larger jitted graph the importer folds Fill against
+    its Const shape instead."""
+
+    def apply(self, params, input, state, training=False, rng=None):
+        shape, value = input[0], input[1]
+        dims = tuple(int(d) for d in np.asarray(shape).reshape(-1))
+        return jnp.full(dims, jnp.asarray(value).reshape(())), state
+
+    def _jitted(self):
+        # dynamic output shape: cannot trace; eager shell only
+        return lambda p, x, s, r: self.apply(p, x, s, rng=r)
+
+
+class Shape(Module):
+    """Input's shape as an int32 vector (reference ``nn/tf/Shape.scala``)."""
+
+    def apply(self, params, input, state, training=False, rng=None):
+        return jnp.asarray(input.shape, jnp.int32), state
+
+
+class SplitAndSelect(Module):
+    """Split ``dimension`` into ``num_split`` equal slices, emit the
+    ``index``-th (both 1-based; negative dimension counts from the end —
+    reference ``nn/tf/SplitAndSelect.scala``)."""
+
+    def __init__(self, dimension: int, index: int, num_split: int, name=None):
+        super().__init__(name)
+        self.dimension = dimension
+        self.index = index
+        self.num_split = num_split
+
+    def apply(self, params, input, state, training=False, rng=None):
+        dim = (input.ndim + self.dimension if self.dimension < 0
+               else self.dimension - 1)
+        size = input.shape[dim]
+        if size % self.num_split != 0:
+            raise ValueError(
+                f"numSplit {self.num_split} must evenly divide dim size "
+                f"{size} (reference SplitAndSelect require)")
+        length = size // self.num_split
+        start = (self.index - 1) * length
+        idx = [slice(None)] * input.ndim
+        idx[dim] = slice(start, start + length)
+        return input[tuple(idx)], state
+
+
+class StrideSlice(Module):
+    """Chained narrows: specs are (dim, start, end) 1-based, end exclusive,
+    stride 1 (reference ``nn/tf/StrideSlice.scala`` — which also only
+    supports stride 1)."""
+
+    def __init__(self, slice_specs: Sequence[Tuple[int, int, int]], name=None):
+        super().__init__(name)
+        self.slice_specs = [tuple(int(v) for v in s) for s in slice_specs]
+
+    def apply(self, params, input, state, training=False, rng=None):
+        out = input
+        for (dim, start, end) in self.slice_specs:
+            idx = [slice(None)] * out.ndim
+            idx[dim - 1] = slice(start - 1, end - 1)
+            out = out[tuple(idx)]
+        return out, state

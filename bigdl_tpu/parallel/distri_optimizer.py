@@ -39,7 +39,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from bigdl_tpu.engine import Engine
 from bigdl_tpu.dataset.dataset import ShardedDataSet
 from bigdl_tpu.nn.module import Criterion, Module
-from bigdl_tpu.optim.optimizer import Optimizer, regularization_penalty
+from bigdl_tpu.optim.optimizer import (Optimizer, mixed_precision_forward,
+                                       regularization_penalty)
 from bigdl_tpu.parallel.all_reduce import AllReduceParameter
 
 logger = logging.getLogger("bigdl_tpu")
@@ -93,6 +94,8 @@ class DistriOptimizer(Optimizer):
         mesh, axis = self.mesh, "data"
         n = mesh.shape[axis]
 
+        precision = self.precision
+
         def shard_step(flat_params, slots, mstate, inputs, targets, hyper, rng):
             # distinct dropout masks per shard, like the reference's
             # independently-seeded model replicas
@@ -100,8 +103,8 @@ class DistriOptimizer(Optimizer):
 
             def loss_fn(flat):
                 p = arp.unflatten(flat)
-                out, new_mstate = model.apply(p, inputs, mstate,
-                                              training=True, rng=rng)
+                out, new_mstate = mixed_precision_forward(
+                    model, p, inputs, mstate, precision, True, rng)
                 loss = criterion.apply(out, targets)
                 loss = loss + regularization_penalty(model, p)
                 return loss, new_mstate

@@ -1,13 +1,12 @@
 """Persistence: save/load of modules, optim methods, and arbitrary objects.
 
 Reference equivalent: ``utils/File.scala:25`` — java-serialization to
-local/HDFS/S3 paths.  Here: pickle to local paths (HDFS/S3 support is gated on
-optional deps; fsspec-style schemes raise a clear error when unavailable —
-this image is egress-free, so remote filesystems cannot be exercised anyway).
+local/HDFS/S3 paths (``save:67``, ``saveToHdfs:106``, ``load:162``).
 
-Checkpoint layout matches the reference protocol
-(``optim/DistriOptimizer.scala:394-416``): ``model.<neval>`` /
-``optimMethod.<neval>`` files in a checkpoint directory.
+Local paths pickle directly (atomic temp-file + rename).  Remote schemes
+(``hdfs://``, ``s3://``, ``gs://``, …) dispatch through fsspec, which maps
+each scheme to its filesystem client (pyarrow-HDFS, s3fs, …) and raises a
+clear error naming the missing client when one is not installed.
 """
 
 from __future__ import annotations
@@ -17,24 +16,41 @@ import pickle
 import tempfile
 from typing import Any
 
+_REMOTE_SCHEMES = ("hdfs://", "s3://", "s3a://", "s3n://", "gs://",
+                   "abfs://", "http://", "https://")
 
-def _check_scheme(path: str) -> str:
-    if path.startswith(("hdfs://", "s3://", "s3a://", "s3n://")):
+
+def _is_remote(path: str) -> bool:
+    return path.startswith(_REMOTE_SCHEMES)
+
+
+def _fsspec_open(path: str, mode: str):
+    try:
+        import fsspec
+    except ImportError as e:  # pragma: no cover - fsspec is in the image
         raise NotImplementedError(
-            f"remote filesystem scheme in {path!r}: HDFS/S3 persistence "
-            "requires the corresponding filesystem client which is not "
-            "available in this environment (reference: utils/File.scala:106)")
-    if path.startswith("file://"):
-        path = path[len("file://"):]
-    return path
+            f"remote filesystem scheme in {path!r} needs fsspec "
+            "(reference: utils/File.scala:106)") from e
+    # s3a/s3n are hadoop aliases for s3
+    for alias in ("s3a://", "s3n://"):
+        if path.startswith(alias):
+            path = "s3://" + path[len(alias):]
+    return fsspec.open(path, mode)
 
 
 def save(obj: Any, path: str, overwrite: bool = True) -> None:
-    """Serialize ``obj`` to ``path`` (reference ``File.save:67``).
-
-    Writes atomically: temp file in the same directory, then rename.
-    """
-    path = _check_scheme(path)
+    """Serialize ``obj`` to ``path`` (reference ``File.save:67`` /
+    ``saveToHdfs:106``).  Local writes are atomic (temp file + rename)."""
+    if _is_remote(path):
+        fo = _fsspec_open(path, "wb")
+        if not overwrite and fo.fs.exists(fo.path):
+            raise FileExistsError(f"{path} already exists and overwrite is "
+                                  "False (reference File.scala overWrite)")
+        with fo as f:
+            pickle.dump(obj, f, protocol=pickle.HIGHEST_PROTOCOL)
+        return
+    if path.startswith("file://"):
+        path = path[len("file://"):]
     if os.path.exists(path) and not overwrite:
         raise FileExistsError(
             f"{path} already exists and overwrite is False "
@@ -54,6 +70,10 @@ def save(obj: Any, path: str, overwrite: bool = True) -> None:
 
 def load(path: str) -> Any:
     """Deserialize from ``path`` (reference ``File.load:162``)."""
-    path = _check_scheme(path)
+    if _is_remote(path):
+        with _fsspec_open(path, "rb") as f:
+            return pickle.load(f)
+    if path.startswith("file://"):
+        path = path[len("file://"):]
     with open(path, "rb") as f:
         return pickle.load(f)

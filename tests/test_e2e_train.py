@@ -110,3 +110,51 @@ class TestConvPoolE2E:
         acc = Evaluator(model).test(
             samples, [optim.Top1Accuracy()], 32)[0][1].final_result()
         assert acc > 0.85
+
+
+class TestMixedPrecision:
+    def test_bf16_lenet_converges(self):
+        """set_precision('bf16'): bf16 compute, fp32 master weights."""
+        import jax.numpy as jnp
+        samples = synthetic_digit_images(256, n_classes=4)
+        ds = LocalDataSet(samples).transform(SampleToMiniBatch(32))
+        model = lenet5(4)
+        opt = optim.Optimizer.create(model, ds, nn.ClassNLLCriterion())
+        opt.set_optim_method(optim.SGD(learning_rate=0.2))
+        opt.set_precision("bf16")
+        opt.set_end_when(optim.max_iteration(60))
+        trained = opt.optimize()
+        # master weights stay fp32
+        import jax
+        for leaf in jax.tree_util.tree_leaves(trained.params):
+            assert leaf.dtype == jnp.float32
+        acc = Evaluator(trained).test(
+            samples, [optim.Top1Accuracy()], 32)[0][1].final_result()
+        assert acc > 0.9, f"bf16 training failed to converge: acc={acc}"
+
+    def test_bf16_distributed_converges(self):
+        import jax, jax.numpy as jnp
+        from bigdl_tpu.dataset.dataset import ShardedDataSet
+        from bigdl_tpu.dataset.datasets import synthetic_separable
+        samples = synthetic_separable(256, 4, n_classes=3, seed=9)
+        ds = ShardedDataSet(samples, 8).transform(SampleToMiniBatch(64, 8))
+        model = (nn.Sequential().add(nn.Linear(4, 16)).add(nn.Tanh())
+                 .add(nn.Linear(16, 3)).add(nn.LogSoftMax()))
+        opt = optim.Optimizer.create(model, ds, nn.ClassNLLCriterion())
+        opt.set_optim_method(optim.SGD(learning_rate=0.5))
+        opt.set_precision("bf16")
+        opt.set_end_when(optim.max_epoch(12))
+        trained = opt.optimize()
+        for leaf in jax.tree_util.tree_leaves(trained.params):
+            assert leaf.dtype == jnp.float32
+        acc = Evaluator(trained).test(
+            samples, [optim.Top1Accuracy()], 64)[0][1].final_result()
+        assert acc > 0.9
+
+    def test_invalid_precision_rejected(self):
+        samples = synthetic_digit_images(32)
+        ds = LocalDataSet(samples).transform(SampleToMiniBatch(16))
+        opt = optim.Optimizer.create(lenet5(4), ds, nn.ClassNLLCriterion())
+        import pytest
+        with pytest.raises(ValueError, match="precision"):
+            opt.set_precision("fp8")

@@ -399,3 +399,36 @@ class TestLinearFamily:
         assert nn.Euclidean(4, 6).forward(rand(2, 4)).shape == (2, 6)
         out = np.asarray(nn.Cosine(4, 6).forward(rand(2, 4)))
         assert out.shape == (2, 6) and np.all(np.abs(out) <= 1 + 1e-5)
+
+
+class TestTfHelperOps:
+    """reference nn/tf/* helper ops."""
+
+    def test_const(self):
+        m = nn.Const(np.arange(6).reshape(2, 3))
+        out = m.forward(np.zeros(5))
+        assert np.asarray(out).shape == (2, 3)
+
+    def test_fill(self):
+        m = nn.Fill()
+        out = m.forward([np.array([2, 3]), np.array(7.0)])
+        np.testing.assert_array_equal(np.asarray(out), np.full((2, 3), 7.0))
+
+    def test_shape(self):
+        m = nn.Shape()
+        out = m.forward(np.zeros((3, 5, 7)))
+        np.testing.assert_array_equal(np.asarray(out), [3, 5, 7])
+
+    def test_split_and_select(self):
+        x = np.arange(12, dtype=np.float32).reshape(2, 6)
+        m = nn.SplitAndSelect(2, index=2, num_split=3)
+        out = np.asarray(m.forward(x))
+        np.testing.assert_array_equal(out, x[:, 2:4])
+        m2 = nn.SplitAndSelect(-1, index=1, num_split=2)
+        np.testing.assert_array_equal(np.asarray(m2.forward(x)), x[:, :3])
+
+    def test_stride_slice(self):
+        x = np.arange(24, dtype=np.float32).reshape(4, 6)
+        m = nn.StrideSlice([(1, 2, 4), (2, 1, 3)])
+        out = np.asarray(m.forward(x))
+        np.testing.assert_array_equal(out, x[1:3, 0:2])
