@@ -171,9 +171,10 @@ def main():
         with open(baseline_path) as f:
             base = json.load(f)
         # only comparable at the batch size/precision the baseline pinned
+        # baselines written before the precision field existed were fp32
         if (base.get("resnet50_train_images_per_sec") and
                 base.get("batch") == args.batch and
-                base.get("precision", "bf16") == args.precision):
+                base.get("precision", "fp32") == args.precision):
             vs = value / base["resnet50_train_images_per_sec"]
 
     print(json.dumps({"metric": "resnet50_train_images_per_sec",

@@ -45,7 +45,7 @@ class LabeledImageBytes:
         self.bytes = data
 
 
-class BytesToBGRImg:
+class BytesToBGRImg(Transformer):
     """Decode LabeledImageBytes → BGR LabeledImage (reference
     ``BytesToBGRImg``)."""
 
@@ -130,13 +130,8 @@ class LocalImgReader(Transformer):
 
     def __call__(self, it: Iterator) -> Iterator[LabeledImage]:
         for rec in it:
-            img = self._decode(rec.path)
-            h, w = img.shape[:2]
-            if h < w:
-                nh, nw = self.scale_to, max(1, round(w * self.scale_to / h))
-            else:
-                nh, nw = max(1, round(h * self.scale_to / w)), self.scale_to
-            yield LabeledImage(_resize_bilinear(img, nh, nw), rec.label)
+            img = _scale_shorter_side(self._decode(rec.path), self.scale_to)
+            yield LabeledImage(img, rec.label)
 
 
 class BGRImgToSample(Transformer):
@@ -163,6 +158,30 @@ class GreyImgToSample(BGRImgToSample):
 # ---------------------------------------------------------------------------
 # crops / flips
 # ---------------------------------------------------------------------------
+
+def _scale_shorter_side(img: np.ndarray, scale_to: int) -> np.ndarray:
+    """Shorter side → ``scale_to``, preserving aspect ratio (the reference
+    ``BGRImage.scale`` convention shared by reader and Scale transformer)."""
+    h, w = img.shape[:2]
+    if h < w:
+        nh, nw = scale_to, max(1, round(w * scale_to / h))
+    else:
+        nh, nw = max(1, round(h * scale_to / w)), scale_to
+    return _resize_bilinear(img, nh, nw)
+
+
+class Scale(Transformer):
+    """Scale the shorter side to ``scale_to``, preserving aspect ratio
+    (reference ``BGRImage.scale`` resize convention)."""
+
+    def __init__(self, scale_to: int):
+        self.scale_to = scale_to
+
+    def __call__(self, it: Iterator[LabeledImage]) -> Iterator[LabeledImage]:
+        for img in it:
+            yield LabeledImage(_scale_shorter_side(img.data, self.scale_to),
+                               img.label)
+
 
 class CenterCrop(Transformer):
     """(reference ``BGRImgCropper`` with CropCenter)."""

@@ -187,21 +187,17 @@ def write_records(path: str, records) -> None:
 # ---------------------------------------------------------------------------
 
 def _text_frame(payload: bytes) -> bytes:
-    """Hadoop ``Text`` serialization: vint length + utf8 bytes."""
+    """Hadoop ``Text`` serialization: vint length + utf8 bytes (delegates
+    to the module's vint helpers)."""
     import io as _io
     buf = _io.BytesIO()
-    _write_vlong(buf, len(payload))
-    buf.write(payload)
+    _write_text(buf, payload)
     return buf.getvalue()
 
 
 def _text_unframe(raw: bytes) -> bytes:
     import io as _io
-    buf = _io.BytesIO(raw)
-    n = _read_vlong(buf)
-    if n is None or n < 0:
-        raise IOError("corrupt Text key")
-    return buf.read(n)
+    return _read_text(_io.BytesIO(raw))
 
 
 def write_image_seqfile(path: str, entries: List[Tuple[str, float, bytes]]

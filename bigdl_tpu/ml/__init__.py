@@ -1,0 +1,14 @@
+"""bigdl_tpu.ml — ML-pipeline estimator wrappers.
+
+Reference equivalents: the Spark ML layer
+(``org.apache.spark.ml.DLEstimator`` / ``DLClassifier``,
+``spark/dl/src/main/scala/org/apache/spark/ml/DLClassifier.scala:32``) —
+fit/transform wrappers that plug the trainer into a pipeline framework.
+The TPU-native analog targets the de-facto Python pipeline convention
+(scikit-learn's fit/predict/transform) instead of Spark ML params.
+"""
+
+from bigdl_tpu.ml.estimator import (DLEstimator, DLModel, DLClassifier,
+                                    DLClassifierModel)
+
+__all__ = ["DLEstimator", "DLModel", "DLClassifier", "DLClassifierModel"]

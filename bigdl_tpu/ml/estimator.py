@@ -1,0 +1,151 @@
+"""DLEstimator / DLClassifier: pipeline-style fit/transform wrappers.
+
+Reference equivalents: ``org.apache.spark.ml.DLEstimator`` (generic
+feature/label sizes) and ``DLClassifier`` (classification sugar: scalar
+DoubleType label, argmax prediction column) —
+``spark/dl/src/main/scala/org/apache/spark/ml/DLClassifier.scala:32``.
+
+The TPU-native analog follows scikit-learn's protocol: ``fit(X, y)``
+returns a fitted model object exposing ``transform``/``predict``.  Inputs
+are arrays (or lists of per-record arrays) instead of DataFrame columns;
+``feature_size`` plays the same per-record reshape role as the reference's
+``featureSize`` param.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.dataset.dataset import LocalDataSet
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+
+
+class DLEstimator:
+    """fit(X, y) -> DLModel (reference ``DLEstimator``)."""
+
+    def __init__(self, model, criterion, feature_size: Sequence[int],
+                 label_size: Sequence[int] = (1,)):
+        self.model = model
+        self.criterion = criterion
+        self.feature_size = tuple(int(s) for s in feature_size)
+        self.label_size = tuple(int(s) for s in label_size)
+        self.batch_size = 32
+        self.max_epoch = 10
+        self.learning_rate = 1e-3
+        self.optim_method = None
+
+    # fluent config (the reference's Params surface)
+    def set_batch_size(self, b: int) -> "DLEstimator":
+        self.batch_size = b
+        return self
+
+    def set_max_epoch(self, e: int) -> "DLEstimator":
+        self.max_epoch = e
+        return self
+
+    def set_learning_rate(self, lr: float) -> "DLEstimator":
+        self.learning_rate = lr
+        return self
+
+    def set_optim_method(self, method) -> "DLEstimator":
+        self.optim_method = method
+        return self
+
+    @staticmethod
+    def _check_lengths(X, y) -> None:
+        if len(X) != len(y):
+            raise ValueError(f"X has {len(X)} records but y has {len(y)}")
+
+    def _samples(self, X, y) -> List[Sample]:
+        self._check_lengths(X, y)
+        out = []
+        for feat, lab in zip(X, y):
+            f = np.asarray(feat, np.float32).reshape(self.feature_size)
+            l = np.asarray(lab, np.float32).reshape(self.label_size)
+            out.append(Sample(f, l))
+        return out
+
+    def fit(self, X, y) -> "DLModel":
+        import bigdl_tpu.optim as optim
+
+        ds = LocalDataSet(self._samples(X, y)).transform(
+            SampleToMiniBatch(self.batch_size))
+        opt = optim.Optimizer.create(self.model, ds, self.criterion)
+        opt.set_optim_method(self.optim_method or
+                             optim.SGD(learning_rate=self.learning_rate))
+        opt.set_end_when(optim.max_epoch(self.max_epoch))
+        trained = opt.optimize()
+        return self._wrap(trained)
+
+    def _wrap(self, model) -> "DLModel":
+        return DLModel(model, self.feature_size).set_batch_size(
+            self.batch_size)
+
+
+class DLModel:
+    """Fitted model: transform(X) appends raw model outputs
+    (reference ``DLModel``)."""
+
+    def __init__(self, model, feature_size: Sequence[int]):
+        self.model = model
+        self.feature_size = tuple(int(s) for s in feature_size)
+        self.batch_size = 32
+
+    def set_batch_size(self, b: int) -> "DLModel":
+        self.batch_size = b
+        return self
+
+    def _forward(self, X) -> np.ndarray:
+        import jax.numpy as jnp
+        from bigdl_tpu.optim.evaluator import _eval_forward
+
+        self.model.evaluate()
+        fwd = _eval_forward(self.model)
+        feats = np.stack([np.asarray(x, np.float32)
+                          .reshape(self.feature_size) for x in X])
+        outs = []
+        for i in range(0, len(feats), self.batch_size):
+            outs.append(np.asarray(fwd(jnp.asarray(feats[i:i + self.batch_size]))))
+        return np.concatenate(outs, axis=0)
+
+    def transform(self, X) -> np.ndarray:
+        return self._forward(X)
+
+    predict = transform
+
+
+class DLClassifier(DLEstimator):
+    """Classification sugar: scalar 1-based labels in, argmax predictions
+    out (reference ``DLClassifier``)."""
+
+    def __init__(self, model, criterion, feature_size: Sequence[int]):
+        super().__init__(model, criterion, feature_size, (1,))
+
+    def _samples(self, X, y) -> List[Sample]:
+        # scalar class-id labels: ClassNLL-style criteria take (N,) targets
+        self._check_lengths(X, y)
+        out = []
+        for feat, lab in zip(X, y):
+            f = np.asarray(feat, np.float32).reshape(self.feature_size)
+            out.append(Sample(f, np.float32(np.asarray(lab).reshape(()))))
+        return out
+
+    def _wrap(self, model) -> "DLClassifierModel":
+        return DLClassifierModel(model, self.feature_size).set_batch_size(
+            self.batch_size)
+
+
+class DLClassifierModel(DLModel):
+    """Prediction column = argmax class, 1-based like the reference's
+    DoubleType predictions (``batchOutputToPrediction``)."""
+
+    def transform(self, X) -> np.ndarray:
+        out = self._forward(X)
+        if out.ndim != 2:
+            raise ValueError(f"classifier output must be 2-D, got {out.shape}")
+        return out.argmax(axis=1).astype(np.float64) + 1.0
+
+    predict = transform

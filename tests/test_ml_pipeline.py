@@ -1,0 +1,37 @@
+"""ML-pipeline estimator wrappers (reference DLEstimator/DLClassifier)."""
+
+import numpy as np
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.dataset.datasets import synthetic_separable
+from bigdl_tpu.ml import DLClassifier, DLEstimator
+
+
+def test_classifier_fit_predict():
+    samples = synthetic_separable(256, 4, n_classes=3, seed=7)
+    X = np.stack([s.feature for s in samples])
+    y = np.asarray([float(s.label) for s in samples])
+    model = (nn.Sequential().add(nn.Linear(4, 16)).add(nn.Tanh())
+             .add(nn.Linear(16, 3)).add(nn.LogSoftMax()))
+    clf = (DLClassifier(model, nn.ClassNLLCriterion(), [4])
+           .set_batch_size(32).set_max_epoch(15).set_learning_rate(0.5))
+    fitted = clf.fit(X, y)
+    preds = fitted.predict(X)
+    assert preds.shape == (256,)
+    acc = float((preds == y).mean())
+    assert acc > 0.9, acc
+
+
+def test_estimator_regression():
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(128, 3)).astype(np.float32)
+    w_true = np.asarray([[1.0], [-2.0], [0.5]], np.float32)
+    y = X @ w_true + 0.01 * rng.normal(size=(128, 1)).astype(np.float32)
+    model = nn.Sequential().add(nn.Linear(3, 1))
+    est = (DLEstimator(model, nn.MSECriterion(), [3], [1])
+           .set_batch_size(32).set_max_epoch(60).set_learning_rate(0.1))
+    fitted = est.fit(X, y)
+    out = fitted.transform(X)
+    assert out.shape == (128, 1)
+    mse = float(((out - y) ** 2).mean())
+    assert mse < 0.01, mse

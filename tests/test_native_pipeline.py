@@ -147,3 +147,41 @@ class TestSeqFileFolder:
         imgs = list(ds.data(train=False))
         assert imgs[0].data.shape == (16, 16, 3)
         assert {im.label for im in imgs} == {1.0, 2.0, 3.0}
+
+    def test_lazy_seqfile_training_pipeline(self, tmp_path):
+        """seq-file byte records -> lazy decode/scale/crop/normalize/batch
+        feeding the optimizer (the inception driver's real-data path)."""
+        import io
+        from PIL import Image
+        import bigdl_tpu.nn as nn
+        import bigdl_tpu.optim as optim
+        from bigdl_tpu.dataset.dataset import DataSet
+        from bigdl_tpu.dataset.image import (BGRImgNormalizer, BGRImgToSample,
+                                             CenterCrop, Scale)
+        from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+
+        rng = np.random.RandomState(5)
+        entries = []
+        for i in range(16):
+            lab = i % 2
+            arr = rng.randint(0, 80, size=(20, 24, 3)).astype(np.uint8)
+            if lab:
+                arr[:, :12] += 120
+            buf = io.BytesIO()
+            Image.fromarray(arr).save(buf, format="JPEG")
+            entries.append((f"i{i}", float(lab + 1), buf.getvalue()))
+        seqfile.write_image_seqfile(str(tmp_path / "p.seq"), entries)
+
+        ds = (DataSet.seq_file_folder(str(tmp_path))
+              .transform(Scale(18)).transform(CenterCrop(16, 16))
+              .transform(BGRImgNormalizer((90.0,) * 3, (60.0,) * 3))
+              .transform(BGRImgToSample())
+              .transform(SampleToMiniBatch(8)))
+        m = (nn.Sequential().add(nn.Reshape((3 * 16 * 16,)))
+             .add(nn.Linear(3 * 16 * 16, 2)).add(nn.LogSoftMax()))
+        opt = optim.Optimizer.create(m, ds, nn.ClassNLLCriterion())
+        opt.set_optim_method(optim.SGD(learning_rate=0.2))
+        opt.set_end_when(optim.max_epoch(6))
+        trained = opt.optimize()
+        w, _ = trained.get_parameters()
+        assert np.all(np.isfinite(np.asarray(w)))

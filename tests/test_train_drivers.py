@@ -61,3 +61,14 @@ class TestTrainDrivers:
     def test_textclassifier_synthetic_smoke(self):
         tc_train.main(["--synthetic", "32", "-b", "8",
                        "--max-iteration", "2"])
+
+    def test_autoencoder_synthetic(self):
+        from bigdl_tpu.models.autoencoder import train as ae_train
+        model = ae_train.main(["--synthetic", "256", "-b", "64", "-e", "3"])
+        w, _ = model.get_parameters()
+        assert np.all(np.isfinite(np.asarray(w)))
+
+    def test_inception_synthetic_smoke(self):
+        from bigdl_tpu.models.inception import train as inc_train
+        inc_train.main(["--synthetic", "16", "-b", "8", "--classes", "4",
+                        "--max-iteration", "2"])
