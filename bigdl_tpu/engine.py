@@ -60,6 +60,27 @@ class Engine:
             _STATE.inited = True
 
     @staticmethod
+    def init_distributed(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> None:
+        """Join the multi-host jax distributed runtime (the reference's
+        multi-node tier: one Spark executor per node; here one host process
+        per TPU host, SURVEY §7 hard-parts note).  After this,
+        ``jax.devices()`` spans all hosts and ``Engine.create_mesh`` builds
+        global meshes whose collectives ride ICI within a pod and DCN
+        across pods.  No-op when already initialised."""
+        # idempotence via jax's own distributed state: touching the backend
+        # (e.g. jax.process_count()) before initialize() would pre-initialise
+        # local-only XLA and break the multi-host bring-up
+        state = getattr(jax.distributed, "global_state", None)
+        if state is not None and getattr(state, "client", None) is not None:
+            return
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+        Engine.init()
+
+    @staticmethod
     def node_number() -> int:
         Engine._ensure()
         return _STATE.node_number

@@ -1,0 +1,39 @@
+"""LeNet-5/MNIST Test (evaluation-only) driver.
+
+Reference equivalent: ``models/lenet/Test.scala`` — load a trained snapshot,
+evaluate Top1 on the test split.
+
+Run::
+
+    python -m bigdl_tpu.models.lenet.test -f <mnist> --model <model.N>
+"""
+
+import numpy as np
+
+import bigdl_tpu.optim as optim
+from bigdl_tpu.dataset.datasets import load_mnist
+from bigdl_tpu.models import driver_utils
+from bigdl_tpu.models.lenet.train import _synthetic, _to_samples
+from bigdl_tpu.utils import file_io
+
+
+def main(argv=None):
+    p = driver_utils.base_parser("Evaluate a LeNet-5 snapshot on MNIST")
+    args = p.parse_args(argv)
+    driver_utils.init_logging()
+    if not args.model:
+        raise SystemExit("--model <snapshot> is required")
+    batch = args.batch_size or 128
+
+    samples = (_synthetic(args.synthetic, seed=2) if args.synthetic
+               else _to_samples(load_mnist(args.folder, "test")))
+    model = file_io.load(args.model)
+    results = optim.Evaluator(model).test(
+        samples, [optim.Top1Accuracy(), optim.Top5Accuracy()], batch)
+    for method, res in results:
+        print(f"{method.name} is {res}")
+    return results
+
+
+if __name__ == "__main__":
+    main()

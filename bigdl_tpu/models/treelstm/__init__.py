@@ -1,0 +1,20 @@
+"""TreeLSTM sentiment classifier (reference ``example/treeLSTMSentiment``).
+
+The BinaryTreeLSTM consumes ``[leaf embeddings, tree]`` (tree = (B, n, 2)
+child indices, children-before-parents) and emits internal-node hiddens in
+topological order; the ROOT is the last internal node, so the classifier
+head selects it and projects to classes.
+"""
+
+from bigdl_tpu.nn import (BinaryTreeLSTM, Linear, LogSoftMax, Select,
+                          Sequential)
+
+
+def tree_lstm_sentiment(embed_dim: int, hidden_size: int,
+                        class_num: int = 5) -> Sequential:
+    m = Sequential()
+    m.add(BinaryTreeLSTM(embed_dim, hidden_size))
+    m.add(Select(2, -1))            # root = last internal node
+    m.add(Linear(hidden_size, class_num))
+    m.add(LogSoftMax())
+    return m

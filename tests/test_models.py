@@ -126,10 +126,14 @@ def test_text_classifier():
 def test_lenet_train_step_decreases_loss():
     """End-to-end sanity: a few SGD steps on random data reduce NLL."""
     from bigdl_tpu.nn import ClassNLLCriterion
+    import jax
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.rand(16, 28, 28), jnp.float32)
     y = jnp.asarray(rng.randint(1, 11, size=(16,)))
     m = models.lenet5(10)
+    # explicit init key: module-name-counter-derived default keys depend on
+    # how many modules earlier tests created, making lr-0.5 steps flaky
+    m.reset(jax.random.PRNGKey(7))
     crit = ClassNLLCriterion()
     losses = []
     for _ in range(5):

@@ -72,3 +72,25 @@ class TestTrainDrivers:
         from bigdl_tpu.models.inception import train as inc_train
         inc_train.main(["--synthetic", "16", "-b", "8", "--classes", "4",
                         "--max-iteration", "2"])
+
+    def test_lenet_eval_only_driver(self, tmp_path):
+        from bigdl_tpu.models.lenet import test as lenet_test
+        ckpt = str(tmp_path / "ckpt")
+        lenet_train.main(["--synthetic", "128", "-b", "64", "-e", "2",
+                          "--checkpoint", ckpt])
+        snaps = sorted(f for f in os.listdir(ckpt) if f.startswith("model."))
+        results = lenet_test.main(["--synthetic", "64",
+                                   "--model", os.path.join(ckpt, snaps[-1])])
+        assert results[0][0].name == "Top1Accuracy"
+
+    def test_treelstm_sentiment_synthetic(self):
+        from bigdl_tpu.models.treelstm import train as tree_train
+        model = tree_train.main(["--synthetic", "128", "-b", "32",
+                                 "-e", "15", "-r", "0.5"])
+        from bigdl_tpu.models.treelstm.train import _synthetic
+        from bigdl_tpu.optim.evaluator import Evaluator
+        import bigdl_tpu.optim as optim
+        val = _synthetic(64, seed=3)
+        acc = Evaluator(model).test(
+            val, [optim.Top1Accuracy()], 32)[0][1].final_result()
+        assert acc > 0.8, f"TreeLSTM failed to learn: acc={acc}"
