@@ -53,14 +53,18 @@ class BatchNormalization(Module):
         return {"running_mean": jnp.zeros((self.n_output,)),
                 "running_var": jnp.ones((self.n_output,))}
 
+    # channel axis (1 = torch NCHW convention; NHWC variants use -1)
+    channel_axis = 1
+
     def _param_view(self, ndim):
         shape = [1] * ndim
-        shape[1] = self.n_output
+        shape[self.channel_axis % ndim] = self.n_output
         return shape
 
     def apply(self, params, input, state, training=False, rng=None):
         view = self._param_view(input.ndim)
-        axes = tuple(i for i in range(input.ndim) if i != 1)
+        ch = self.channel_axis % input.ndim
+        axes = tuple(i for i in range(input.ndim) if i != ch)
         if training:
             mean = jnp.mean(input, axis=axes)
             var = jnp.var(input, axis=axes)
@@ -83,7 +87,15 @@ class BatchNormalization(Module):
 
 
 class SpatialBatchNormalization(BatchNormalization):
-    """BN over (N, C, H, W) (reference ``nn/SpatialBatchNormalization.scala``)."""
+    """BN over (N, C, H, W) (reference ``nn/SpatialBatchNormalization.scala``).
+    ``format="NHWC"`` normalizes the trailing channel axis instead (the
+    TF-import and TPU-preferred activation layout)."""
+
+    def __init__(self, n_output, eps=1e-5, momentum=0.1, affine=True,
+                 init_weight=None, init_bias=None, format="NCHW", name=None):
+        super().__init__(n_output, eps, momentum, affine, init_weight,
+                         init_bias, name=name)
+        self.channel_axis = 1 if format == "NCHW" else -1
 
 
 class Normalize(Module):

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+import jax.numpy as jnp
 import numpy as np
 
 import bigdl_tpu.nn as nn
@@ -94,12 +95,26 @@ class TensorflowLoader:
         if not self._input_nodes:
             raise ValueError("no graph inputs found among " +
                              ", ".join(self.inputs))
-        return Graph(self._input_nodes, out_nodes)
+        g = Graph(self._input_nodes, out_nodes)
+        # imported GraphDefs are inference graphs (is_training baked in):
+        # eval mode keeps frozen BatchNorm statistics frozen and Dropout off
+        g.evaluate()
+        return g
 
     # -- conversion ------------------------------------------------------
 
     def _in(self, node, i: int):
         return self.nodes[node.input[i].split(":")[0].lstrip("^")]
+
+    def _resolve_const(self, node):
+        """Follow Identity chains to the underlying Const (frozen graphs
+        wrap variable reads as Const -> Identity -> consumer); None when the
+        chain ends elsewhere."""
+        seen = 0
+        while node.op == "Identity" and node.input and seen < 16:
+            node = self._in(node, 0)
+            seen += 1
+        return node if node.op == "Const" else None
 
     def _convert(self, name: str) -> ModuleNode:
         name = name.split(":")[0]
@@ -168,8 +183,8 @@ class TensorflowLoader:
 
     def _op_matmul(self, node, bias: Optional[np.ndarray] = None,
                    name: Optional[str] = None):
-        w_node = self._in(node, 1)
-        if w_node.op != "Const":
+        w_node = self._resolve_const(self._in(node, 1))
+        if w_node is None:
             raise ValueError(f"MatMul {node.name}: non-Const weights")
         if node.attr["transpose_a"].b:
             raise ValueError(f"MatMul {node.name}: transpose_a unsupported")
@@ -183,8 +198,8 @@ class TensorflowLoader:
 
     def _op_conv2d(self, node, bias: Optional[np.ndarray] = None,
                    name: Optional[str] = None):
-        w_node = self._in(node, 1)
-        if w_node.op != "Const":
+        w_node = self._resolve_const(self._in(node, 1))
+        if w_node is None:
             raise ValueError(f"Conv2D {node.name}: non-Const weights")
         dil = list(node.attr["dilations"].list.i)
         if dil and any(d != 1 for d in dil):
@@ -203,8 +218,8 @@ class TensorflowLoader:
 
     def _op_biasadd(self, node):
         pre = self._in(node, 0)
-        b_node = self._in(node, 1)
-        if b_node.op == "Const" and pre.op in ("Conv2D", "MatMul"):
+        b_node = self._resolve_const(self._in(node, 1))
+        if b_node is not None and pre.op in ("Conv2D", "MatMul"):
             # fuse: Conv2D/MatMul + BiasAdd -> one layer (reference
             # TensorflowToBigDL's Conv2D/FullConnection patterns)
             bias = _const_value(b_node)
@@ -219,8 +234,8 @@ class TensorflowLoader:
         return self._op_add(node)
 
     def _op_add(self, node):
-        a, b = self._in(node, 0), self._in(node, 1)
-        if b.op == "Const":
+        a, b = self._in(node, 0), self._resolve_const(self._in(node, 1))
+        if b is not None:
             v = _const_value(b)
             if v.ndim == 0:
                 return self._unary(node, nn.AddConstant(float(v)))
@@ -232,6 +247,29 @@ class TensorflowLoader:
                                     self._convert(node.input[1]))
 
     _op_addv2 = _op_add
+
+    def _op_fusedbatchnorm(self, node):
+        """FusedBatchNorm(V2/V3) inference import: (x, scale, offset, mean,
+        variance) -> SpatialBatchNormalization with frozen running stats."""
+        if node.attr["is_training"].b:
+            raise ValueError(f"{node.name}: training-mode FusedBatchNorm "
+                             "import unsupported")
+        parts = [self._resolve_const(self._in(node, i)) for i in (1, 2, 3, 4)]
+        if any(p is None for p in parts):
+            raise ValueError(f"{node.name}: non-Const batch-norm parameters")
+        scale, offset, mean, var = (_const_value(p) for p in parts)
+        # a stripped/absent attr reads 0.0; the op's registered default
+        eps = float(node.attr["epsilon"].f) or 1e-4
+        bn = nn.SpatialBatchNormalization(
+            scale.shape[0], eps=eps, init_weight=scale, init_bias=offset,
+            format=_data_format(node), name=node.name)
+        bn.reset()
+        bn.state = {"running_mean": jnp.asarray(mean),
+                    "running_var": jnp.asarray(var)}
+        return ModuleNode(bn).inputs(self._convert(node.input[0]))
+
+    _op_fusedbatchnormv2 = _op_fusedbatchnorm
+    _op_fusedbatchnormv3 = _op_fusedbatchnorm
 
     def _op_maxpool(self, node):
         return self._pool(node, nn.SpatialMaxPooling)

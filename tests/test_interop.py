@@ -209,3 +209,29 @@ class TestLoaderRegressions:
                          dilations=[1, 2, 2, 1], name="output")
         with pytest.raises(ValueError, match="dilations"):
             TensorflowLoader.load(g.as_graph_def(), ["input"], ["output"])
+
+    def test_frozen_graph_identity_weights_and_fused_bn(self):
+        """Frozen-graph idioms: Const->Identity->op weight reads and
+        inference-mode FusedBatchNorm."""
+        from bigdl_tpu.utils.tf import TensorflowLoader
+        g = tf.Graph()
+        rng = np.random.RandomState(11)
+        with g.as_default():
+            x = tf.compat.v1.placeholder(tf.float32, [None, 8, 8, 3],
+                                         name="input")
+            k = tf.identity(tf.constant(
+                rng.normal(size=(3, 3, 3, 4)).astype(np.float32) * 0.3))
+            h = tf.nn.conv2d(x, k, strides=[1, 1, 1, 1], padding="SAME")
+            scale = tf.constant(rng.uniform(0.5, 1.5, 4).astype(np.float32))
+            offset = tf.constant(rng.normal(size=4).astype(np.float32))
+            mean = tf.constant(rng.normal(size=4).astype(np.float32))
+            var = tf.constant(rng.uniform(0.5, 2.0, 4).astype(np.float32))
+            h, *_ = tf.compat.v1.nn.fused_batch_norm(
+                h, scale, offset, mean, var, epsilon=1e-3, is_training=False)
+            tf.nn.relu(h, name="output")
+        gd = g.as_graph_def()
+        model = TensorflowLoader.load(gd, ["input"], ["output"])
+        xv = rng.normal(size=(2, 8, 8, 3)).astype(np.float32)
+        ours = np.asarray(model.evaluate().forward(xv))
+        theirs = _run_tf(gd, "input", xv, "output")
+        np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-4)
