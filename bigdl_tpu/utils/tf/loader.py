@@ -58,6 +58,30 @@ def _data_format(node) -> str:
     return "NCHW" if node.attr["data_format"].s == b"NCHW" else "NHWC"
 
 
+class _ConstPad(nn.Module):
+    """Zero-pad by a static (ndim, 2) paddings table (TF Pad op)."""
+
+    def __init__(self, pads, name=None):
+        super().__init__(name)
+        self.pads = tuple((int(a), int(b)) for a, b in pads)
+
+    def apply(self, params, input, state, training=False, rng=None):
+        return jnp.pad(input, self.pads), state
+
+
+class _ReduceMean(nn.Module):
+    """Mean over static axes (TF Mean op / global average pooling)."""
+
+    def __init__(self, axes, keep_dims, name=None):
+        super().__init__(name)
+        self.axes = axes
+        self.keep_dims = keep_dims
+
+    def apply(self, params, input, state, training=False, rng=None):
+        return jnp.mean(input, axis=self.axes,
+                        keepdims=self.keep_dims), state
+
+
 class TensorflowLoader:
     """Pattern-matching GraphDef → Graph converter."""
 
@@ -234,7 +258,7 @@ class TensorflowLoader:
         return self._op_add(node)
 
     def _op_add(self, node):
-        a, b = self._in(node, 0), self._resolve_const(self._in(node, 1))
+        b = self._resolve_const(self._in(node, 1))
         if b is not None:
             v = _const_value(b)
             if v.ndim == 0:
@@ -270,6 +294,41 @@ class TensorflowLoader:
 
     _op_fusedbatchnormv2 = _op_fusedbatchnorm
     _op_fusedbatchnormv3 = _op_fusedbatchnorm
+
+    def _op_concatv2(self, node):
+        """ConcatV2(values..., axis Const) -> JoinTable (1-based dim).
+        The value count comes from the 'N' attr — control inputs (^dep)
+        trail the regular ones in node.input."""
+        n = int(node.attr["N"].i) or (len(node.input) - 1)
+        axis_node = self._resolve_const(self._in(node, n))
+        if axis_node is None:
+            raise ValueError(f"{node.name}: dynamic concat axis unsupported")
+        axis = int(_const_value(axis_node))
+        m = nn.JoinTable(axis + 1 if axis >= 0 else axis)
+        m.name = node.name
+        preds = [self._convert(node.input[i]) for i in range(n)]
+        return ModuleNode(m).inputs(*preds)
+
+    def _op_pad(self, node):
+        """Pad with Const paddings -> SpatialZeroPadding-style padding
+        (zero mode only, any rank via the generic Padding op)."""
+        pad_node = self._resolve_const(self._in(node, 1))
+        if pad_node is None:
+            raise ValueError(f"{node.name}: dynamic paddings unsupported")
+        pads = _const_value(pad_node).astype(int)   # (ndim, 2)
+        m = _ConstPad(pads, name=node.name)
+        return ModuleNode(m).inputs(self._convert(node.input[0]))
+
+    def _op_mean(self, node):
+        """Mean over Const reduction axes (global average pooling in
+        classification heads): keep_dims honored."""
+        ax_node = self._resolve_const(self._in(node, 1))
+        if ax_node is None:
+            raise ValueError(f"{node.name}: dynamic Mean axes unsupported")
+        axes = tuple(int(a) for a in np.atleast_1d(_const_value(ax_node)))
+        keep = bool(node.attr["keep_dims"].b)
+        m = _ReduceMean(axes, keep, name=node.name)
+        return ModuleNode(m).inputs(self._convert(node.input[0]))
 
     def _op_maxpool(self, node):
         return self._pool(node, nn.SpatialMaxPooling)

@@ -235,3 +235,26 @@ class TestLoaderRegressions:
         ours = np.asarray(model.evaluate().forward(xv))
         theirs = _run_tf(gd, "input", xv, "output")
         np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-4)
+
+    def test_concat_pad_mean_ops(self):
+        """Inception-style idioms: Pad + branch ConcatV2 + global Mean."""
+        from bigdl_tpu.utils.tf import TensorflowLoader
+        g = tf.Graph()
+        rng = np.random.RandomState(13)
+        with g.as_default():
+            x = tf.compat.v1.placeholder(tf.float32, [None, 6, 6, 2],
+                                         name="input")
+            p = tf.pad(x, [[0, 0], [1, 1], [1, 1], [0, 0]])
+            k1 = tf.constant(rng.normal(size=(3, 3, 2, 3)).astype(np.float32))
+            k2 = tf.constant(rng.normal(size=(1, 1, 2, 3)).astype(np.float32))
+            b1 = tf.nn.conv2d(p, k1, strides=[1, 1, 1, 1], padding="VALID")
+            b2 = tf.nn.conv2d(x, k2, strides=[1, 1, 1, 1], padding="SAME")
+            h = tf.concat([tf.nn.relu(b1), tf.nn.relu(b2)], axis=3)
+            tf.reduce_mean(h, axis=[1, 2], name="output")
+        gd = g.as_graph_def()
+        model = TensorflowLoader.load(gd, ["input"], ["output"])
+        xv = rng.normal(size=(2, 6, 6, 2)).astype(np.float32)
+        ours = np.asarray(model.forward(xv))
+        theirs = _run_tf(gd, "input", xv, "output")
+        assert ours.shape == theirs.shape == (2, 6)
+        np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-4)
