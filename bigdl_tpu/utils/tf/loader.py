@@ -299,7 +299,10 @@ class TensorflowLoader:
         """ConcatV2(values..., axis Const) -> JoinTable (1-based dim).
         The value count comes from the 'N' attr — control inputs (^dep)
         trail the regular ones in node.input."""
-        n = int(node.attr["N"].i) or (len(node.input) - 1)
+        n = int(node.attr["N"].i)
+        if n <= 0:
+            raise ValueError(f"{node.name}: ConcatV2 without the mandatory "
+                             "N attr")
         axis_node = self._resolve_const(self._in(node, n))
         if axis_node is None:
             raise ValueError(f"{node.name}: dynamic concat axis unsupported")

@@ -131,3 +131,25 @@ class TestRingAttention:
         ring = fn(q, k, v)
         np.testing.assert_allclose(np.asarray(ring), np.asarray(full),
                                    rtol=2e-5, atol=2e-6)
+
+
+class TestFlashGate:
+    def test_auto_falls_back_off_tpu(self):
+        """On the CPU test mesh, flash=None silently uses the reference
+        path and results stay correct."""
+        mha = MultiHeadAttention(32, 4)
+        x = jnp.asarray(np.random.RandomState(7).normal(
+            size=(2, 128, 32)).astype(np.float32))
+        out = mha.forward(x)
+        assert out.shape == (2, 128, 32)
+
+    def test_flash_true_raises_when_unsupported(self):
+        mha = MultiHeadAttention(32, 4, flash=True)
+        x = jnp.asarray(np.zeros((1, 128, 32), np.float32))
+        with pytest.raises(ValueError, match="flash=True"):
+            mha.forward(x)
+
+    def test_flash_false_forces_reference(self):
+        mha = MultiHeadAttention(32, 4, flash=False)
+        q = jnp.zeros((1, 128, 4, 8))
+        assert mha._flash_ok(q, q) is False
