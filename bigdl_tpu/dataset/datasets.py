@@ -106,6 +106,48 @@ def load_glove(path: str, dim: int = 100) -> Dict[str, np.ndarray]:
     return out
 
 
+def load_news20(news_dir: str) -> List[Tuple[str, int]]:
+    """Parse an extracted 20-newsgroups tree → [(text, label_id)], labels
+    1-based in sorted-subdirectory order (reference
+    ``pyspark/bigdl/dataset/news20.py`` get_news20; downloads out of scope —
+    the caller points at the extracted ``20_newsgroups`` directory)."""
+    texts: List[Tuple[str, int]] = []
+    label_id = 0
+    for name in sorted(os.listdir(news_dir)):
+        path = os.path.join(news_dir, name)
+        if not os.path.isdir(path):
+            continue
+        label_id += 1
+        for fname in sorted(os.listdir(path)):
+            if not fname.isdigit():
+                continue
+            with open(os.path.join(path, fname), encoding="latin-1") as f:
+                texts.append((f.read(), label_id))
+    return texts
+
+
+def load_movielens(data_dir: str) -> np.ndarray:
+    """Parse MovieLens ``ratings.dat`` (``::``-separated) → int array of
+    (user, item, rating, timestamp) rows (reference
+    ``pyspark/bigdl/dataset/movielens.py`` read_data_sets)."""
+    path = os.path.join(data_dir, "ratings.dat")
+    if not os.path.exists(path):
+        path = os.path.join(data_dir, "ml-1m", "ratings.dat")
+    with open(path, "r") as f:
+        rows = [line.strip().split("::") for line in f if line.strip()]
+    return np.asarray(rows).astype(np.int64)
+
+
+def movielens_id_pairs(data_dir: str) -> np.ndarray:
+    """(user, item) columns (reference get_id_pairs)."""
+    return load_movielens(data_dir)[:, 0:2]
+
+
+def movielens_id_ratings(data_dir: str) -> np.ndarray:
+    """(user, item, rating) columns (reference get_id_ratings)."""
+    return load_movielens(data_dir)[:, 0:3]
+
+
 # ---------------------------------------------------------------------------
 # synthetic data (tests + perf harnesses)
 # ---------------------------------------------------------------------------

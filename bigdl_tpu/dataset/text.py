@@ -40,6 +40,19 @@ class SentenceTokenizer(Transformer):
             yield self._pat.findall(s.lower())
 
 
+class SentenceBiPadding(Transformer):
+    """Wrap each sentence in start/end markers (reference
+    ``pyspark/bigdl/dataset/sentence.py`` sentences_bipadding — the rnn
+    example's LM pipeline marks sentence boundaries with these tokens)."""
+
+    START = "SENTENCESTART"
+    END = "SENTENCEEND"
+
+    def __call__(self, it: Iterator[str]) -> Iterator[str]:
+        for s in it:
+            yield f"{self.START} {s} {self.END}"
+
+
 class Dictionary:
     """Word ↔ index vocabulary (reference ``dataset/text/Dictionary.scala``).
 

@@ -11,6 +11,7 @@ from bigdl_tpu.dataset.image import (BGRImgToBatch, CenterCrop, ChannelNormalize
 from bigdl_tpu.dataset.text import (Dictionary, LabeledSentenceToSample,
                                     SentenceSplitter, SentenceTokenizer,
                                     TextToLabeledSentence)
+from bigdl_tpu.dataset import datasets
 from bigdl_tpu.dataset.datasets import synthetic_images, synthetic_separable
 
 
@@ -178,6 +179,35 @@ def test_oov_clamped_into_vocab():
     s = samples[0]
     assert s.feature.shape == (3, 2)
     assert s.label.max() <= d.vocab_size()
+
+
+def test_news20_tree_and_movielens(tmp_path):
+    # news20: label ids follow sorted subdirectory order, digit filenames only
+    for i, group in enumerate(["alt.atheism", "comp.graphics"]):
+        d = tmp_path / "news" / group
+        d.mkdir(parents=True)
+        (d / str(10000 + i)).write_text(f"post about {group}")
+        (d / "README").write_text("not a post")
+    # stray top-level file must not consume a label id
+    (tmp_path / "news" / "20news.tar.gz").write_text("")
+    texts = datasets.load_news20(str(tmp_path / "news"))
+    assert [(t[1]) for t in texts] == [1, 2]
+    assert "alt.atheism" in texts[0][0]
+
+    # movielens: :: framing, int columns
+    ml = tmp_path / "ml-1m"
+    ml.mkdir()
+    (ml / "ratings.dat").write_text("1::1193::5::978300760\n2::661::3::978302109\n")
+    arr = datasets.load_movielens(str(tmp_path))        # finds ml-1m/ subdir
+    assert arr.shape == (2, 4) and arr.dtype == np.int64
+    assert datasets.movielens_id_pairs(str(ml)).tolist() == [[1, 1193], [2, 661]]
+    assert datasets.movielens_id_ratings(str(ml))[0].tolist() == [1, 1193, 5]
+
+
+def test_sentence_bipadding():
+    from bigdl_tpu.dataset.text import SentenceBiPadding
+    out = list(SentenceBiPadding()(["hello world"]))
+    assert out == ["SENTENCESTART hello world SENTENCEEND"]
 
 
 def test_synthetic_generators():
