@@ -1,0 +1,13 @@
+"""Runnable examples mirroring the reference ``example/`` tree.
+
+| Reference (``example/``)           | Here                                   |
+|------------------------------------|----------------------------------------|
+| ``loadmodel/ModelValidator.scala`` | ``model_validator.py`` (CLI)           |
+| ``imageclassification/``           | ``image_predictor.py`` (CLI)           |
+| ``udfpredictor/``                  | ``udf_predictor.py`` (callable + CLI)  |
+| ``tensorflow/Load,Save.scala``     | ``tensorflow_interop.py`` (CLI)        |
+| ``textclassification/``            | ``bigdl_tpu/models/textclassifier``    |
+| ``treeLSTMSentiment/``             | ``bigdl_tpu/models/treelstm``          |
+| ``lenetLocal/``                    | ``bigdl_tpu/models/lenet`` train/test  |
+| ``MLPipeline/``                    | ``bigdl_tpu/ml`` estimators            |
+"""

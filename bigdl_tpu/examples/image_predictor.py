@@ -1,0 +1,70 @@
+"""Classify a folder of images with a trained model.
+
+Reference equivalent: ``example/imageclassification/ImagePredictor.scala`` —
+load a model, run the visual pipeline over every image under a folder, and
+print per-image predicted classes.
+
+Run::
+
+    python -m bigdl_tpu.examples.image_predictor \
+        --modelPath model.snapshot -f <image-folder> [--topN 5]
+"""
+
+import argparse
+import os
+
+import numpy as np
+
+from bigdl_tpu.dataset.image import (BGRImgToSample, CenterCrop,
+                                     ChannelNormalize, LocalImgPath,
+                                     LocalImgReader)
+from bigdl_tpu.examples.model_validator import load_model
+from bigdl_tpu.optim.predictor import Predictor
+
+IMG_EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def image_samples(folder: str, crop: int = 224, scale_to: int = 256,
+                  mean=(104.0, 117.0, 123.0)):
+    """Flat or nested image folder → (paths, samples)."""
+    paths = []
+    for root, _, files in sorted(os.walk(folder)):
+        for f in sorted(files):
+            if f.lower().endswith(IMG_EXTS):
+                paths.append(os.path.join(root, f))
+    records = [LocalImgPath(p, 0.0) for p in paths]
+    chain = ChannelNormalize(mean, (1.0, 1.0, 1.0))
+    it = BGRImgToSample()(chain(CenterCrop(crop, crop)(
+        LocalImgReader(scale_to)(iter(records)))))
+    return paths, list(it)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="Predict classes for images")
+    p.add_argument("-f", "--folder", required=True)
+    p.add_argument("--modelPath", required=True)
+    p.add_argument("-t", "--model-type", default="bigdl",
+                   choices=["bigdl", "caffe", "torch", "tf"])
+    p.add_argument("--caffeDefPath")
+    p.add_argument("-b", "--batch-size", type=int, default=32)
+    p.add_argument("--crop", type=int, default=224)
+    p.add_argument("--topN", type=int, default=1)
+    args = p.parse_args(argv)
+
+    model = load_model(args.model_type, args.modelPath, args.caffeDefPath)
+    model.evaluate()
+    paths, samples = image_samples(args.folder, crop=args.crop)
+    if not samples:
+        raise SystemExit(f"no images under {args.folder}")
+
+    out = Predictor(model).predict(samples, args.batch_size)
+    out = np.asarray(out)
+    for path, dist in zip(paths, out):
+        top = np.argsort(dist)[::-1][:args.topN]
+        classes = " ".join(f"{int(c) + 1}({dist[c]:.3f})" for c in top)
+        print(f"{path}: {classes}")
+    return list(zip(paths, out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,94 @@
+"""Load a trained model from any supported format and validate it.
+
+Reference equivalent: ``example/loadmodel/ModelValidator.scala`` — one CLI
+that loads a bigdl / caffe / torch / tensorflow model and evaluates
+Top1/Top5 accuracy over a labeled image folder.
+
+Run::
+
+    python -m bigdl_tpu.examples.model_validator \
+        -t caffe --caffeDefPath deploy.prototxt --modelPath net.caffemodel \
+        -f <val-image-tree> -b 32
+    python -m bigdl_tpu.examples.model_validator -t bigdl \
+        --modelPath model.snapshot -f <val-image-tree>
+"""
+
+import argparse
+
+import numpy as np
+
+import bigdl_tpu.optim as optim
+from bigdl_tpu.dataset import DataSet
+from bigdl_tpu.dataset.image import (BGRImgToSample, CenterCrop,
+                                     ChannelNormalize, LocalImgReader)
+from bigdl_tpu.utils import file_io
+
+
+def load_model(model_type: str, model_path: str, caffe_def_path=None,
+               tf_inputs=None, tf_outputs=None):
+    """Dispatch on model type (reference ModelValidator's match)."""
+    if model_type == "bigdl":
+        return file_io.load(model_path)
+    if model_type == "caffe":
+        from bigdl_tpu.utils.caffe.loader import load_caffe
+        if not caffe_def_path:
+            raise SystemExit("caffe models need --caffeDefPath")
+        return load_caffe(caffe_def_path, model_path)
+    if model_type == "torch":
+        from bigdl_tpu.utils.torch_module import load_model as load_t7
+        return load_t7(model_path)
+    if model_type == "tf":
+        from bigdl_tpu.utils.tf.loader import load as load_tf
+        if not (tf_inputs and tf_outputs):
+            raise SystemExit("tf models need --inputs and --outputs")
+        return load_tf(model_path, tf_inputs, tf_outputs)
+    raise SystemExit(f"unknown model type {model_type!r} "
+                     "(want bigdl|caffe|torch|tf)")
+
+
+def validation_samples(folder: str, crop: int = 224, scale_to: int = 256,
+                       mean=(104.0, 117.0, 123.0), std=(1.0, 1.0, 1.0)):
+    """Labeled image tree → centered-crop normalized samples (reference
+    preprocessors in ``example/loadmodel/Preprocessor.scala``)."""
+    ds = DataSet.image_folder(folder, scale_to=scale_to)
+    ds = (ds.transform(LocalImgReader(scale_to))
+            .transform(CenterCrop(crop, crop))
+            .transform(ChannelNormalize(mean, std))
+            .transform(BGRImgToSample()))
+    return list(ds.data(train=False))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Load a bigdl/caffe/torch/tf model and validate it")
+    p.add_argument("-f", "--folder", required=True,
+                   help="label-per-subdirectory validation image tree")
+    p.add_argument("-t", "--model-type", required=True,
+                   choices=["bigdl", "caffe", "torch", "tf"])
+    p.add_argument("--modelPath", required=True)
+    p.add_argument("--caffeDefPath")
+    p.add_argument("--inputs", nargs="*", help="tf graph input node names")
+    p.add_argument("--outputs", nargs="*", help="tf graph output node names")
+    p.add_argument("-b", "--batch-size", type=int, default=32)
+    p.add_argument("--crop", type=int, default=224)
+    p.add_argument("--meanFile",
+                   help=".npy channel-mean file (else caffe BGR means)")
+    args = p.parse_args(argv)
+
+    model = load_model(args.model_type, args.modelPath, args.caffeDefPath,
+                       args.inputs, args.outputs)
+    model.evaluate()
+
+    mean = (tuple(np.load(args.meanFile).ravel()[:3]) if args.meanFile
+            else (104.0, 117.0, 123.0))
+    samples = validation_samples(args.folder, crop=args.crop, mean=mean)
+    results = optim.Evaluator(model).test(
+        samples, [optim.Top1Accuracy(), optim.Top5Accuracy()],
+        args.batch_size)
+    for method, result in results:
+        print(f"{method}: {result}")
+    return results
+
+
+if __name__ == "__main__":
+    main()

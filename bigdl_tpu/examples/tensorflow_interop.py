@@ -1,0 +1,67 @@
+"""TensorFlow GraphDef load/save demo.
+
+Reference equivalent: ``example/tensorflow/Load.scala`` + ``Save.scala`` —
+load a frozen GraphDef as a model and run it; export a model to a GraphDef
+TensorFlow can import.
+
+Run::
+
+    python -m bigdl_tpu.examples.tensorflow_interop load \
+        --modelPath model.pb --inputs Placeholder --outputs output
+    python -m bigdl_tpu.examples.tensorflow_interop save \
+        --out model.pb [--modelPath model.snapshot]
+"""
+
+import argparse
+
+import numpy as np
+
+
+def cmd_load(args):
+    from bigdl_tpu.utils.tf.loader import load as load_tf
+    model = load_tf(args.modelPath, args.inputs, args.outputs)
+    model.evaluate()
+    shape = tuple(int(s) for s in args.shape)
+    x = np.random.RandomState(0).normal(size=shape).astype(np.float32)
+    out = model.forward(x)
+    print(f"loaded {args.modelPath}: forward({shape}) -> "
+          f"{np.asarray(out).shape}")
+    return model
+
+
+def cmd_save(args):
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.utils import file_io
+    from bigdl_tpu.utils.tf.saver import save as save_tf
+    if args.modelPath:
+        model = file_io.load(args.modelPath)
+    else:  # the reference's Save.scala demo: a small LeNet-ish chain
+        model = (nn.Sequential()
+                 .add(nn.Linear(784, 128)).add(nn.Tanh())
+                 .add(nn.Linear(128, 10)).add(nn.SoftMax()))
+    shape = [None] + [int(s) for s in args.shape[1:]] \
+        if args.shape else [None, 784]
+    save_tf(model, shape, args.out)
+    print(f"saved GraphDef to {args.out}")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="TF GraphDef load/save demo")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    pl = sub.add_parser("load")
+    pl.add_argument("--modelPath", required=True)
+    pl.add_argument("--inputs", nargs="+", required=True)
+    pl.add_argument("--outputs", nargs="+", required=True)
+    pl.add_argument("--shape", nargs="+", default=[1, 28, 28])
+    pl.set_defaults(fn=cmd_load)
+    ps = sub.add_parser("save")
+    ps.add_argument("--out", required=True)
+    ps.add_argument("--modelPath")
+    ps.add_argument("--shape", nargs="+")
+    ps.set_defaults(fn=cmd_save)
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

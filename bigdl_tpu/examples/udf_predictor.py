@@ -1,0 +1,87 @@
+"""Text-classification predictor packaged as a reusable UDF.
+
+Reference equivalent: ``example/udfpredictor/`` — wraps a trained text
+classifier as a user-defined function applied over a table of documents
+(there: a Spark SQL UDF on a DataFrame; here: a plain callable usable with
+any dataframe library, plus a CLI over a folder of ``.txt`` files).
+
+Run::
+
+    python -m bigdl_tpu.examples.udf_predictor \
+        --modelPath model.snapshot --glove glove.6B.200d.txt -f <txt-folder>
+"""
+
+import argparse
+import os
+
+import numpy as np
+
+from bigdl_tpu.dataset.datasets import load_glove
+from bigdl_tpu.dataset.text import SentenceTokenizer
+from bigdl_tpu.optim.predictor import Predictor
+from bigdl_tpu.utils import file_io
+
+
+def make_udf(model, word_vectors, seq_len: int = 1000,
+             batch_size: int = 32):
+    """Return ``predict(texts) -> 1-based class labels`` — the reference's
+    ``udf(predict _)`` body (``udfpredictor/Utils.scala``): tokenize, embed
+    with pretrained vectors, batch through the model."""
+    model.evaluate()
+    tok = SentenceTokenizer()
+    dim = len(next(iter(word_vectors.values())))
+    predictor = Predictor(model)
+
+    def embed(text: str) -> np.ndarray:
+        from bigdl_tpu.dataset.sample import Sample
+        words = next(tok(iter([text])), [])
+        seq = np.zeros((seq_len, dim), dtype=np.float32)
+        for i, w in enumerate(words[:seq_len]):
+            v = word_vectors.get(w)
+            if v is not None:
+                seq[i] = v
+        return Sample(seq, np.float32(0))
+
+    def predict(texts):
+        if isinstance(texts, str):
+            texts = [texts]
+        if not texts:
+            return []
+        samples = [embed(t) for t in texts]
+        return (predictor.predict_class(samples, batch_size)
+                .astype(int).tolist())
+
+    return predict
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Apply a text-classifier UDF over documents")
+    p.add_argument("-f", "--folder", required=True,
+                   help="folder of .txt documents")
+    p.add_argument("--modelPath", required=True)
+    p.add_argument("--glove", required=True, help="GloVe .txt vectors")
+    p.add_argument("--dim", type=int, default=200)
+    p.add_argument("--seq-len", type=int, default=1000)
+    p.add_argument("-b", "--batch-size", type=int, default=32)
+    args = p.parse_args(argv)
+
+    model = file_io.load(args.modelPath)
+    vectors = load_glove(args.glove, args.dim)
+    udf = make_udf(model, vectors, args.seq_len, args.batch_size)
+
+    names, texts = [], []
+    for f in sorted(os.listdir(args.folder)):
+        path = os.path.join(args.folder, f)
+        if os.path.isfile(path):
+            names.append(f)
+            with open(path, errors="ignore") as fh:
+                texts.append(fh.read())
+    if not texts:
+        raise SystemExit(f"no documents under {args.folder}")
+    for name, label in zip(names, udf(texts)):
+        print(f"{name}: {label}")
+
+
+if __name__ == "__main__":
+    main()

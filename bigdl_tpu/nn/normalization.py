@@ -76,7 +76,10 @@ class BatchNormalization(Module):
                 "running_var": (1 - m) * state["running_var"] + m * unbiased,
             }
         else:
-            mean, var = state["running_mean"], state["running_var"]
+            # compute in the activation dtype: fp32 running stats must not
+            # promote a bf16 inference forward back to fp32 mid-network
+            mean = state["running_mean"].astype(input.dtype)
+            var = state["running_var"].astype(input.dtype)
             new_state = state
         inv = jax.lax.rsqrt(var + self.eps)
         out = (input - jnp.reshape(mean, view)) * jnp.reshape(inv, view)

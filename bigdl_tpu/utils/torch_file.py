@@ -53,7 +53,11 @@ _DTYPE_TO_STORAGE = {np.dtype(v): k for k, v in _STORAGE_CLASSES.items()}
 
 
 class TorchObject:
-    """An unrecognised torch class, kept as (class_name, payload)."""
+    """A torch class instance, kept as (class_name, payload table).
+
+    The reader produces these for any non-tensor torch class (nn modules,
+    optim states, …); the writer serializes them back, so module trees
+    round-trip.  ``utils/torch_module.py`` converts nn.* trees to Modules."""
 
     def __init__(self, torch_class: str, payload: Any):
         self.torch_class = torch_class
@@ -61,6 +65,17 @@ class TorchObject:
 
     def __repr__(self):
         return f"TorchObject({self.torch_class})"
+
+
+class LongStorage:
+    """Marks an int sequence to serialize as ``torch.LongStorage`` (torch
+    stores View/Reshape sizes as storages, not tensors)."""
+
+    def __init__(self, values):
+        self.values = np.asarray(values, dtype=np.int64).ravel()
+
+    def __repr__(self):
+        return f"LongStorage({self.values.tolist()})"
 
 
 # ---------------------------------------------------------------------------
@@ -204,6 +219,21 @@ class _Writer:
             self.f.write(data)
         elif isinstance(obj, np.ndarray):
             self._write_tensor(obj)
+        elif isinstance(obj, TorchObject):
+            self._i32(TYPE_TORCH)
+            if self._memoise(obj) is not None:
+                return
+            self._raw_string("V 1")
+            self._raw_string(obj.torch_class)
+            self.write(obj.payload)
+        elif isinstance(obj, LongStorage):
+            self._i32(TYPE_TORCH)
+            if self._memoise(obj) is not None:
+                return
+            self._raw_string("V 1")
+            self._raw_string("torch.LongStorage")
+            self._i64(obj.values.size)
+            self.f.write(obj.values.tobytes())
         elif isinstance(obj, dict):
             self._write_table(obj, obj.items())
         elif isinstance(obj, (list, tuple)):

@@ -1,0 +1,281 @@
+"""Torch7 ``.t7`` module tree ↔ Module conversion.
+
+Reference equivalent: the module half of ``utils/TorchFile.scala`` —
+``readModule`` dispatch (``TorchFile.scala:142-187``) and the
+``write<Layer>`` family (``:640-`` writers with ``writeGeneralParameters``):
+load a torch7-serialized nn.* tree as a trained model, and save a model so
+stock torch7 (or the reference) can read it.
+
+Weight layout bridges (same conventions as the caffe/TF importers):
+torch Linear stores (out, in) — native is (in, out); torch SpatialConvolution
+stores OIHW (the reference writer views it 2-D as (nOut, nIn*kH*kW),
+``TorchFile.scala:482``) — native is HWIO.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.utils import torch_file
+from bigdl_tpu.utils.torch_file import LongStorage, TorchObject
+
+# torch classes with no constructor arguments worth preserving
+_PARAM_FREE = {
+    "nn.Tanh": nn.Tanh, "nn.Sigmoid": nn.Sigmoid,
+    "nn.LogSoftMax": nn.LogSoftMax, "nn.SoftMax": nn.SoftMax,
+    "nn.SoftPlus": nn.SoftPlus, "nn.SoftSign": nn.SoftSign,
+    "nn.Identity": nn.Identity, "nn.Abs": nn.Abs, "nn.Exp": nn.Exp,
+    "nn.Square": nn.Square, "nn.Sqrt": nn.Sqrt,
+    "nn.CAddTable": nn.CAddTable, "nn.FlattenTable": nn.FlattenTable,
+    "nn.LogSigmoid": nn.LogSigmoid, "nn.TanhShrink": nn.TanhShrink,
+}
+
+
+def _f32(v) -> np.ndarray:
+    return np.asarray(v, dtype=np.float32)
+
+
+def _children(payload: Dict) -> List[Any]:
+    mods = payload.get("modules") or {}
+    if isinstance(mods, dict):
+        return [mods[k] for k in sorted(mods)]
+    return list(mods)
+
+
+def to_module(obj: TorchObject) -> nn.Module:
+    """Convert a torch7 nn.* object tree into a Module
+    (reference ``TorchFile.readModule``, ``TorchFile.scala:142``)."""
+    cls, p = obj.torch_class, obj.payload
+    if cls in _PARAM_FREE:
+        return _PARAM_FREE[cls]()
+    if cls == "nn.Sequential":
+        seq = nn.Sequential()
+        for c in _children(p):
+            seq.add(to_module(c))
+        return seq
+    if cls == "nn.Concat":
+        cat = nn.Concat(int(p["dimension"]))
+        for c in _children(p):
+            cat.add(to_module(c))
+        return cat
+    if cls == "nn.ConcatTable":
+        ct = nn.ConcatTable()
+        for c in _children(p):
+            ct.add(to_module(c))
+        return ct
+    if cls == "nn.Linear":
+        w = _f32(p["weight"])                       # (out, in)
+        b = p.get("bias")
+        return nn.Linear(w.shape[1], w.shape[0], with_bias=b is not None,
+                         init_weight=np.ascontiguousarray(w.T),
+                         init_bias=None if b is None else _f32(b).ravel())
+    if cls in ("nn.SpatialConvolution", "nn.SpatialConvolutionMM"):
+        n_in = int(p["nInputPlane"])
+        n_out = int(p["nOutputPlane"])
+        kw, kh = int(p["kW"]), int(p["kH"])
+        w = _f32(p["weight"]).reshape(n_out, n_in, kh, kw)  # OIHW (2-D view ok)
+        b = p.get("bias")
+        return nn.SpatialConvolution(
+            n_in, n_out, kw, kh, int(p["dW"]), int(p["dH"]),
+            int(p.get("padW", 0)), int(p.get("padH", 0)),
+            with_bias=b is not None,
+            init_weight=np.transpose(w, (2, 3, 1, 0)),      # -> HWIO
+            init_bias=None if b is None else _f32(b).ravel())
+    if cls == "nn.SpatialMaxPooling":
+        m = nn.SpatialMaxPooling(int(p["kW"]), int(p["kH"]),
+                                 int(p["dW"]), int(p["dH"]),
+                                 int(p.get("padW", 0)), int(p.get("padH", 0)))
+        return m.ceil() if p.get("ceil_mode") else m
+    if cls == "nn.SpatialAveragePooling":
+        m = nn.SpatialAveragePooling(
+            int(p["kW"]), int(p["kH"]), int(p["dW"]), int(p["dH"]),
+            int(p.get("padW", 0)), int(p.get("padH", 0)),
+            ceil_mode=bool(p.get("ceil_mode")),
+            count_include_pad=bool(p.get("count_include_pad", True)))
+        return m
+    if cls in ("nn.BatchNormalization", "nn.SpatialBatchNormalization"):
+        mean = _f32(p["running_mean"]).ravel()
+        var = _f32(p["running_var"]).ravel()
+        affine = bool(p.get("affine", p.get("weight") is not None))
+        klass = (nn.SpatialBatchNormalization
+                 if cls == "nn.SpatialBatchNormalization"
+                 else nn.BatchNormalization)
+        bn = klass(mean.shape[0], eps=float(p.get("eps", 1e-5)),
+                   momentum=float(p.get("momentum", 0.1)), affine=affine,
+                   init_weight=None if not affine else _f32(p["weight"]).ravel(),
+                   init_bias=None if not affine else _f32(p["bias"]).ravel())
+        bn._ensure_init()
+        bn.state = {"running_mean": mean, "running_var": var}
+        return bn
+    if cls == "nn.ReLU":
+        return nn.ReLU()
+    if cls == "nn.ReLU6":
+        return nn.ReLU6()        # torch implements it as HardTanh(0, 6)
+    if cls == "nn.HardTanh":
+        return nn.HardTanh(float(p.get("min_val", -1.0)),
+                           float(p.get("max_val", 1.0)))
+    if cls == "nn.Threshold":
+        return nn.Threshold(float(p.get("threshold", 1e-6)),
+                            float(p.get("val", 0.0)))
+    if cls == "nn.Dropout":
+        return nn.Dropout(float(p.get("p", 0.5)))
+    if cls == "nn.View":
+        v = nn.View(*(int(s) for s in np.asarray(p["size"]).ravel()))
+        if p.get("numInputDims"):
+            v.set_num_input_dims(int(p["numInputDims"]))
+        return v
+    if cls == "nn.Reshape":
+        return nn.Reshape([int(s) for s in np.asarray(p["size"]).ravel()],
+                          batch_mode=p.get("batchMode"))
+    if cls == "nn.SpatialZeroPadding":
+        return nn.SpatialZeroPadding(int(p["pad_l"]), int(p["pad_r"]),
+                                     int(p["pad_t"]), int(p["pad_b"]))
+    raise ValueError(f"unsupported torch module class {cls}")
+
+
+# ---------------------------------------------------------------------------
+# export
+# ---------------------------------------------------------------------------
+
+_EMPTY = np.zeros((0,), dtype=np.float32)
+
+
+def _general(table: Dict, dtype: str = "torch.FloatTensor") -> Dict:
+    """(reference ``writeGeneralParameters``, ``TorchFile.scala:450``)."""
+    table["gradInput"] = _EMPTY
+    table["output"] = _EMPTY
+    table["_type"] = dtype
+    return table
+
+
+def from_module(m: nn.Module) -> TorchObject:
+    """Convert a Module tree to torch7 nn.* objects
+    (reference ``TorchFile.writeObject`` module cases)."""
+    for cls, klass in _PARAM_FREE.items():
+        if type(m) is klass:
+            return TorchObject(cls, _general({}))
+    if type(m) is nn.ReLU:
+        return TorchObject("nn.ReLU", _general({"inplace": False}))
+    if type(m) is nn.ReLU6:
+        # torch7 ReLU6 extends HardTanh(0, 6): fields must be present or
+        # stock torch errors on nil min_val at run time
+        return TorchObject("nn.ReLU6", _general(
+            {"min_val": 0.0, "max_val": 6.0, "inplace": False}))
+    if type(m) in (nn.HardTanh, nn.Clamp):
+        return TorchObject("nn.HardTanh", _general(
+            {"min_val": float(m.min_value), "max_val": float(m.max_value),
+             "inplace": False}))
+    if isinstance(m, nn.Sequential):
+        mods = {i + 1: from_module(c) for i, c in enumerate(m.children)}
+        return TorchObject("nn.Sequential", _general({"modules": mods}))
+    if isinstance(m, nn.ConcatTable):
+        mods = {i + 1: from_module(c) for i, c in enumerate(m.children)}
+        return TorchObject("nn.ConcatTable", _general({"modules": mods}))
+    if isinstance(m, nn.Concat):
+        mods = {i + 1: from_module(c) for i, c in enumerate(m.children)}
+        return TorchObject("nn.Concat", _general(
+            {"modules": mods, "dimension": float(m.dimension)}))
+    m._ensure_init()
+    p = m.params if m._params is not None else {}
+    if getattr(m, "format", "NCHW") != "NCHW" or \
+            getattr(m, "channel_axis", 1) not in (1,):
+        # TF-imported NHWC convs/BNs/poolings have no torch representation
+        raise ValueError(f"cannot export NHWC-format "
+                         f"{type(m).__name__} to torch (NCHW only)")
+    if isinstance(m, nn.SpatialConvolution):
+        if m.n_group != 1:
+            raise ValueError("nGroup is not supported in torch")
+        w = np.transpose(_f32(p["weight"]), (3, 2, 0, 1))   # HWIO -> OIHW
+        t = _general({
+            "nInputPlane": float(m.n_input_plane),
+            "nOutputPlane": float(m.n_output_plane),
+            "kW": float(m.kernel_w), "kH": float(m.kernel_h),
+            "dW": float(m.stride_w), "dH": float(m.stride_h),
+            "padW": float(m.pad_w), "padH": float(m.pad_h),
+            # the reference writer views weight 2-D (TorchFile.scala:482)
+            "weight": w.reshape(m.n_output_plane, -1),
+            "gradWeight": np.zeros_like(w).reshape(m.n_output_plane, -1),
+            "fInput": _EMPTY, "fGradInput": _EMPTY,
+        })
+        if m.with_bias:
+            t["bias"] = _f32(p["bias"])
+            t["gradBias"] = np.zeros_like(t["bias"])
+        return TorchObject("nn.SpatialConvolution", t)
+    if isinstance(m, nn.Linear):
+        t = _general({"weight": _f32(p["weight"]).T,        # -> (out, in)
+                      "gradWeight": np.zeros(
+                          (m.output_size, m.input_size), np.float32)})
+        if m.with_bias:
+            t["bias"] = _f32(p["bias"])
+            t["gradBias"] = np.zeros_like(t["bias"])
+        return TorchObject("nn.Linear", t)
+    if isinstance(m, nn.SpatialMaxPooling):
+        return TorchObject("nn.SpatialMaxPooling", _general({
+            "kW": float(m.kw), "kH": float(m.kh),
+            "dW": float(m.dw), "dH": float(m.dh),
+            "padW": float(m.pad_w), "padH": float(m.pad_h),
+            "ceil_mode": bool(m.ceil_mode), "indices": _EMPTY}))
+    if isinstance(m, nn.SpatialAveragePooling):
+        return TorchObject("nn.SpatialAveragePooling", _general({
+            "kW": float(m.kw), "kH": float(m.kh),
+            "dW": float(m.dw), "dH": float(m.dh),
+            "padW": float(m.pad_w), "padH": float(m.pad_h),
+            "ceil_mode": bool(m.ceil_mode),
+            "count_include_pad": bool(m.count_include_pad),
+            "divide": True}))
+    if isinstance(m, nn.BatchNormalization):   # covers Spatial subclass
+        s = m.state
+        t = _general({"running_mean": _f32(s["running_mean"]),
+                      "running_var": _f32(s["running_var"]),
+                      "eps": float(m.eps), "momentum": float(m.momentum),
+                      "affine": bool(m.affine)})
+        if m.affine:
+            t["weight"] = _f32(p["weight"])
+            t["bias"] = _f32(p["bias"])
+            t["gradWeight"] = np.zeros_like(t["weight"])
+            t["gradBias"] = np.zeros_like(t["bias"])
+        cls = ("nn.SpatialBatchNormalization"
+               if isinstance(m, nn.SpatialBatchNormalization)
+               else "nn.BatchNormalization")
+        return TorchObject(cls, t)
+    if isinstance(m, nn.Threshold):
+        return TorchObject("nn.Threshold", _general(
+            {"threshold": float(m.th), "val": float(m.v), "inplace": False}))
+    if isinstance(m, nn.Dropout):
+        return TorchObject("nn.Dropout", _general(
+            {"p": float(m.p), "noise": _EMPTY, "v2": True}))
+    if isinstance(m, nn.View):
+        t = _general({"size": LongStorage(m.sizes),
+                      "numElements": float(np.prod(m.sizes))})
+        if m.num_input_dims:
+            t["numInputDims"] = float(m.num_input_dims)
+        return TorchObject("nn.View", t)
+    if isinstance(m, nn.Reshape):
+        return TorchObject("nn.Reshape", _general(
+            {"size": LongStorage(m.size),
+             "nelement": float(np.prod(m.size)),
+             "batchMode": m.batch_mode}))
+    if isinstance(m, nn.SpatialZeroPadding):
+        return TorchObject("nn.SpatialZeroPadding", _general(
+            {"pad_l": float(m.pl), "pad_r": float(m.pr),
+             "pad_t": float(m.pt), "pad_b": float(m.pb)}))
+    raise ValueError(f"cannot export {type(m).__name__} to torch")
+
+
+def load_model(path: str) -> nn.Module:
+    """Load a ``.t7`` file containing a torch7 nn module tree
+    (reference ``Module.loadTorch`` → ``TorchFile.loadModule``)."""
+    obj = torch_file.load(path)
+    if not isinstance(obj, TorchObject):
+        raise ValueError(f"{path} does not contain a torch module "
+                         f"(got {type(obj).__name__})")
+    return to_module(obj)
+
+
+def save_model(path: str, model: nn.Module) -> None:
+    """Save a Module tree as a torch7-readable ``.t7``
+    (reference ``AbstractModule.saveTorch`` → ``TorchFile.saveModule``)."""
+    torch_file.save(path, from_module(model))

@@ -1,0 +1,96 @@
+"""Examples-package tests (reference ``example/`` tree analogs)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.utils import file_io
+
+
+def _tiny_classifier(n_classes=3):
+    m = (nn.Sequential()
+         .add(nn.Reshape([3 * 8 * 8], batch_mode=True))
+         .add(nn.Linear(192, n_classes))
+         .add(nn.LogSoftMax()))
+    m._ensure_init()
+    return m
+
+
+def _write_image_tree(root, classes=3, per_class=2):
+    PIL = pytest.importorskip("PIL.Image")
+    rng = np.random.RandomState(0)
+    paths = []
+    for c in range(classes):
+        d = os.path.join(root, f"class{c}")
+        os.makedirs(d, exist_ok=True)
+        for i in range(per_class):
+            arr = rng.randint(0, 255, size=(8, 8, 3), dtype=np.uint8)
+            p = os.path.join(d, f"img{i}.png")
+            PIL.fromarray(arr).save(p)
+            paths.append(p)
+    return paths
+
+
+def test_model_validator_cli(tmp_path, capsys):
+    from bigdl_tpu.examples import model_validator
+    _write_image_tree(str(tmp_path / "val"))
+    model_path = str(tmp_path / "model.snapshot")
+    file_io.save(_tiny_classifier(), model_path)
+
+    results = model_validator.main([
+        "-f", str(tmp_path / "val"), "-t", "bigdl",
+        "--modelPath", model_path, "-b", "2", "--crop", "8"])
+    out = capsys.readouterr().out
+    assert "Top1Accuracy" in out and "Top5Accuracy" in out
+    top1 = results[0][1].final_result()
+    assert 0.0 <= top1 <= 1.0
+
+
+def test_model_validator_unknown_type(tmp_path):
+    from bigdl_tpu.examples.model_validator import load_model
+    with pytest.raises(SystemExit, match="caffeDefPath"):
+        load_model("caffe", "whatever.caffemodel")
+
+
+def test_image_predictor_cli(tmp_path, capsys):
+    from bigdl_tpu.examples import image_predictor
+    paths = _write_image_tree(str(tmp_path / "imgs"), classes=1, per_class=3)
+    model_path = str(tmp_path / "model.snapshot")
+    file_io.save(_tiny_classifier(), model_path)
+
+    out = image_predictor.main([
+        "-f", str(tmp_path / "imgs"), "--modelPath", model_path,
+        "--crop", "8", "--topN", "2"])
+    assert len(out) == len(paths)
+    printed = capsys.readouterr().out
+    assert all(os.path.basename(p) in printed for p in paths)
+
+
+def test_udf_predictor_callable(tmp_path):
+    from bigdl_tpu.examples.udf_predictor import make_udf
+    dim, seq_len, classes = 4, 6, 2
+    model = (nn.Sequential()
+             .add(nn.Reshape([seq_len * dim], batch_mode=True))
+             .add(nn.Linear(seq_len * dim, classes))
+             .add(nn.LogSoftMax()))
+    model._ensure_init()
+    vectors = {"good": np.ones(dim, np.float32),
+               "bad": -np.ones(dim, np.float32)}
+    udf = make_udf(model, vectors, seq_len=seq_len, batch_size=2)
+    labels = udf(["good good good", "bad bad", "unseen words only"])
+    assert len(labels) == 3
+    assert all(1 <= l <= classes for l in labels)
+    # single-string convenience
+    assert udf("good")[0] in (1, 2)
+    # empty input: plain empty result, not a numpy crash
+    assert udf([]) == []
+
+
+def test_tensorflow_interop_save_demo(tmp_path):
+    pytest.importorskip("tensorflow")
+    from bigdl_tpu.examples import tensorflow_interop
+    out = str(tmp_path / "model.pb")
+    tensorflow_interop.main(["save", "--out", out])
+    assert os.path.getsize(out) > 0

@@ -49,6 +49,125 @@ class TestTorchFile:
         assert torch_file.load(p) == [1, 2, 3]
 
 
+class TestTorchModule:
+    """Module-tree .t7 interop (reference ``TorchFile.loadModule`` /
+    ``saveModule``, ``TorchFile.scala:142,262``)."""
+
+    def _lenet_ish(self):
+        m = (nn.Sequential()
+             .add(nn.Reshape([1, 12, 12]))
+             .add(nn.SpatialConvolution(1, 4, 5, 5))
+             .add(nn.Tanh())
+             .add(nn.SpatialMaxPooling(2, 2, 2, 2))
+             .add(nn.SpatialBatchNormalization(4))
+             .add(nn.Reshape([4 * 4 * 4]))
+             .add(nn.Linear(64, 10))
+             .add(nn.LogSoftMax()))
+        m._ensure_init()
+        return m
+
+    def test_module_roundtrip_forward_parity(self, tmp_path):
+        from bigdl_tpu.utils import torch_module
+        p = str(tmp_path / "m.t7")
+        model = self._lenet_ish()
+        model.evaluate()
+        x = np.random.RandomState(0).normal(size=(3, 144)).astype(np.float32)
+        want = np.asarray(model.forward(x))
+
+        torch_module.save_model(p, model)
+        back = torch_module.load_model(p)
+        back.evaluate()
+        got = np.asarray(back.forward(x))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_serialized_shape_is_torch_convention(self, tmp_path):
+        from bigdl_tpu.utils import torch_module
+        p = str(tmp_path / "m.t7")
+        lin = nn.Linear(3, 5)
+        lin._ensure_init()
+        torch_module.save_model(p, lin)
+        raw = torch_file.load(p)
+        assert raw.torch_class == "nn.Linear"
+        # torch stores (out, in); our native layout is (in, out)
+        assert raw.payload["weight"].shape == (5, 3)
+        assert raw.payload["_type"] == "torch.FloatTensor"
+
+    def test_conv_weight_2d_view_like_reference_writer(self, tmp_path):
+        from bigdl_tpu.utils import torch_module
+        p = str(tmp_path / "m.t7")
+        conv = nn.SpatialConvolution(2, 3, 4, 5)   # kw=4, kh=5
+        conv._ensure_init()
+        torch_module.save_model(p, conv)
+        raw = torch_file.load(p)
+        # reference writer views OIHW 2-D as (nOut, nIn*kH*kW)
+        assert raw.payload["weight"].shape == (3, 2 * 5 * 4)
+        back = torch_module.load_model(p)
+        x = np.random.RandomState(1).normal(size=(2, 2, 9, 8)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(back.forward(x)),
+                                   np.asarray(conv.forward(x)),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_containers_and_bn_state(self, tmp_path):
+        from bigdl_tpu.utils import torch_module
+        p = str(tmp_path / "m.t7")
+        bn = nn.BatchNormalization(4)
+        bn._ensure_init()
+        bn.state = {"running_mean": np.arange(4, dtype=np.float32),
+                    "running_var": np.arange(1, 5, dtype=np.float32)}
+        model = (nn.Sequential()
+                 .add(nn.ConcatTable().add(nn.Identity()).add(nn.Identity()))
+                 .add(nn.CAddTable())
+                 .add(bn))
+        model._ensure_init()
+        model.evaluate()
+        torch_module.save_model(p, model)
+        back = torch_module.load_model(p)
+        back.evaluate()
+        x = np.random.RandomState(2).normal(size=(5, 4)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(back.forward(x)),
+                                   np.asarray(model.forward(x)),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_unsupported_class_reports_name(self, tmp_path):
+        from bigdl_tpu.utils import torch_module
+        with pytest.raises(ValueError, match="nn.ExoticLayer"):
+            torch_module.to_module(
+                torch_file.TorchObject("nn.ExoticLayer", {}))
+
+    def test_hardtanh_bounds_and_view_dims_roundtrip(self, tmp_path):
+        from bigdl_tpu.utils import torch_module
+        p = str(tmp_path / "m.t7")
+        model = (nn.Sequential()
+                 .add(nn.HardTanh(0.0, 20.0))
+                 .add(nn.ReLU6())
+                 .add(nn.View(-1).set_num_input_dims(2)))
+        model._ensure_init()
+        torch_module.save_model(p, model)
+        raw = torch_file.load(p)
+        # the reader lowers a 1..N-keyed lua table to a python list
+        ht = raw.payload["modules"][0].payload
+        assert ht["min_val"] == 0.0 and ht["max_val"] == 20.0
+        r6 = raw.payload["modules"][1].payload
+        assert r6["min_val"] == 0.0 and r6["max_val"] == 6.0
+        back = torch_module.load_model(p)
+        x = np.random.RandomState(3).normal(
+            0, 10, size=(4, 3, 5)).astype(np.float32)
+        got = np.asarray(back.forward(x))
+        assert got.shape == (4, 15)      # numInputDims=2 keeps the batch dim
+        np.testing.assert_allclose(got, np.asarray(model.forward(x)))
+
+    def test_nhwc_modules_refuse_torch_export(self):
+        from bigdl_tpu.utils import torch_module
+        conv = nn.SpatialConvolution(2, 3, 3, 3, format="NHWC")
+        conv._ensure_init()
+        with pytest.raises(ValueError, match="NHWC"):
+            torch_module.from_module(conv)
+        bn = nn.SpatialBatchNormalization(4, format="NHWC")
+        bn._ensure_init()
+        with pytest.raises(ValueError, match="NHWC"):
+            torch_module.from_module(bn)
+
+
 def _run_tf(graph_def, feed_name, x, out_name):
     tf.compat.v1.reset_default_graph()
     with tf.compat.v1.Session() as sess:
