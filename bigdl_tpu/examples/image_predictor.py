@@ -46,12 +46,15 @@ def main(argv=None):
     p.add_argument("-t", "--model-type", default="bigdl",
                    choices=["bigdl", "caffe", "torch", "tf"])
     p.add_argument("--caffeDefPath")
+    p.add_argument("--inputs", nargs="*", help="tf graph input node names")
+    p.add_argument("--outputs", nargs="*", help="tf graph output node names")
     p.add_argument("-b", "--batch-size", type=int, default=32)
     p.add_argument("--crop", type=int, default=224)
     p.add_argument("--topN", type=int, default=1)
     args = p.parse_args(argv)
 
-    model = load_model(args.model_type, args.modelPath, args.caffeDefPath)
+    model = load_model(args.model_type, args.modelPath, args.caffeDefPath,
+                       args.inputs, args.outputs)
     model.evaluate()
     paths, samples = image_samples(args.folder, crop=args.crop)
     if not samples:

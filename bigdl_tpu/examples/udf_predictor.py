@@ -29,6 +29,10 @@ def make_udf(model, word_vectors, seq_len: int = 1000,
     with pretrained vectors, batch through the model."""
     model.evaluate()
     tok = SentenceTokenizer()
+    if not word_vectors:
+        raise ValueError("word_vectors is empty — wrong --dim for the "
+                         "GloVe file? (lines with a different dimension "
+                         "are skipped)")
     dim = len(next(iter(word_vectors.values())))
     predictor = Predictor(model)
 

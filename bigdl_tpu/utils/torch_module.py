@@ -248,15 +248,16 @@ def from_module(m: nn.Module) -> TorchObject:
         return TorchObject("nn.Dropout", _general(
             {"p": float(m.p), "noise": _EMPTY, "v2": True}))
     if isinstance(m, nn.View):
-        t = _general({"size": LongStorage(m.sizes),
-                      "numElements": float(np.prod(m.sizes))})
+        # torch7 View:__init__ excludes inferred (-1) dims from numElements
+        n_elem = float(np.prod([s for s in m.sizes if s >= 0]))
+        t = _general({"size": LongStorage(m.sizes), "numElements": n_elem})
         if m.num_input_dims:
             t["numInputDims"] = float(m.num_input_dims)
         return TorchObject("nn.View", t)
     if isinstance(m, nn.Reshape):
         return TorchObject("nn.Reshape", _general(
             {"size": LongStorage(m.size),
-             "nelement": float(np.prod(m.size)),
+             "nelement": float(np.prod([s for s in m.size if s >= 0])),
              "batchMode": m.batch_mode}))
     if isinstance(m, nn.SpatialZeroPadding):
         return TorchObject("nn.SpatialZeroPadding", _general(

@@ -86,6 +86,9 @@ def test_udf_predictor_callable(tmp_path):
     assert udf("good")[0] in (1, 2)
     # empty input: plain empty result, not a numpy crash
     assert udf([]) == []
+    # empty vectors (e.g. --dim mismatch): clear error, not StopIteration
+    with pytest.raises(ValueError, match="dim"):
+        make_udf(model, {}, seq_len=seq_len)
 
 
 def test_tensorflow_interop_save_demo(tmp_path):

@@ -156,6 +156,15 @@ class TestTorchModule:
         assert got.shape == (4, 15)      # numInputDims=2 keeps the batch dim
         np.testing.assert_allclose(got, np.asarray(model.forward(x)))
 
+    def test_view_num_elements_excludes_inferred_dim(self):
+        from bigdl_tpu.utils import torch_module
+        obj = torch_module.from_module(nn.View(-1, 6))
+        # torch7 divides input element count by numElements to infer the
+        # batch; including -1 would make that negative
+        assert obj.payload["numElements"] == 6.0
+        obj = torch_module.from_module(nn.Reshape([-1, 4]))
+        assert obj.payload["nelement"] == 4.0
+
     def test_nhwc_modules_refuse_torch_export(self):
         from bigdl_tpu.utils import torch_module
         conv = nn.SpatialConvolution(2, 3, 3, 3, format="NHWC")
