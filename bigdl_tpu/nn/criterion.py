@@ -96,8 +96,9 @@ class DistKLDivCriterion(Criterion):
         l = jnp.where(target > 0, target * (jnp.log(jnp.maximum(target, 1e-30))
                                             - input), 0.0)
         s = jnp.sum(l)
-        n = input.shape[0] if input.ndim > 1 else 1
-        return s / n if self.size_average else s
+        # sizeAverage divides by element count, not batch
+        # (reference DistKLDivCriterion.scala: sum / input.nElement())
+        return s / input.size if self.size_average else s
 
 
 class CosineEmbeddingCriterion(Criterion):

@@ -255,9 +255,14 @@ def from_module(m: nn.Module) -> TorchObject:
             t["numInputDims"] = float(m.num_input_dims)
         return TorchObject("nn.View", t)
     if isinstance(m, nn.Reshape):
+        if any(s < 0 for s in m.size):
+            # torch7 Reshape has no inferred-dim support; its nelement
+            # check would silently mis-branch on a negative product
+            raise ValueError("cannot export Reshape with an inferred (-1) "
+                             "dim to torch (use View instead)")
         return TorchObject("nn.Reshape", _general(
             {"size": LongStorage(m.size),
-             "nelement": float(np.prod([s for s in m.size if s >= 0])),
+             "nelement": float(np.prod(m.size)),
              "batchMode": m.batch_mode}))
     if isinstance(m, nn.SpatialZeroPadding):
         return TorchObject("nn.SpatialZeroPadding", _general(

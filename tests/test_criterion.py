@@ -76,7 +76,7 @@ class TestRegression:
         logq = jnp.log(jnp.asarray([[0.5, 0.5]]))
         p = jnp.asarray([[0.75, 0.25]])
         exp = (0.75 * (np.log(0.75) - np.log(0.5))
-               + 0.25 * (np.log(0.25) - np.log(0.5)))
+               + 0.25 * (np.log(0.25) - np.log(0.5))) / 2  # / nElement
         np.testing.assert_allclose(
             float(nn.DistKLDivCriterion().forward(logq, p)), exp, rtol=1e-3)
 

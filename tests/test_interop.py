@@ -162,8 +162,11 @@ class TestTorchModule:
         # torch7 divides input element count by numElements to infer the
         # batch; including -1 would make that negative
         assert obj.payload["numElements"] == 6.0
-        obj = torch_module.from_module(nn.Reshape([-1, 4]))
-        assert obj.payload["nelement"] == 4.0
+        # torch7 Reshape cannot represent an inferred dim at all
+        with pytest.raises(ValueError, match="View instead"):
+            torch_module.from_module(nn.Reshape([-1, 4]))
+        obj = torch_module.from_module(nn.Reshape([2, 4]))
+        assert obj.payload["nelement"] == 8.0
 
     def test_nhwc_modules_refuse_torch_export(self):
         from bigdl_tpu.utils import torch_module

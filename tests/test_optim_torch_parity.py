@@ -1,0 +1,80 @@
+"""Optimizer trajectory parity against torch.optim.
+
+The reference's optim methods are torch-optim ports tested against torch
+(``optim/SGDSpec`` etc. via the TH harness); here each method runs the same
+deterministic gradient sequence as its torch.optim twin and the parameter
+trajectories must agree step for step.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import bigdl_tpu.optim as optim  # noqa: E402
+
+N_STEPS = 12
+DIM = 10
+
+
+def _problem():
+    """Deterministic quadratic: grad(p) = A p - b."""
+    rng = np.random.RandomState(0)
+    q = rng.normal(size=(DIM, DIM)).astype(np.float64)
+    a = (q @ q.T / DIM + np.eye(DIM)).astype(np.float32)
+    b = rng.normal(size=DIM).astype(np.float32)
+    p0 = rng.normal(size=DIM).astype(np.float32)
+    return a, b, p0
+
+
+def _run_ours(method, a, b, p0, steps=N_STEPS):
+    p = np.array(p0)
+    traj = []
+    for _ in range(steps):
+        g = a @ p - b
+        p = np.asarray(method.update(g.astype(np.float32), p))
+        traj.append(p.copy())
+    return np.stack(traj)
+
+
+def _run_torch(opt_cls, kwargs, a, b, p0, steps=N_STEPS):
+    p = torch.from_numpy(np.array(p0)).requires_grad_(True)
+    opt = opt_cls([p], **kwargs)
+    ta = torch.from_numpy(a)
+    tb = torch.from_numpy(b)
+    traj = []
+    for _ in range(steps):
+        opt.zero_grad()
+        p.grad = ta @ p.detach() - tb
+        opt.step()
+        traj.append(p.detach().numpy().copy())
+    return np.stack(traj)
+
+
+@pytest.mark.parametrize("ours,tcls,tkw", [
+    (lambda: optim.SGD(learning_rate=0.05),
+     torch.optim.SGD, dict(lr=0.05)),
+    (lambda: optim.SGD(learning_rate=0.05, momentum=0.9, dampening=0.0),
+     torch.optim.SGD, dict(lr=0.05, momentum=0.9)),
+    (lambda: optim.SGD(learning_rate=0.05, momentum=0.9, nesterov=True),
+     torch.optim.SGD, dict(lr=0.05, momentum=0.9, nesterov=True)),
+    (lambda: optim.SGD(learning_rate=0.05, momentum=0.9, dampening=0.0,
+                       weight_decay=0.01),
+     torch.optim.SGD, dict(lr=0.05, momentum=0.9, weight_decay=0.01)),
+    (lambda: optim.Adam(learning_rate=0.1),
+     torch.optim.Adam, dict(lr=0.1)),
+    (lambda: optim.Adagrad(learning_rate=0.1),
+     torch.optim.Adagrad, dict(lr=0.1, eps=1e-10)),
+    (lambda: optim.Adadelta(decay_rate=0.9, epsilon=1e-6),
+     torch.optim.Adadelta, dict(lr=1.0, rho=0.9, eps=1e-6)),
+    (lambda: optim.RMSprop(learning_rate=0.01, decay_rate=0.99),
+     torch.optim.RMSprop, dict(lr=0.01, alpha=0.99)),
+    (lambda: optim.Adamax(learning_rate=0.02, epsilon=1e-8),
+     torch.optim.Adamax, dict(lr=0.02, eps=1e-8)),
+], ids=["sgd", "sgd-momentum", "sgd-nesterov", "sgd-wd", "adam", "adagrad",
+        "adadelta", "rmsprop", "adamax"])
+def test_trajectory_matches_torch(ours, tcls, tkw):
+    a, b, p0 = _problem()
+    got = _run_ours(ours(), a, b, p0)
+    want = _run_torch(tcls, tkw, a, b, p0)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)

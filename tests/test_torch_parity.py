@@ -207,6 +207,16 @@ class TestLayerParity:
         np.testing.assert_allclose(_np(m.forward(x)), want,
                                    rtol=RTOL, atol=ATOL)
 
+        bm = nn.Bilinear(5, 3, 4)
+        bm._ensure_init()
+        x1 = rng.normal(size=(3, 5)).astype(np.float32)
+        x2 = rng.normal(size=(3, 3)).astype(np.float32)
+        # same (out, in1, in2) weight layout as torch.nn.Bilinear
+        want = F.bilinear(_t(x1), _t(x2), _t(bm.params["weight"]),
+                          _t(bm.params["bias"])).numpy()
+        np.testing.assert_allclose(_np(bm.forward([x1, x2])), want,
+                                   rtol=RTOL, atol=ATOL)
+
     def test_lookup_table_is_one_based_embedding(self):
         rng = np.random.RandomState(13)
         m = nn.LookupTable(10, 4)
@@ -280,9 +290,12 @@ class TestCriterionParity:
             (nn.SmoothL1Criterion(), x, y, F.smooth_l1_loss(tx, ty)),
             (nn.BCECriterion(), sig, ysig,
              F.binary_cross_entropy(torch.sigmoid(tx), _t(ysig))),
+            # sizeAverage divides by nElement (reference
+            # DistKLDivCriterion.scala); sum/numel avoids torch's
+            # deprecated reduction="mean" semantics
             (nn.DistKLDivCriterion(), np.log(sig), ysig,
              F.kl_div(torch.log(torch.sigmoid(tx)), _t(ysig),
-                      reduction="batchmean")),
+                      reduction="sum") / tx.numel()),
             (nn.SoftMarginCriterion(), x, np.sign(y) + (y == 0),
              F.soft_margin_loss(tx, torch.sign(ty) + (ty == 0).float())),
         ]
