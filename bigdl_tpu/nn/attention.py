@@ -22,6 +22,16 @@ from bigdl_tpu.nn import init as init_methods
 from bigdl_tpu.nn.module import Module
 
 
+def _axis_bound(name: str) -> bool:
+    """Trace-time check: is the named mesh axis currently bound (are we
+    inside a shard_map/pmap over it)?"""
+    try:
+        jax.lax.axis_index(name)
+        return True
+    except Exception:
+        return False
+
+
 def scaled_dot_product_attention(q: jnp.ndarray, k: jnp.ndarray,
                                  v: jnp.ndarray,
                                  causal: bool = False,
@@ -69,6 +79,20 @@ class MultiHeadAttention(Module):
         self.causal = causal
         self.with_bias = with_bias
         self.flash = flash
+        # mesh-axis name for ring-attention sequence parallelism; the ring
+        # path engages only while that named axis is bound (i.e. inside a
+        # shard_map over the mesh's seq axis — DistriOptimizer sets this
+        # for sequence-parallel training); plain forwards are unaffected
+        self.sequence_parallel: Optional[str] = None
+
+    def set_sequence_parallel(self, axis_name: Optional[str]
+                              ) -> "MultiHeadAttention":
+        if axis_name and self.flash:
+            raise ValueError("flash kernel and ring sequence parallelism "
+                             "are mutually exclusive")
+        self.sequence_parallel = axis_name
+        self._jit_apply = None
+        return self
 
     def _flash_ok(self, q, k) -> bool:
         """Static (trace-time) eligibility for the pallas kernel.  Only
@@ -118,7 +142,16 @@ class MultiHeadAttention(Module):
         q = self._project(params, q_src, "wq", "bq")
         k = self._project(params, kv_src, "wk", "bk")
         v = self._project(params, kv_src, "wv", "bv")
-        if self._flash_ok(q, k):
+        if self.sequence_parallel and _axis_bound(self.sequence_parallel):
+            if q_src is not kv_src:
+                raise ValueError("sequence-parallel MHA is self-attention "
+                                 "only (q and kv must be the same source)")
+            from bigdl_tpu.parallel.ring_attention import (
+                _ring_attention_shard)
+            out = _ring_attention_shard(q, k, v,
+                                        axis_name=self.sequence_parallel,
+                                        causal=self.causal)
+        elif self._flash_ok(q, k):
             from jax.experimental.pallas.ops.tpu.flash_attention import (
                 flash_attention)
             out = flash_attention(

@@ -87,12 +87,19 @@ class DistriOptimizer(Optimizer):
 
     # ---- the fused sharded step ----------------------------------------
 
+    @property
+    def seq_axis(self) -> Optional[str]:
+        """Sequence-parallel axis: present when the mesh declares a ``seq``
+        dimension (the long-context dp x sp layout)."""
+        return "seq" if "seq" in self.mesh.shape else None
+
     def _build_step(self, arp: AllReduceParameter):
         from bigdl_tpu.parallel.all_reduce import shard_map
 
         model, criterion, optim = self.model, self.criterion, self.optim_method
         mesh, axis = self.mesh, "data"
-        n = mesh.shape[axis]
+        seq_axis = self.seq_axis
+        n = mesh.shape[axis] * (mesh.shape[seq_axis] if seq_axis else 1)
 
         precision = self.precision
 
@@ -100,6 +107,8 @@ class DistriOptimizer(Optimizer):
             # distinct dropout masks per shard, like the reference's
             # independently-seeded model replicas
             rng = jax.random.fold_in(rng, lax.axis_index(axis))
+            if seq_axis:
+                rng = jax.random.fold_in(rng, lax.axis_index(seq_axis))
 
             def loss_fn(flat):
                 p = arp.unflatten(flat)
@@ -112,6 +121,11 @@ class DistriOptimizer(Optimizer):
             (loss, new_mstate), flat_grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(flat_params)
 
+            if seq_axis:
+                # sequence shards each saw a chunk of every sequence: their
+                # gradient contributions sum (ring attention's backward is
+                # already chunk-local)
+                flat_grads = lax.psum(flat_grads, seq_axis)
             # reduce-scatter: own gradient slice, summed over shards
             grad_shard = arp.reduce_scatter_gradients(flat_grads, axis) / n
             # ZeRO-1: update only this device's parameter slice + slots
@@ -123,18 +137,25 @@ class DistriOptimizer(Optimizer):
 
             loss = lax.pmean(loss, axis)
             new_mstate = _pmean_float(new_mstate, axis)
+            if seq_axis:
+                loss = lax.pmean(loss, seq_axis)
+                new_mstate = _pmean_float(new_mstate, seq_axis)
             return new_flat, new_slots, new_mstate, loss
 
         pspec_rep = P()
-        pspec_batch = P(axis)
+        # batch over data; with a seq axis, time (dim 1) over seq
+        pspec_batch = P(axis, seq_axis) if seq_axis else P(axis)
+        # slots are sharded over the data axis only (ZeRO-1); replicated
+        # across seq shards
+        pspec_slots = P(axis)
         sharded = shard_map(
             shard_step, mesh=mesh,
             in_specs=(pspec_rep,                          # flat params
-                      P(axis),                            # slot shards
+                      pspec_slots,                        # slot shards
                       pspec_rep,                          # module state
                       pspec_batch, pspec_batch,           # inputs, targets
                       pspec_rep, pspec_rep),              # hyper, rng
-            out_specs=(pspec_rep, P(axis), pspec_rep, pspec_rep),
+            out_specs=(pspec_rep, pspec_slots, pspec_rep, pspec_rep),
             check_rep=False)
         return jax.jit(sharded, donate_argnums=(0, 1, 2))
 
@@ -151,6 +172,8 @@ class DistriOptimizer(Optimizer):
 
         model.training()
         model._ensure_init()
+        if self.seq_axis:
+            self._wire_sequence_parallel(model)
 
         arp = AllReduceParameter(model.params, axis_size, self.compression)
         self._arp = arp
@@ -167,7 +190,23 @@ class DistriOptimizer(Optimizer):
         if self._step_fn is None:
             self._step_fn = self._build_step(arp)
 
-        batch_sharding = NamedSharding(mesh, P("data"))
+        if self.seq_axis:
+            # time (dim 1) sharded over seq: per-timestep targets required
+            batch_sharding = NamedSharding(mesh, P("data", "seq"))
+            seq_size = mesh.shape["seq"]
+
+            def _check(x):
+                x = np.asarray(x)
+                if x.ndim < 2 or x.shape[1] % seq_size != 0:
+                    raise ValueError(
+                        "sequence-parallel training needs (N, T, ...) inputs "
+                        "and (N, T, ...) per-timestep targets with T "
+                        f"divisible by the seq axis size {seq_size} "
+                        f"(got shape {x.shape})")
+                return x
+        else:
+            batch_sharding = NamedSharding(mesh, P("data"))
+            _check = None
         it = {"shards": None}
 
         def reset_epoch():
@@ -176,7 +215,7 @@ class DistriOptimizer(Optimizer):
                             for p in range(self.dataset.partition_num)]
 
         def fetch_batch():
-            return _global_batch(it["shards"], batch_sharding)
+            return _global_batch(it["shards"], batch_sharding, check=_check)
 
         def run_step(inputs, targets, hyper, rng):
             (carry["flat"], carry["slots"], carry["mstate"],
@@ -200,6 +239,31 @@ class DistriOptimizer(Optimizer):
                     epoch_size=self.dataset.size())
         return model
 
+    def _wire_sequence_parallel(self, module) -> None:
+        """Point every MultiHeadAttention at the mesh's seq axis.  The ring
+        path only engages while that axis is bound (inside the shard_map
+        training step), so validation/predict forwards — which run outside
+        it — keep full-sequence attention.
+
+        Other time-mixing modules have no sequence-parallel path: on a
+        time-sharded input a recurrent unroll would restart its hidden
+        state at every chunk edge and a temporal conv / time reverse would
+        see artificial boundaries — silently wrong, so they are rejected.
+        """
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.nn.attention import MultiHeadAttention
+        time_mixing = (nn.Recurrent, nn.BiRecurrent, nn.TemporalConvolution,
+                       nn.Reverse)
+        offenders = [type(m).__name__ for m in module.find_modules(time_mixing)]
+        if offenders:
+            raise ValueError(
+                "sequence-parallel training (mesh with a 'seq' axis) shards "
+                "the time dimension, but these modules mix information "
+                f"across time with no ring path: {sorted(set(offenders))}; "
+                "train them on a ('data',)-only mesh")
+        for m in module.find_modules(MultiHeadAttention):
+            m.set_sequence_parallel(self.seq_axis)
+
     def _eval_mesh(self):
         """Validation forwards run sharded over the training mesh (the
         reference evaluates inside the cluster, ``optim/Evaluator.scala``)."""
@@ -220,15 +284,19 @@ class DistriOptimizer(Optimizer):
             outer, [arp.flatten(s) for s in subtrees])
 
 
-def _global_batch(shard_iters, batch_sharding):
+def _global_batch(shard_iters, batch_sharding, check=None):
     """Pull one minibatch per shard, concatenate host-side into the global
     batch, and place it sharded over the mesh's data axis (each device gets
     exactly its shard's records — the reference's locality-preserving zip,
-    ``ZippedPartitionsWithLocalityRDD.scala:28``)."""
+    ``ZippedPartitionsWithLocalityRDD.scala:28``).  ``check`` optionally
+    validates each leaf (sequence-parallel shape requirements)."""
     batches = [next(it) for it in shard_iters]
     inputs = _cat([b.get_input() for b in batches])
     targets = _cat([b.get_target() for b in batches])
     bsz = sum(b.size() for b in batches)
+    if check is not None:
+        inputs = jax.tree_util.tree_map(check, inputs)
+        targets = jax.tree_util.tree_map(check, targets)
     inputs = jax.tree_util.tree_map(
         lambda x: jax.device_put(x, batch_sharding), inputs)
     targets = jax.tree_util.tree_map(

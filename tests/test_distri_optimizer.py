@@ -224,3 +224,137 @@ class TestDistriOptimizer:
         acc = Evaluator(m2).test(
             samples, [optim.Top1Accuracy()], 64)[0][1].final_result()
         assert acc > 0.9
+
+
+class TestSequenceParallelTraining:
+    """dp x sp training: ring-attention sequence parallelism integrated in
+    the DistriOptimizer step (beyond-reference long-context path)."""
+
+    D_MODEL, N_CLASS, SEQ_T = 16, 4, 8
+
+    def _lm(self, seed=21):
+        m = (nn.Sequential()
+             .add(nn.Linear(self.D_MODEL, self.D_MODEL))
+             .add(nn.MultiHeadAttention(self.D_MODEL, 2, causal=True))
+             .add(nn.Tanh())
+             .add(nn.Linear(self.D_MODEL, self.N_CLASS))
+             .add(nn.LogSoftMax()))
+        m.reset(jax.random.PRNGKey(seed))
+        return m
+
+    def _samples(self, n=32, seed=9):
+        rng = np.random.RandomState(seed)
+        out = []
+        for _ in range(n):
+            x = rng.normal(size=(self.SEQ_T, self.D_MODEL)).astype(np.float32)
+            # learnable signal: label at t follows the sign of feature 0
+            y = (x[:, 0] > 0).astype(np.float32) + 1.0
+            out.append(Sample(x, y))
+        return out
+
+    def _train(self, samples, distributed, iters=6, lr=0.1):
+        model = self._lm()
+        crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                           size_average=True)
+        if distributed:
+            mesh = Engine.create_mesh((4, 2), ("data", "seq"))
+            ds = ShardedDataSet(samples, 4).transform(
+                SampleToMiniBatch(len(samples), 4))
+            opt = DistriOptimizer(model, ds, crit, mesh=mesh)
+        else:
+            ds = LocalDataSet(samples).transform(
+                SampleToMiniBatch(len(samples)))
+            opt = optim.Optimizer.create(model, ds, crit)
+        opt.set_optim_method(optim.SGD(learning_rate=lr, momentum=0.9))
+        opt.set_end_when(optim.max_iteration(iters))
+        trained = opt.optimize()
+        w, _ = trained.get_parameters()
+        return np.asarray(w), model
+
+    def test_matches_local_training_exactly(self):
+        """The dp x sp step (ring attention + psum over both axes) must
+        reproduce full-sequence single-process training — the RefOptimizer
+        oracle strategy applied to the long-context path."""
+        samples = self._samples()
+        w_local, _ = self._train(samples, distributed=False)
+        w_distri, model = self._train(samples, distributed=True)
+        np.testing.assert_allclose(w_distri, w_local, rtol=5e-4, atol=5e-5)
+        # after training, the same model still forwards full sequences
+        # outside the mesh (the ring path is shard_map-scoped)
+        x = np.stack([s.feature for s in samples[:4]])
+        out = np.asarray(model.forward(x))
+        assert out.shape == (4, self.SEQ_T, self.N_CLASS)
+
+    def test_converges_and_validates(self):
+        samples = self._samples(n=64)
+        model = self._lm(seed=5)
+        mesh = Engine.create_mesh((4, 2), ("data", "seq"))
+        ds = ShardedDataSet(samples, 4).transform(SampleToMiniBatch(32, 4))
+        crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                           size_average=True)
+        opt = DistriOptimizer(model, ds, crit, mesh=mesh)
+        opt.set_optim_method(optim.Adam(learning_rate=0.02))
+        opt.set_end_when(optim.max_iteration(40))
+        trained = opt.optimize()
+        x = np.stack([s.feature for s in samples])
+        pred = np.asarray(trained.forward(x)).argmax(-1) + 1
+        want = np.stack([s.label for s in samples])
+        acc = float((pred == want).mean())
+        assert acc > 0.9, f"sp training failed to converge: acc={acc}"
+
+    def test_time_mixing_modules_rejected(self):
+        """Recurrent/temporal-conv models cannot be time-sharded: each
+        chunk would restart the hidden state — must raise, not silently
+        train wrong."""
+        rng = np.random.RandomState(1)
+        samples = [Sample(rng.normal(size=(8, 4)).astype(np.float32),
+                          np.ones(8, np.float32)) for _ in range(8)]
+        model = (nn.Sequential()
+                 .add(nn.Recurrent().add(nn.RnnCell(4, 8, nn.Tanh())))
+                 .add(nn.TimeDistributed(nn.Linear(8, 2)))
+                 .add(nn.LogSoftMax()))
+        mesh = Engine.create_mesh((4, 2), ("data", "seq"))
+        ds = ShardedDataSet(samples, 4).transform(SampleToMiniBatch(8, 4))
+        opt = DistriOptimizer(
+            model, ds, nn.TimeDistributedCriterion(nn.ClassNLLCriterion()),
+            mesh=mesh)
+        opt.set_optim_method(optim.SGD(learning_rate=0.1))
+        opt.set_end_when(optim.max_iteration(1))
+        with pytest.raises(ValueError, match="Recurrent"):
+            opt.optimize()
+
+    def test_mha_wired_through_non_container_wrapper(self):
+        """find_modules-based wiring reaches an MHA nested in Bottle (a
+        plain-Module composite), not just Container children."""
+        mha = nn.MultiHeadAttention(self.D_MODEL, 2, causal=True)
+        model = (nn.Sequential()
+                 .add(nn.Bottle(mha, n_input_dim=3, n_output_dim=3))
+                 .add(nn.Linear(self.D_MODEL, self.N_CLASS))
+                 .add(nn.LogSoftMax()))
+        model.reset(jax.random.PRNGKey(2))
+        samples = self._samples(n=8)
+        mesh = Engine.create_mesh((4, 2), ("data", "seq"))
+        ds = ShardedDataSet(samples, 4).transform(SampleToMiniBatch(8, 4))
+        crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                           size_average=True)
+        opt = DistriOptimizer(model, ds, crit, mesh=mesh)
+        opt.set_optim_method(optim.SGD(learning_rate=0.05))
+        opt.set_end_when(optim.max_iteration(1))
+        opt.optimize()
+        assert mha.sequence_parallel == "seq"
+
+    def test_seq_shape_guard(self):
+        samples = self._samples(n=8)
+        # T=8 not divisible by... use a 3-wide seq axis? 8 devices: (2, 4)
+        # mesh with T=6 inputs -> T % 4 != 0 must raise clearly
+        rng = np.random.RandomState(0)
+        bad = [Sample(rng.normal(size=(6, self.D_MODEL)).astype(np.float32),
+                      np.ones(6, np.float32)) for _ in range(8)]
+        mesh = Engine.create_mesh((2, 4), ("data", "seq"))
+        ds = ShardedDataSet(bad, 2).transform(SampleToMiniBatch(8, 2))
+        crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+        opt = DistriOptimizer(self._lm(), ds, crit, mesh=mesh)
+        opt.set_optim_method(optim.SGD(learning_rate=0.1))
+        opt.set_end_when(optim.max_iteration(1))
+        with pytest.raises(ValueError, match="divisible by the seq axis"):
+            opt.optimize()
