@@ -10,6 +10,11 @@ from bigdl_tpu.parallel.all_reduce import AllReduceParameter
 from bigdl_tpu.parallel.distri_optimizer import DistriOptimizer
 from bigdl_tpu.parallel.ring_attention import (ring_attention,
                                                ring_self_attention)
+from bigdl_tpu.parallel.tensor_parallel import (column_parallel,
+                                                head_count_divisible,
+                                                row_parallel,
+                                                tp_shard_params, tp_specs)
 
 __all__ = ["AllReduceParameter", "DistriOptimizer", "ring_attention",
-           "ring_self_attention"]
+           "ring_self_attention", "column_parallel", "row_parallel",
+           "tp_shard_params", "tp_specs", "head_count_divisible"]

@@ -1,0 +1,148 @@
+"""Tensor parallelism via GSPMD sharding annotations (Megatron-style).
+
+The reference has NO tensor parallelism (SURVEY §2.12: data parallelism
+only) — this is a beyond-reference capability, expressed the TPU-native
+way: instead of hand-written collectives, parameters carry
+``NamedSharding`` annotations over a ``model`` mesh axis and XLA's SPMD
+partitioner inserts the all-reduces (the scaling-book recipe: pick a mesh,
+annotate shardings, let XLA insert collectives).
+
+The Megatron split for an attention/MLP block:
+
+- **column-parallel** (first of a pair): weight ``(in, out)`` sharded
+  ``P(None, "model")`` — each device holds ``out/n`` columns, outputs stay
+  feature-sharded, no communication;
+- **row-parallel** (second of a pair): weight ``(in, out)`` sharded
+  ``P("model", None)`` — feature-sharded input contracts locally, XLA
+  inserts ONE psum per pair on the output.
+
+MultiHeadAttention maps heads onto the column split: wq/wk/wv are
+column-parallel (each device computes ``n_head/n`` heads), wo is
+row-parallel.
+
+Usage::
+
+    mesh = Engine.create_mesh((n,), ("model",))
+    specs = tp_specs(model, mesh=mesh)            # params-pytree of specs
+    params = tp_shard_params(model.params, mesh, specs)
+    step = jax.jit(train_step)                    # shardings propagate
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bigdl_tpu.nn.attention import MultiHeadAttention
+from bigdl_tpu.nn.linear import Linear
+from bigdl_tpu.nn.module import Container, Module
+
+
+def tp_specs(module: Module, axis: str = "model",
+             mesh: Optional[Mesh] = None):
+    """PartitionSpec pytree matching ``module``'s params.
+
+    MultiHeadAttention gets the Megatron head split automatically; a
+    ``Linear`` participates when tagged via :func:`column_parallel` /
+    :func:`row_parallel`; everything else is replicated (``P()``).
+
+    Pass ``mesh`` to validate the head split sizes up front
+    (:func:`head_count_divisible` runs for you).
+    """
+    reached: List[Module] = []
+    specs = _specs(module, axis, reached)
+    # every TP participant found by tree walk must have been assigned a
+    # split spec — an unknown non-Container composite hiding one would
+    # silently replicate it (no memory/compute split, no error)
+    participants = [m for m in module.find_modules((MultiHeadAttention,
+                                                    Linear))
+                    if isinstance(m, MultiHeadAttention)
+                    or getattr(m, "_tp", None)]
+    missed = [m for m in participants if not any(m is r for r in reached)]
+    if missed:
+        raise ValueError(
+            "tensor-parallel modules are nested inside composites the "
+            "spec walk cannot see through: "
+            f"{sorted(type(m).__name__ for m in missed)} — restructure "
+            "with Sequential/Container (or Bottle, which is supported)")
+    if mesh is not None:
+        head_count_divisible(module, mesh, axis)
+    return specs
+
+
+def _specs(module: Module, axis: str, reached: List[Module]):
+    from bigdl_tpu.nn.structural import Bottle
+    if isinstance(module, MultiHeadAttention):
+        reached.append(module)
+        if module.flash:
+            raise ValueError("flash kernel is incompatible with the "
+                             "GSPMD head split (pallas kernels do not "
+                             "partition); use the default attention path")
+        specs = {"wq": P(None, axis), "wk": P(None, axis),
+                 "wv": P(None, axis), "wo": P(axis, None)}
+        if module.with_bias:
+            specs.update({"bq": P(axis), "bk": P(axis), "bv": P(axis),
+                          "bo": P()})
+        return specs
+    if isinstance(module, Linear):
+        tp = getattr(module, "_tp", None)
+        if tp == "column":
+            reached.append(module)
+            s = {"weight": P(None, axis)}
+            if module.with_bias:
+                s["bias"] = P(axis)
+            return s
+        if tp == "row":
+            reached.append(module)
+            s = {"weight": P(axis, None)}
+            if module.with_bias:
+                s["bias"] = P()
+            return s
+    if isinstance(module, Bottle):
+        return [_specs(module.module, axis, reached)]
+    if isinstance(module, Container):
+        return [_specs(c, axis, reached) for c in module.children]
+    # replicated leaf: one spec per param array
+    module._ensure_init()
+    p = module._params if module._params is not None else {}
+    return jax.tree_util.tree_map(lambda _: P(), p)
+
+
+def column_parallel(linear: Linear) -> Linear:
+    """Tag a Linear as the column-split half of a Megatron pair (its
+    activation output becomes feature-sharded)."""
+    linear._tp = "column"
+    return linear
+
+
+def row_parallel(linear: Linear) -> Linear:
+    """Tag a Linear as the row-split half (consumes a feature-sharded
+    activation; XLA inserts the pair's single psum here)."""
+    linear._tp = "row"
+    return linear
+
+
+def tp_shard_params(params, mesh: Mesh, specs):
+    """Place a params pytree on the mesh with the given spec pytree —
+    weights are physically split 1/n per device along the model axis."""
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, specs)
+
+
+def head_count_divisible(module: Module, mesh: Mesh,
+                         axis: str = "model") -> None:
+    """Validate the Megatron head split: every MHA's head count must divide
+    by the model-axis size (each device computes whole heads)."""
+    n = mesh.shape[axis]
+    for m in module.find_modules(MultiHeadAttention):
+        if m.n_head % n != 0:
+            raise ValueError(
+                f"tensor parallelism needs n_head divisible by the "
+                f"'{axis}' axis size: {m.n_head} % {n} != 0")
+        if m.flash:
+            raise ValueError("flash kernel is incompatible with the "
+                             "GSPMD head split (pallas kernels do not "
+                             "partition); use the default attention path")
