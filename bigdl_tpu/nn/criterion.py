@@ -427,16 +427,50 @@ class ClassSimplexCriterion(Criterion):
 
 class TimeDistributedCriterion(Criterion):
     """Apply a criterion at every timestep of (N, T, ...) input
-    (reference ``nn/TimeDistributedCriterion.scala``)."""
+    (reference ``nn/TimeDistributedCriterion.scala``).
+
+    Separable inner criterions (unweighted ClassNLL/CrossEntropy, MSE, Abs,
+    BCE) take a vectorized path: one criterion call on the time-flattened
+    batch instead of T unrolled calls — identical value (each per-timestep
+    mean over N equals the flat mean over N*T scaled by T), but the jitted
+    graph stays O(1) in sequence length instead of O(T)."""
 
     def __init__(self, critrn: Criterion, size_average: bool = False):
         super().__init__()
         self.critrn = critrn
         self.size_average = size_average
 
+    def _separable(self) -> bool:
+        c = self.critrn
+        if isinstance(c, (MSECriterion, AbsCriterion)):
+            return True
+        if isinstance(c, (ClassNLLCriterion, BCECriterion)):
+            return c.weights is None
+        if isinstance(c, CrossEntropyCriterion):
+            return c.nll.weights is None
+        return False
+
+    def _inner_size_average(self) -> bool:
+        c = self.critrn
+        # CrossEntropy delegates to its NLL: the ctor arg lives there, not
+        # on the base-class default
+        if isinstance(c, CrossEntropyCriterion):
+            return c.nll.size_average
+        return getattr(c, "size_average", False)
+
     def apply(self, input, target):
         t_steps = input.shape[1]
-        total = 0.0
-        for t in range(t_steps):
-            total = total + self.critrn.apply(input[:, t], target[:, t])
+        if self._separable():
+            flat_in = jnp.reshape(input, (-1,) + input.shape[2:])
+            flat_tgt = jnp.reshape(jnp.asarray(target),
+                                   (-1,) + jnp.asarray(target).shape[2:])
+            total = self.critrn.apply(flat_in, flat_tgt)
+            # flat size_average divides by N*T (or N*T*D); the unrolled sum
+            # of per-timestep means divides by N (or N*D) — scale back
+            if self._inner_size_average():
+                total = total * t_steps
+        else:
+            total = 0.0
+            for t in range(t_steps):
+                total = total + self.critrn.apply(input[:, t], target[:, t])
         return total / t_steps if self.size_average else total

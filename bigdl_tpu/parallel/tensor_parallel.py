@@ -76,10 +76,7 @@ def _specs(module: Module, axis: str, reached: List[Module]):
     from bigdl_tpu.nn.structural import Bottle
     if isinstance(module, MultiHeadAttention):
         reached.append(module)
-        if module.flash:
-            raise ValueError("flash kernel is incompatible with the "
-                             "GSPMD head split (pallas kernels do not "
-                             "partition); use the default attention path")
+        _reject_flash(module)
         specs = {"wq": P(None, axis), "wk": P(None, axis),
                  "wv": P(None, axis), "wo": P(axis, None)}
         if module.with_bias:
@@ -132,6 +129,13 @@ def tp_shard_params(params, mesh: Mesh, specs):
         params, specs)
 
 
+def _reject_flash(mha: MultiHeadAttention) -> None:
+    if mha.flash:
+        raise ValueError("flash kernel is incompatible with the "
+                         "GSPMD head split (pallas kernels do not "
+                         "partition); use the default attention path")
+
+
 def head_count_divisible(module: Module, mesh: Mesh,
                          axis: str = "model") -> None:
     """Validate the Megatron head split: every MHA's head count must divide
@@ -142,7 +146,4 @@ def head_count_divisible(module: Module, mesh: Mesh,
             raise ValueError(
                 f"tensor parallelism needs n_head divisible by the "
                 f"'{axis}' axis size: {m.n_head} % {n} != 0")
-        if m.flash:
-            raise ValueError("flash kernel is incompatible with the "
-                             "GSPMD head split (pallas kernels do not "
-                             "partition); use the default attention path")
+        _reject_flash(m)

@@ -196,3 +196,74 @@ class TestOthers:
         exp = (max(0, 1 - (0.4 - 0.1)) + max(0, 1 - (0.4 - 0.2))
                + max(0, 1 - (0.4 - 0.8))) / 4
         np.testing.assert_allclose(loss, exp, rtol=1e-5)
+
+
+class TestTimeDistributedVectorizedPath:
+    """The separable fast path must equal the unrolled per-timestep loop."""
+
+    def _loop_value(self, crit, x, y):
+        total = 0.0
+        for t in range(x.shape[1]):
+            total = total + float(crit.apply(x[:, t], y[:, t]))
+        return total
+
+    @pytest.mark.parametrize("size_average", [False, True])
+    def test_classnll_matches_loop(self, size_average):
+        rng = np.random.RandomState(0)
+        logits = rng.normal(size=(4, 6, 5)).astype(np.float32)
+        x = jnp.asarray(logits) - jnp.max(jnp.asarray(logits))
+        x = jax.nn.log_softmax(x, axis=-1)
+        y = jnp.asarray(rng.randint(1, 6, size=(4, 6)).astype(np.float32))
+        inner = nn.ClassNLLCriterion()
+        td = nn.TimeDistributedCriterion(inner, size_average=size_average)
+        assert td._separable()
+        got = float(td.apply(x, y))
+        want = self._loop_value(inner, x, y)
+        if size_average:
+            want /= x.shape[1]
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_mse_and_bce_match_loop(self):
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.rand(3, 5, 4).astype(np.float32)) * 0.8 + 0.1
+        y = jnp.asarray((rng.rand(3, 5, 4) > 0.5).astype(np.float32))
+        for inner in (nn.MSECriterion(), nn.BCECriterion()):
+            td = nn.TimeDistributedCriterion(inner)
+            assert td._separable()
+            np.testing.assert_allclose(float(td.apply(x, y)),
+                                       self._loop_value(inner, x, y),
+                                       rtol=1e-4)
+
+    def test_crossentropy_no_size_average_not_rescaled(self):
+        # CrossEntropy stores the flag on its inner NLL; the fast path must
+        # read it there, not the base-class default
+        inner = nn.CrossEntropyCriterion(size_average=False)
+        td = nn.TimeDistributedCriterion(inner)
+        assert td._separable() and not td._inner_size_average()
+        rng = np.random.RandomState(2)
+        x = jnp.asarray(rng.normal(size=(4, 6, 5)).astype(np.float32))
+        y = jnp.asarray(rng.randint(1, 6, size=(4, 6)).astype(np.float32))
+        np.testing.assert_allclose(float(td.apply(x, y)),
+                                   self._loop_value(inner, x, y), rtol=1e-5)
+
+    def test_weighted_nll_falls_back_to_loop(self):
+        inner = nn.ClassNLLCriterion(weights=np.asarray([1.0, 2.0]))
+        td = nn.TimeDistributedCriterion(inner)
+        assert not td._separable()
+        x = jnp.log(jnp.full((2, 3, 2), 0.5))
+        y = jnp.ones((2, 3), jnp.float32)
+        v = float(td.apply(x, y))
+        np.testing.assert_allclose(v, self._loop_value(inner, x, y),
+                                   rtol=1e-6)
+
+    def test_graph_size_constant_in_t(self):
+        """The vectorized path keeps the jitted HLO O(1) in T."""
+        inner = nn.ClassNLLCriterion()
+        td = nn.TimeDistributedCriterion(inner, size_average=True)
+
+        def size_for(t):
+            x = jnp.zeros((2, t, 4))
+            y = jnp.ones((2, t))
+            return len(jax.make_jaxpr(td.apply)(x, y).jaxpr.eqns)
+
+        assert size_for(64) == size_for(8)
