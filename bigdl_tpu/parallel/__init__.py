@@ -10,6 +10,10 @@ from bigdl_tpu.parallel.all_reduce import AllReduceParameter
 from bigdl_tpu.parallel.distri_optimizer import DistriOptimizer
 from bigdl_tpu.parallel.ring_attention import (ring_attention,
                                                ring_self_attention)
+from bigdl_tpu.parallel.pipeline import (pipeline_apply,
+                                         pipeline_shard_params,
+                                         stack_stage_params,
+                                         unstack_stage_params)
 from bigdl_tpu.parallel.tensor_parallel import (column_parallel,
                                                 head_count_divisible,
                                                 row_parallel,
@@ -17,4 +21,6 @@ from bigdl_tpu.parallel.tensor_parallel import (column_parallel,
 
 __all__ = ["AllReduceParameter", "DistriOptimizer", "ring_attention",
            "ring_self_attention", "column_parallel", "row_parallel",
-           "tp_shard_params", "tp_specs", "head_count_divisible"]
+           "tp_shard_params", "tp_specs", "head_count_divisible",
+           "pipeline_apply", "pipeline_shard_params", "stack_stage_params",
+           "unstack_stage_params"]

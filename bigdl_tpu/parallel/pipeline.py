@@ -1,0 +1,112 @@
+"""Pipeline parallelism: GPipe-style microbatching over a ``stage`` axis.
+
+The reference has NO pipeline parallelism (SURVEY §2.12: data parallelism
+only) — this is a beyond-reference capability in the TPU-native idiom: the
+pipeline schedule is a ``lax.scan`` whose carry rotates activations around
+the mesh's ``stage`` axis with ``lax.ppermute``; each device applies its
+own stage's parameters (a leading stage dimension sharded over the axis).
+Because the whole schedule is one differentiable scan, ``jax.grad`` derives
+the reverse (backward) pipeline automatically — no hand-written 1F1B.
+
+Scope: homogeneous pipelines — S repetitions of the same block structure
+with matching input/output shapes (the transformer-stack case).  Blocks
+must be stateless (no BatchNorm running statistics inside the scan).
+
+Usage::
+
+    mesh = Engine.create_mesh((S,), ("stage",))
+    block = make_block()                       # one stage's Module
+    stacked = stack_stage_params([p0, ..., pS-1])
+    stacked = pipeline_shard_params(stacked, mesh)
+    y = pipeline_apply(block, stacked, x, n_micro=M, mesh=mesh)
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bigdl_tpu.nn.module import Module
+
+
+def stack_stage_params(per_stage: List):
+    """Stack S per-stage param pytrees leaf-wise into a (S, ...) tree."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_stage)
+
+
+def unstack_stage_params(stacked, n_stages: int) -> List:
+    """Inverse of :func:`stack_stage_params`."""
+    return [jax.tree_util.tree_map(lambda a, i=i: a[i], stacked)
+            for i in range(n_stages)]
+
+
+def pipeline_shard_params(stacked, mesh: Mesh, axis: str = "stage"):
+    """Place stacked params with the stage dimension split across the mesh:
+    each device physically holds only its own stage's weights."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, NamedSharding(mesh, P(axis))), stacked)
+
+
+def _check_block(block: Module) -> None:
+    state_leaves = jax.tree_util.tree_leaves(block.state)
+    if state_leaves:
+        raise ValueError(
+            "pipeline blocks must be stateless (no BatchNorm running "
+            "statistics) — the scanned schedule cannot thread per-stage "
+            "module state")
+
+
+def pipeline_apply(block: Module, stacked_params, x: jnp.ndarray,
+                   n_micro: int, mesh: Mesh, axis: str = "stage"):
+    """Run the S-stage pipeline over ``x`` (batch, ...) and return the
+    final-stage output for the whole batch, replicated.
+
+    ``x`` is split into ``n_micro`` microbatches along dim 0; at steady
+    state all S stages work on different microbatches concurrently.
+    Differentiable end-to-end: wrap in a loss and ``jax.grad`` — per-stage
+    weight gradients come back with the same (S, ...) stage-sharded layout.
+    """
+    from bigdl_tpu.parallel.all_reduce import shard_map
+
+    n_stages = mesh.shape[axis]
+    _check_block(block)
+    if n_micro < 1 or x.shape[0] % n_micro != 0:
+        raise ValueError(f"batch {x.shape[0]} not divisible into "
+                         f"{n_micro} microbatches")
+    mb = x.shape[0] // n_micro
+    xm = x.reshape((n_micro, mb) + x.shape[1:])
+    state = block.state
+    perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+
+    def shard_fn(stage_p, xs):
+        sp = jax.tree_util.tree_map(lambda a: a[0], stage_p)  # my stage
+        idx = lax.axis_index(axis)
+
+        def step(buf, i):
+            # stage 0 ingests a fresh microbatch; later stages take the
+            # activation handed over by ppermute on the previous tick
+            fresh = xs[jnp.minimum(i, n_micro - 1)]
+            inp = jnp.where(idx == 0, fresh, buf)
+            y, _ = block.apply(sp, inp, state, training=False)
+            nxt = lax.ppermute(y, axis, perm)
+            return nxt, y
+
+        _, ys = lax.scan(step, jnp.zeros_like(xs[0]),
+                         jnp.arange(n_micro + n_stages - 1))
+        # the last stage emits microbatch m at tick m + S - 1
+        outs = ys[n_stages - 1:]
+        # broadcast the last stage's outputs to every device
+        outs = lax.psum(
+            jnp.where(idx == n_stages - 1, outs, jnp.zeros_like(outs)),
+            axis)
+        return outs
+
+    fn = shard_map(shard_fn, mesh=mesh,
+                   in_specs=(P(axis), P()), out_specs=P(),
+                   check_rep=False)
+    outs = fn(stacked_params, xm)
+    return outs.reshape((n_micro * mb,) + outs.shape[2:])
