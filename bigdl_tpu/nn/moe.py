@@ -1,0 +1,115 @@
+"""Mixture-of-experts layer (Switch-style top-1 routing).
+
+The reference has no MoE (its ``MixtureTable`` is a dense gated blend over
+a Table of expert outputs, ``nn/MixtureTable.scala`` — every expert runs on
+every sample).  This layer is the sparse, TPU-native counterpart: top-1
+token routing with a capacity bound, computed as einsum dispatch/combine so
+the expert FFNs stay large batched MXU matmuls; homogeneous experts are
+vmapped over a stacked parameter tree.  Expert parallelism over a mesh
+``expert`` axis lives in ``bigdl_tpu/parallel/expert_parallel.py``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn import init as init_methods
+from bigdl_tpu.nn.module import Module
+
+
+class MixtureOfExperts(Module):
+    """Top-1 (Switch) gated mixture of ``n_experts`` homogeneous experts.
+
+    ``expert``: a template Module mapping (tokens, d_model) -> (tokens,
+    d_model); its structure is replicated per expert with independent
+    parameters (stacked leaf-wise under the ``"experts"`` key).
+
+    Routing: softmax gate over experts, each token goes to its argmax
+    expert; each expert processes at most ``capacity`` tokens
+    (``ceil(tokens / n_experts * capacity_factor)``), overflow tokens pass
+    through with zero expert output (standard Switch behavior).
+    """
+
+    def __init__(self, d_model: int, expert: Module, n_experts: int,
+                 capacity_factor: float = 1.25, name=None):
+        super().__init__(name)
+        self.d_model = d_model
+        self.expert = expert
+        self.n_experts = n_experts
+        self.capacity_factor = capacity_factor
+
+    def _init_params(self, rng):
+        ks = jax.random.split(rng, self.n_experts + 1)
+        xavier = init_methods.Xavier()
+        gate = xavier(ks[0], (self.d_model, self.n_experts),
+                      self.d_model, self.n_experts)
+        per_expert = [self.expert._init_params(k) for k in ks[1:]]
+        stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                         *per_expert)
+        return {"gate": gate, "experts": stacked}
+
+    def _init_state(self):
+        # experts must be stateless: per-expert running statistics are not
+        # threaded through the vmapped dispatch (guarded in expert_forward)
+        expert_state = self.expert._init_state()
+        if jax.tree_util.tree_leaves(expert_state):
+            raise ValueError(
+                "MixtureOfExperts experts must be stateless (no BatchNorm "
+                "running statistics) — state updates cannot be threaded "
+                "through the routed dispatch")
+        return {"expert": expert_state}
+
+    def capacity(self, n_tokens: int) -> int:
+        """Per-expert token capacity for a dispatch over ``n_tokens``.
+        Under expert parallelism this applies per device shard (each shard
+        routes its local tokens), so the global per-expert budget is
+        n_shards * capacity(local_tokens)."""
+        return max(1, math.ceil(n_tokens / self.n_experts
+                                * self.capacity_factor))
+
+    def route(self, params, flat):
+        """(tokens, d) -> (dispatch (t, E, C), combine (t, E, C)).
+
+        ``dispatch`` is the 0/1 routing tensor (token t occupies capacity
+        slot c of expert e); ``combine`` additionally carries the gate
+        probability, so ``combine @ expert_out`` is the weighted output.
+        """
+        t = flat.shape[0]
+        cap = self.capacity(t)
+        gates = jax.nn.softmax(flat @ params["gate"], axis=-1)   # (t, E)
+        expert_idx = jnp.argmax(gates, axis=-1)                  # (t,)
+        # queue bookkeeping in int32: a low-precision activation dtype
+        # (bf16 is first-class here) cannot count past 256 exactly, which
+        # would double-book capacity slots
+        onehot_i = jax.nn.one_hot(expert_idx, self.n_experts,
+                                  dtype=jnp.int32)               # (t, E)
+        pos = jnp.cumsum(onehot_i, axis=0) * onehot_i - 1        # (t, E)
+        keep = (pos >= 0) & (pos < cap)
+        slot = jax.nn.one_hot(jnp.where(keep, pos, -1), cap,
+                              dtype=flat.dtype)                  # (t, E, C)
+        onehot = onehot_i.astype(flat.dtype)
+        dispatch = slot * onehot[:, :, None]
+        gate_val = jnp.sum(gates * onehot, axis=-1)              # (t,)
+        combine = dispatch * gate_val[:, None, None]
+        return dispatch, combine
+
+    def expert_forward(self, params, expert_in, state, training, rng):
+        """vmapped expert application over the stacked (E, C, d) inputs."""
+        def one(p, xin):
+            out, _ = self.expert.apply(p, xin, state["expert"],
+                                       training=training, rng=rng)
+            return out
+        return jax.vmap(one)(params["experts"], expert_in)
+
+    def apply(self, params, input, state, training=False, rng=None):
+        flat = jnp.reshape(input, (-1, self.d_model))
+        dispatch, combine = self.route(params, flat)
+        expert_in = jnp.einsum("tec,td->ecd", dispatch, flat)
+        expert_out = self.expert_forward(params, expert_in, state,
+                                         training, rng)
+        out = jnp.einsum("tec,ecd->td", combine, expert_out)
+        return jnp.reshape(out, input.shape), state

@@ -74,6 +74,12 @@ def pipeline_apply(block: Module, stacked_params, x: jnp.ndarray,
 
     n_stages = mesh.shape[axis]
     _check_block(block)
+    for leaf in jax.tree_util.tree_leaves(stacked_params):
+        if leaf.shape[0] != n_stages:
+            raise ValueError(
+                f"stacked params carry {leaf.shape[0]} stages but the "
+                f"'{axis}' axis has {n_stages} devices — with a mismatch "
+                "each device would silently run only its first local stage")
     if n_micro < 1 or x.shape[0] % n_micro != 0:
         raise ValueError(f"batch {x.shape[0]} not divisible into "
                          f"{n_micro} microbatches")

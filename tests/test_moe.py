@@ -1,0 +1,133 @@
+"""Mixture-of-experts + expert-parallelism tests (8-device CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.engine import Engine
+from bigdl_tpu.nn.moe import MixtureOfExperts
+from bigdl_tpu.parallel.expert_parallel import (ep_shard_params,
+                                                expert_parallel_apply)
+
+D, E = 8, 4
+N_DEV = 4
+
+
+def _moe(capacity_factor=8.0, seed=3):
+    expert = (nn.Sequential().add(nn.Linear(D, 2 * D)).add(nn.ReLU())
+              .add(nn.Linear(2 * D, D)))
+    moe = MixtureOfExperts(D, expert, E, capacity_factor=capacity_factor)
+    moe.reset(jax.random.PRNGKey(seed))
+    return moe
+
+
+class TestMixtureOfExperts:
+    def test_routing_is_top1_and_capacity_bounded(self):
+        moe = _moe(capacity_factor=0.5)       # force drops
+        x = jnp.asarray(np.random.RandomState(0)
+                        .normal(size=(16, D)).astype(np.float32))
+        dispatch, combine = moe.route(moe.params, x)
+        # each token occupies at most one (expert, slot)
+        per_token = np.asarray(jnp.sum(dispatch, axis=(1, 2)))
+        assert set(np.unique(per_token)) <= {0.0, 1.0}
+        # capacity respected per expert
+        cap = moe.capacity(16)
+        per_slot = np.asarray(jnp.sum(dispatch, axis=0))    # (E, C)
+        assert per_slot.max() <= 1.0 and dispatch.shape[2] == cap
+
+    def test_forward_is_gated_expert_output(self):
+        moe = _moe()
+        x = jnp.asarray(np.random.RandomState(1)
+                        .normal(size=(10, D)).astype(np.float32))
+        out = np.asarray(moe.forward(x))
+        # manual per-token check against the chosen expert
+        p = moe.params
+        gates = jax.nn.softmax(x @ p["gate"], axis=-1)
+        idx = np.asarray(jnp.argmax(gates, axis=-1))
+        for t in range(10):
+            ep = jax.tree_util.tree_map(lambda a, e=idx[t]: a[e],
+                                        p["experts"])
+            want, _ = moe.expert.apply(ep, x[t:t + 1], moe.state["expert"])
+            want = np.asarray(want[0]) * float(gates[t, idx[t]])
+            np.testing.assert_allclose(out[t], want, rtol=1e-4, atol=1e-5)
+
+    def test_overflow_tokens_drop_to_zero(self):
+        moe = _moe(capacity_factor=0.26)      # capacity 2 for 16 tokens
+        x = jnp.asarray(np.ones((16, D), np.float32))  # all to one expert
+        out = np.asarray(moe.forward(x))
+        zero_rows = (np.abs(out).sum(axis=-1) < 1e-6).sum()
+        assert zero_rows >= 14                # only `capacity` survive
+
+    def test_batched_input_shape_preserved(self):
+        moe = _moe()
+        x = np.random.RandomState(2).normal(size=(2, 5, D)).astype(np.float32)
+        out = moe.forward(x)
+        assert np.asarray(out).shape == (2, 5, D)
+
+
+class TestExpertParallel:
+    def test_matches_dense_when_nothing_drops(self):
+        mesh = Engine.create_mesh((N_DEV,), ("expert",),
+                                  devices=jax.devices()[:N_DEV])
+        moe = _moe(capacity_factor=8.0)
+        x = jnp.asarray(np.random.RandomState(3)
+                        .normal(size=(16, D)).astype(np.float32))
+        want = np.asarray(moe.forward(x))
+        params = ep_shard_params(moe.params, mesh)
+        # expert weights are physically split 1/n
+        leaf = jax.tree_util.tree_leaves(params["experts"])[0]
+        assert {s.data.shape[0] for s in leaf.addressable_shards} == {1}
+        got = np.asarray(expert_parallel_apply(moe, params, x, mesh))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_gradients_flow_and_stay_sharded(self):
+        mesh = Engine.create_mesh((N_DEV,), ("expert",),
+                                  devices=jax.devices()[:N_DEV])
+        moe = _moe(seed=7)
+        rng = np.random.RandomState(4)
+        x = jnp.asarray(rng.normal(size=(8, D)).astype(np.float32))
+        y = jnp.asarray(rng.normal(size=(8, D)).astype(np.float32))
+        params = ep_shard_params(moe.params, mesh)
+
+        def loss(p):
+            out = expert_parallel_apply(moe, p, x, mesh)
+            return jnp.mean((out - y) ** 2)
+
+        g = jax.jit(jax.grad(loss))(params)
+        for leaf in jax.tree_util.tree_leaves(g):
+            assert np.all(np.isfinite(np.asarray(leaf)))
+        gleaf = jax.tree_util.tree_leaves(g["experts"])[0]
+        assert {s.data.shape[0] for s in gleaf.addressable_shards} == {1}, \
+            "expert grads must stay expert-sharded"
+
+    def test_guards(self):
+        mesh = Engine.create_mesh((N_DEV,), ("expert",),
+                                  devices=jax.devices()[:N_DEV])
+        moe = MixtureOfExperts(D, nn.Linear(D, D), 6)   # 6 % 4 != 0
+        moe._ensure_init()
+        with pytest.raises(ValueError, match="divide"):
+            expert_parallel_apply(moe, moe.params, jnp.zeros((8, D)), mesh)
+        moe2 = _moe()
+        with pytest.raises(ValueError, match="batch"):
+            expert_parallel_apply(moe2, ep_shard_params(moe2.params, mesh),
+                                  jnp.zeros((6, D)), mesh)
+
+
+def test_stateful_expert_rejected():
+    expert = nn.Sequential().add(nn.BatchNormalization(D))
+    moe = MixtureOfExperts(D, expert, E)
+    with pytest.raises(ValueError, match="stateless"):
+        moe._ensure_init()
+
+
+def test_routing_bookkeeping_survives_bf16():
+    # 600 tokens to few experts: bf16 cumsum would double-book slots >256
+    moe = _moe(capacity_factor=8.0)
+    x = jnp.asarray(np.random.RandomState(5)
+                    .normal(size=(600, D)).astype(np.float32)
+                    ).astype(jnp.bfloat16)
+    dispatch, _ = moe.route(moe.params, x)
+    per_slot = np.asarray(jnp.sum(dispatch.astype(jnp.float32), axis=0))
+    assert per_slot.max() <= 1.0, "capacity slot double-booked"

@@ -130,3 +130,9 @@ class TestPipeline:
             pipeline_apply(block, stacked, jnp.zeros((7, D)), 4, mesh)
         with pytest.raises(ValueError, match="microbatch"):
             pipeline_apply(block, stacked, jnp.zeros((8, D)), 0, mesh)
+    def test_stage_count_mismatch_rejected(self):
+        mesh = Engine.create_mesh((2,), ("stage",),
+                                  devices=jax.devices()[:2])
+        block, stacked, _ = _stages()          # 4 stages vs 2-device mesh
+        with pytest.raises(ValueError, match="stages"):
+            pipeline_apply(block, stacked, jnp.zeros((8, D)), 4, mesh)
