@@ -42,6 +42,22 @@ class Engine:
     MESH_AXES = ("data", "model", "seq")
 
     @staticmethod
+    def honor_virtual_devices() -> None:
+        """Honor an XLA_FLAGS virtual host-device request even when a site
+        hook pre-registered an accelerator backend: on this image the env
+        var alone is not enough, the platform must be forced to cpu before
+        jax initializes its backend.  Call early in any entry point that
+        should respect ``--xla_force_host_platform_device_count``."""
+        import os
+        if "xla_force_host_platform_device_count" in os.environ.get(
+                "XLA_FLAGS", ""):
+            try:
+                import jax
+                jax.config.update("jax_platforms", "cpu")
+            except Exception:
+                pass
+
+    @staticmethod
     def init(node_number: Optional[int] = None,
              core_number: Optional[int] = None,
              engine_type: Optional[str] = None) -> None:

@@ -1,0 +1,135 @@
+"""Decoder-only transformer language model.
+
+No reference equivalent (the reference has no attention op at all,
+SURVEY §5.7) — this is the flagship long-context model family: causal
+MultiHeadAttention blocks with pre-norm residuals, trainable on a
+``("data", "seq")`` mesh where attention runs as a ppermute ring
+(``bigdl_tpu/parallel/ring_attention.py``) and optionally with
+Megatron-split MLPs (``parallel/tensor_parallel.py``).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.module import Module
+
+
+class PositionalEncoding(Module):
+    """Sinusoidal position signal added to (B, T, D) embeddings.
+
+    Position-dependent, so under sequence parallelism each time shard must
+    offset into the table by its chunk start: the trainer wires
+    ``set_sequence_parallel`` (duck-typed, like MultiHeadAttention's ring
+    path) and the offset engages only while the seq axis is bound."""
+
+    def __init__(self, d_model: int, max_len: int = 4096, name=None):
+        super().__init__(name)
+        pos = np.arange(max_len)[:, None]
+        div = np.exp(np.arange(0, d_model, 2) * (-math.log(10000.0) / d_model))
+        pe = np.zeros((max_len, d_model), np.float32)
+        pe[:, 0::2] = np.sin(pos * div)
+        pe[:, 1::2] = np.cos(pos * div[: d_model // 2])
+        self.pe = jnp.asarray(pe)
+        self.sequence_parallel = None
+
+    @property
+    def max_seq_len(self) -> int:
+        """Table capacity — the sp trainer validates global T against this
+        (dynamic_slice would silently clamp out-of-range shard offsets)."""
+        return int(self.pe.shape[0])
+
+    def set_sequence_parallel(self, axis_name) -> "PositionalEncoding":
+        self.sequence_parallel = axis_name
+        self._jit_apply = None
+        return self
+
+    def apply(self, params, input, state, training=False, rng=None):
+        from bigdl_tpu.nn.attention import _axis_bound
+        t = input.shape[1]
+        if self.sequence_parallel and _axis_bound(self.sequence_parallel):
+            start = jax.lax.axis_index(self.sequence_parallel) * t
+            pe = jax.lax.dynamic_slice_in_dim(self.pe, start, t, 0)
+        else:
+            pe = self.pe[:t]
+        return input + pe[None].astype(input.dtype), state
+
+
+class LayerNorm(Module):
+    """Feature-axis layer normalization (pre-norm transformer blocks;
+    time-pointwise, so it composes with sequence parallelism)."""
+
+    def __init__(self, d_model: int, eps: float = 1e-5, name=None):
+        super().__init__(name)
+        self.d_model = d_model
+        self.eps = eps
+
+    def _init_params(self, rng):
+        return {"weight": jnp.ones((self.d_model,)),
+                "bias": jnp.zeros((self.d_model,))}
+
+    def apply(self, params, input, state, training=False, rng=None):
+        mean = jnp.mean(input, axis=-1, keepdims=True)
+        var = jnp.var(input, axis=-1, keepdims=True)
+        out = (input - mean) * jax.lax.rsqrt(var + self.eps)
+        return out * params["weight"] + params["bias"], state
+
+
+class _Residual(Module):
+    """x + inner(norm(x)) — pre-norm residual."""
+
+    def __init__(self, d_model: int, inner: Module, name=None):
+        super().__init__(name)
+        self.norm = LayerNorm(d_model)
+        self.inner = inner
+
+    def _init_params(self, rng):
+        k1, k2 = jax.random.split(rng)
+        return {"norm": self.norm._init_params(k1),
+                "inner": self.inner._init_params(k2)}
+
+    def _init_state(self):
+        return {"inner": self.inner._init_state()}
+
+    def modules(self):
+        return [self] + self.norm.modules() + self.inner.modules()
+
+    def apply(self, params, input, state, training=False, rng=None):
+        h, _ = self.norm.apply(params["norm"], input, {},
+                               training=training)
+        h, new_inner = self.inner.apply(params["inner"], h, state["inner"],
+                                        training=training, rng=rng)
+        return input + h, {"inner": new_inner}
+
+
+def transformer_block(d_model: int, n_head: int,
+                      ff_mult: int = 4) -> nn.Sequential:
+    """One pre-norm decoder block: causal MHA + MLP, both residual."""
+    mlp = (nn.Sequential()
+           .add(nn.Linear(d_model, ff_mult * d_model))
+           .add(nn.ReLU())
+           .add(nn.Linear(ff_mult * d_model, d_model)))
+    return (nn.Sequential()
+            .add(_Residual(d_model,
+                           nn.MultiHeadAttention(d_model, n_head,
+                                                 causal=True)))
+            .add(_Residual(d_model, mlp)))
+
+
+def transformer_lm(vocab_size: int, d_model: int = 128, n_head: int = 4,
+                   n_layers: int = 2, max_len: int = 4096) -> nn.Sequential:
+    """Token ids (B, T), 1-based -> log-probs (B, T, vocab)."""
+    m = (nn.Sequential()
+         .add(nn.LookupTable(vocab_size, d_model))
+         .add(PositionalEncoding(d_model, max_len)))
+    for _ in range(n_layers):
+        m.add(transformer_block(d_model, n_head))
+    m.add(LayerNorm(d_model))
+    m.add(nn.Linear(d_model, vocab_size))
+    m.add(nn.LogSoftMax())
+    return m

@@ -1,0 +1,107 @@
+"""Transformer language-model Train driver (beyond-reference family).
+
+Run::
+
+    python -m bigdl_tpu.models.transformer.train --synthetic 256
+    python -m bigdl_tpu.models.transformer.train -f corpus.txt --seq-len 128
+    python -m bigdl_tpu.models.transformer.train --synthetic 256 \
+        --partitions 4 --seq-parallel 2       # dp x sp mesh, ring attention
+
+With ``--seq-parallel N`` the mesh is ``(partitions, N)`` over
+``("data", "seq")``: attention runs as a ppermute ring and the time
+dimension is sharded — the long-context training path.
+"""
+
+import numpy as np
+
+import bigdl_tpu.nn as nn
+import bigdl_tpu.optim as optim
+from bigdl_tpu.dataset import Sample
+from bigdl_tpu.dataset.text import Dictionary, SentenceTokenizer
+from bigdl_tpu.models import driver_utils
+from bigdl_tpu.models.transformer import transformer_lm
+
+VOCAB = 64
+
+
+def _synthetic(n: int, seq_len: int, seed: int = 1) -> list:
+    """Learnable next-token structure: token_{t+1} = f(token_t) pattern."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        start = rng.randint(1, VOCAB + 1)
+        step = rng.randint(1, 5)
+        toks = (np.arange(seq_len + 1) * step + start) % VOCAB + 1
+        out.append(Sample(toks[:-1].astype(np.float32),
+                          toks[1:].astype(np.float32)))
+    return out
+
+
+def _load_corpus(path: str, seq_len: int):
+    with open(path, errors="ignore") as f:
+        words = next(SentenceTokenizer()(iter([f.read()])), [])
+    d = Dictionary([words], vocab_size=VOCAB - 1)
+    idx = np.asarray([d.get_index(w) + 1 for w in words], np.float32)
+    out = []
+    for i in range(0, len(idx) - seq_len - 1, seq_len):
+        out.append(Sample(idx[i:i + seq_len], idx[i + 1:i + seq_len + 1]))
+    return out
+
+
+def main(argv=None):
+    p = driver_utils.base_parser("Train a decoder-only transformer LM")
+    p.add_argument("--seq-len", type=int, default=32)
+    p.add_argument("--d-model", type=int, default=64)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--seq-parallel", type=int, default=0,
+                   help="N>1: shard time over a ('data','seq') mesh")
+    args = p.parse_args(argv)
+    driver_utils.init_logging()
+    batch = args.batch_size or 32
+
+    if args.synthetic:
+        records = _synthetic(args.synthetic, args.seq_len)
+    else:
+        records = _load_corpus(args.folder, args.seq_len)
+
+    model, method = driver_utils.load_snapshots(
+        args, lambda: transformer_lm(VOCAB, args.d_model, args.heads,
+                                     args.layers,
+                                     max_len=max(4096, args.seq_len)),
+        lambda: optim.Adam(learning_rate=args.learning_rate or 1e-3))
+
+    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                       size_average=True)
+    if args.seq_parallel > 1:
+        from bigdl_tpu.dataset import SampleToMiniBatch
+        from bigdl_tpu.dataset.dataset import ShardedDataSet
+        from bigdl_tpu.engine import Engine
+        from bigdl_tpu.parallel import DistriOptimizer
+        dp = max(1, args.partitions or 1)
+        mesh = Engine.create_mesh((dp, args.seq_parallel), ("data", "seq"))
+        ds = ShardedDataSet(records, dp).transform(
+            SampleToMiniBatch(batch, dp))
+        opt = DistriOptimizer(model, ds, crit, mesh=mesh)
+        opt.set_optim_method(method)
+        driver_utils.configure(opt, args, default_epochs=10,
+                               app_name="transformer")
+    else:
+        ds = driver_utils.make_dataset(records, args, batch)
+        opt = optim.Optimizer.create(model, ds, crit)
+        opt.set_optim_method(method)
+        driver_utils.configure(opt, args, default_epochs=10,
+                               app_name="transformer")
+    trained = opt.optimize()
+
+    # report next-token accuracy on the training set
+    x = np.stack([s.feature for s in records[:64]])
+    y = np.stack([s.label for s in records[:64]])
+    pred = np.asarray(trained.forward(x)).argmax(-1) + 1
+    acc = float((pred == y).mean())
+    print(f"Final next-token accuracy: {acc:.4f}")
+    return trained
+
+
+if __name__ == "__main__":
+    main()

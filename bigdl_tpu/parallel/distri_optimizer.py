@@ -195,6 +195,8 @@ class DistriOptimizer(Optimizer):
             batch_sharding = NamedSharding(mesh, P("data", "seq"))
             seq_size = mesh.shape["seq"]
 
+            max_seq = getattr(self, "_max_seq_len", None)
+
             def _check(x):
                 x = np.asarray(x)
                 if x.ndim < 2 or x.shape[1] % seq_size != 0:
@@ -203,6 +205,11 @@ class DistriOptimizer(Optimizer):
                         "and (N, T, ...) per-timestep targets with T "
                         f"divisible by the seq axis size {seq_size} "
                         f"(got shape {x.shape})")
+                if max_seq is not None and x.shape[1] > max_seq:
+                    raise ValueError(
+                        f"sequence length {x.shape[1]} exceeds a module's "
+                        f"position capacity {max_seq} — sharded offsets "
+                        "would silently clamp; raise max_len")
                 return x
         else:
             batch_sharding = NamedSharding(mesh, P("data"))
@@ -251,7 +258,6 @@ class DistriOptimizer(Optimizer):
         see artificial boundaries — silently wrong, so they are rejected.
         """
         import bigdl_tpu.nn as nn
-        from bigdl_tpu.nn.attention import MultiHeadAttention
         time_mixing = (nn.Recurrent, nn.BiRecurrent, nn.TemporalConvolution,
                        nn.Reverse)
         offenders = [type(m).__name__ for m in module.find_modules(time_mixing)]
@@ -261,8 +267,16 @@ class DistriOptimizer(Optimizer):
                 "the time dimension, but these modules mix information "
                 f"across time with no ring path: {sorted(set(offenders))}; "
                 "train them on a ('data',)-only mesh")
-        for m in module.find_modules(MultiHeadAttention):
-            m.set_sequence_parallel(self.seq_axis)
+        # duck-typed: MultiHeadAttention (ring path), PositionalEncoding
+        # (chunk offset), and any future seq-aware module
+        self._max_seq_len = None
+        for m in module.modules():
+            if hasattr(m, "set_sequence_parallel"):
+                m.set_sequence_parallel(self.seq_axis)
+            cap = getattr(m, "max_seq_len", None)
+            if cap is not None:
+                self._max_seq_len = (cap if self._max_seq_len is None
+                                     else min(self._max_seq_len, cap))
 
     def _eval_mesh(self):
         """Validation forwards run sharded over the training mesh (the

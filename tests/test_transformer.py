@@ -1,0 +1,130 @@
+"""Transformer LM family tests (beyond-reference; 8-device CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+import bigdl_tpu.optim as optim
+from bigdl_tpu.dataset import SampleToMiniBatch
+from bigdl_tpu.dataset.dataset import LocalDataSet, ShardedDataSet
+from bigdl_tpu.engine import Engine
+from bigdl_tpu.models.transformer import (LayerNorm, PositionalEncoding,
+                                          transformer_lm)
+from bigdl_tpu.models.transformer.train import VOCAB, _synthetic
+from bigdl_tpu.parallel import DistriOptimizer
+
+
+class TestTransformerLM:
+    def test_forward_shapes_and_logprobs(self):
+        m = transformer_lm(VOCAB, d_model=32, n_head=2, n_layers=2)
+        m.reset(jax.random.PRNGKey(0))
+        x = np.random.RandomState(0).randint(
+            1, VOCAB + 1, size=(2, 16)).astype(np.float32)
+        out = np.asarray(m.forward(x))
+        assert out.shape == (2, 16, VOCAB)
+        np.testing.assert_allclose(np.exp(out).sum(-1), 1.0, rtol=1e-4)
+
+    def test_causality(self):
+        """Changing a future token must not change earlier predictions."""
+        m = transformer_lm(VOCAB, d_model=32, n_head=2, n_layers=2)
+        m.reset(jax.random.PRNGKey(1))
+        rng = np.random.RandomState(1)
+        x = rng.randint(1, VOCAB + 1, size=(1, 12)).astype(np.float32)
+        x2 = x.copy()
+        x2[0, -1] = x2[0, -1] % VOCAB + 1      # perturb the last token
+        a = np.asarray(m.forward(x))
+        b = np.asarray(m.forward(x2))
+        np.testing.assert_allclose(a[0, :-1], b[0, :-1], atol=1e-5)
+        assert not np.allclose(a[0, -1], b[0, -1])
+
+    def test_layernorm_normalizes(self):
+        ln = LayerNorm(8)
+        ln._ensure_init()
+        x = np.random.RandomState(2).normal(5, 3, (4, 8)).astype(np.float32)
+        out = np.asarray(ln.forward(x))
+        np.testing.assert_allclose(out.mean(-1), 0.0, atol=1e-5)
+        np.testing.assert_allclose(out.std(-1), 1.0, atol=1e-2)
+
+    def test_positional_encoding_offsets_under_seq_axis(self):
+        """Each seq shard must add ITS chunk of the position table."""
+        from bigdl_tpu.parallel.all_reduce import shard_map
+        from jax.sharding import PartitionSpec as P
+        mesh = Engine.create_mesh((4,), ("seq",),
+                                  devices=jax.devices()[:4])
+        pe = PositionalEncoding(8).set_sequence_parallel("seq")
+        pe._ensure_init()
+        x = jnp.zeros((1, 16, 8))
+
+        def fn(xs):
+            out, _ = pe.apply({}, xs, {})
+            return out
+
+        sharded = shard_map(fn, mesh=mesh, in_specs=P(None, "seq"),
+                            out_specs=P(None, "seq"), check_rep=False)
+        got = np.asarray(jax.jit(sharded)(x))
+        want = np.asarray(pe.forward(x))       # unsharded reference
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_sp_training_matches_local(self):
+        """dp x sp transformer training == full-sequence local training."""
+        samples = _synthetic(16, 16, seed=5)
+        crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                           size_average=True)
+
+        def run(distributed):
+            m = transformer_lm(VOCAB, d_model=32, n_head=2, n_layers=1)
+            m.reset(jax.random.PRNGKey(3))
+            if distributed:
+                mesh = Engine.create_mesh((4, 2), ("data", "seq"))
+                ds = ShardedDataSet(samples, 4).transform(
+                    SampleToMiniBatch(16, 4))
+                opt = DistriOptimizer(m, ds, crit, mesh=mesh)
+            else:
+                ds = LocalDataSet(samples).transform(SampleToMiniBatch(16))
+                opt = optim.Optimizer.create(m, ds, crit)
+            opt.set_optim_method(optim.SGD(learning_rate=0.1))
+            opt.set_end_when(optim.max_iteration(4))
+            trained = opt.optimize()
+            w, _ = trained.get_parameters()
+            return np.asarray(w)
+
+        w_local = run(False)
+        w_sp = run(True)
+        np.testing.assert_allclose(w_sp, w_local, rtol=5e-4, atol=5e-5)
+
+    @pytest.mark.slow
+    def test_driver_learns_synthetic_pattern(self, capsys):
+        from bigdl_tpu.models.transformer import train as drv
+        drv.main(["--synthetic", "64", "--seq-len", "16", "--max-epoch", "8",
+                  "--batch-size", "16"])
+        out = capsys.readouterr().out
+        acc = float(out.strip().rsplit(" ", 1)[-1])
+        assert acc > 0.5, out
+
+
+def test_odd_d_model_positional_encoding():
+    pe = PositionalEncoding(7, max_len=16)
+    pe._ensure_init()
+    out = np.asarray(pe.forward(np.zeros((1, 5, 7), np.float32)))
+    assert out.shape == (1, 5, 7) and np.isfinite(out).all()
+
+
+def test_sp_rejects_sequence_beyond_position_capacity():
+    from bigdl_tpu.dataset import Sample
+    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                       size_average=True)
+    m = transformer_lm(VOCAB, d_model=16, n_head=2, n_layers=1, max_len=8)
+    m.reset(jax.random.PRNGKey(4))
+    rng = np.random.RandomState(0)
+    # global T=16 > max_len=8: sharded offsets would clamp silently
+    samples = [Sample(rng.randint(1, VOCAB + 1, 16).astype(np.float32),
+                      np.ones(16, np.float32)) for _ in range(8)]
+    mesh = Engine.create_mesh((4, 2), ("data", "seq"))
+    ds = ShardedDataSet(samples, 4).transform(SampleToMiniBatch(8, 4))
+    opt = DistriOptimizer(m, ds, crit, mesh=mesh)
+    opt.set_optim_method(optim.SGD(learning_rate=0.1))
+    opt.set_end_when(optim.max_iteration(1))
+    with pytest.raises(ValueError, match="position capacity"):
+        opt.optimize()
