@@ -87,10 +87,18 @@ class Engine:
         across pods.  No-op when already initialised."""
         # idempotence via jax's own distributed state: touching the backend
         # (e.g. jax.process_count()) before initialize() would pre-initialise
-        # local-only XLA and break the multi-host bring-up
-        state = getattr(jax.distributed, "global_state", None)
-        if state is not None and getattr(state, "client", None) is not None:
-            return
+        # local-only XLA and break the multi-host bring-up.  Try the public
+        # is_initialized() first; fall back to the internal client handle
+        # (jax.distributed exposed global_state publicly in some versions)
+        is_init = getattr(jax.distributed, "is_initialized", None)
+        if is_init is not None:
+            if is_init():
+                return
+        else:
+            from jax._src import distributed as _dist
+            if getattr(getattr(_dist, "global_state", None),
+                       "client", None) is not None:
+                return
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
                                    process_id=process_id)

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 import bigdl_tpu.nn as nn
-from bigdl_tpu.nn.module import Module
+from bigdl_tpu.nn.module import Container, Module, _child_rng
 
 
 class PositionalEncoding(Module):
@@ -80,31 +80,25 @@ class LayerNorm(Module):
         return out * params["weight"] + params["bias"], state
 
 
-class _Residual(Module):
-    """x + inner(norm(x)) — pre-norm residual."""
+class _Residual(Container):
+    """x + inner(norm(x)) — pre-norm residual.
+
+    A real Container (children = [norm, inner]) so child param views stay
+    adopted: sublayer ``.forward()``, ``get_parameters_table()``, and the
+    TrainSummary "Parameters" histogram walk all see the trained weights,
+    and tp_specs/sequence-parallel wiring recurse naturally."""
 
     def __init__(self, d_model: int, inner: Module, name=None):
         super().__init__(name)
-        self.norm = LayerNorm(d_model)
-        self.inner = inner
-
-    def _init_params(self, rng):
-        k1, k2 = jax.random.split(rng)
-        return {"norm": self.norm._init_params(k1),
-                "inner": self.inner._init_params(k2)}
-
-    def _init_state(self):
-        return {"inner": self.inner._init_state()}
-
-    def modules(self):
-        return [self] + self.norm.modules() + self.inner.modules()
+        self.add(LayerNorm(d_model)).add(inner)
 
     def apply(self, params, input, state, training=False, rng=None):
-        h, _ = self.norm.apply(params["norm"], input, {},
-                               training=training)
-        h, new_inner = self.inner.apply(params["inner"], h, state["inner"],
-                                        training=training, rng=rng)
-        return input + h, {"inner": new_inner}
+        norm, inner = self.children
+        h, _ = norm.apply(params[0], input, state[0], training=training)
+        h, new_inner = inner.apply(params[1], h, state[1],
+                                   training=training,
+                                   rng=_child_rng(rng, 1))
+        return input + h, [state[0], new_inner]
 
 
 def transformer_block(d_model: int, n_head: int,

@@ -83,15 +83,12 @@ def main(argv=None):
         ds = ShardedDataSet(records, dp).transform(
             SampleToMiniBatch(batch, dp))
         opt = DistriOptimizer(model, ds, crit, mesh=mesh)
-        opt.set_optim_method(method)
-        driver_utils.configure(opt, args, default_epochs=10,
-                               app_name="transformer")
     else:
         ds = driver_utils.make_dataset(records, args, batch)
         opt = optim.Optimizer.create(model, ds, crit)
-        opt.set_optim_method(method)
-        driver_utils.configure(opt, args, default_epochs=10,
-                               app_name="transformer")
+    opt.set_optim_method(method)
+    driver_utils.configure(opt, args, default_epochs=10,
+                           app_name="transformer")
     trained = opt.optimize()
 
     # report next-token accuracy on the training set

@@ -128,3 +128,15 @@ def test_sp_rejects_sequence_beyond_position_capacity():
     opt.set_end_when(optim.max_iteration(1))
     with pytest.raises(ValueError, match="position capacity"):
         opt.optimize()
+
+
+def test_residual_children_adopted():
+    """Sublayers inside residual blocks must view the container's params —
+    the TrainSummary 'Parameters' walk and direct sublayer.forward() would
+    otherwise see freshly-reset random weights."""
+    m = transformer_lm(VOCAB, d_model=16, n_head=2, n_layers=1)
+    m.reset(jax.random.PRNGKey(6))
+    mha = m.find_modules(nn.MultiHeadAttention)[0]
+    # the adopted view must BE the container's array, not a new init
+    leaves = {id(l) for l in jax.tree_util.tree_leaves(m.params)}
+    assert id(mha.params["wq"]) in leaves

@@ -117,3 +117,40 @@ def test_kth_largest():
     assert kth_largest(arr, 1) == 9
     assert kth_largest(arr, 3) == 5
     assert kth_largest(arr, 5) == 1
+
+
+def test_init_distributed_single_process_bringup():
+    """Engine.init_distributed joins the jax distributed runtime (the
+    multi-host tier) — exercised single-process in a subprocess so the
+    global coordination client cannot leak into this test session."""
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    code = f"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+from bigdl_tpu.engine import Engine
+Engine.init_distributed("127.0.0.1:{port}", 1, 0)
+Engine.init_distributed("127.0.0.1:{port}", 1, 0)   # idempotent no-op
+import jax
+assert jax.process_count() == 1
+assert jax.process_index() == 0
+print("BRINGUP_OK")
+"""
+    # strip the site hook's accelerator vars: TPU_*/PJRT_* would trigger
+    # jax's TPU cluster auto-detection and pre-init the backend
+    def _keep(k):
+        return not (k in ("JAX_PLATFORMS", "XLA_FLAGS") or
+                    k.startswith(("TPU_", "AXON_", "_AXON", "PALLAS_",
+                                  "PJRT_")))
+    env = {k: v for k, v in os.environ.items() if _keep(k)}
+    env["JAX_PLATFORMS"] = "cpu"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run([sys.executable, "-c", code], cwd=repo_root,
+                         capture_output=True, text=True, timeout=120,
+                         env=env)
+    assert "BRINGUP_OK" in out.stdout, out.stderr[-2000:]
