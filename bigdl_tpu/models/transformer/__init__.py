@@ -101,28 +101,50 @@ class _Residual(Container):
         return input + h, [state[0], new_inner]
 
 
-def transformer_block(d_model: int, n_head: int,
-                      ff_mult: int = 4) -> nn.Sequential:
-    """One pre-norm decoder block: causal MHA + MLP, both residual."""
-    mlp = (nn.Sequential()
-           .add(nn.Linear(d_model, ff_mult * d_model))
-           .add(nn.ReLU())
-           .add(nn.Linear(ff_mult * d_model, d_model)))
+def transformer_block(d_model: int, n_head: int, ff_mult: int = 4,
+                      tp: bool = False,
+                      moe_experts: int = 0) -> nn.Sequential:
+    """One pre-norm decoder block: causal MHA + MLP, both residual.
+
+    ``tp=True`` tags the MLP pair column/row for the Megatron split
+    (``parallel.tp_specs`` then shards it over the ``model`` axis; the
+    MHA head split applies automatically).  ``moe_experts=E`` replaces the
+    dense MLP with a Switch :class:`~bigdl_tpu.nn.MixtureOfExperts` of E
+    expert MLPs (expert-parallel over an ``expert`` axis via
+    ``parallel.expert_parallel``)."""
+    from bigdl_tpu.parallel.tensor_parallel import (column_parallel,
+                                                    row_parallel)
+    if moe_experts:
+        if tp:
+            raise ValueError("pick one of tp / moe_experts per block")
+        expert = (nn.Sequential()
+                  .add(nn.Linear(d_model, ff_mult * d_model))
+                  .add(nn.ReLU())
+                  .add(nn.Linear(ff_mult * d_model, d_model)))
+        ffn = nn.MixtureOfExperts(d_model, expert, moe_experts)
+    else:
+        up = nn.Linear(d_model, ff_mult * d_model)
+        down = nn.Linear(ff_mult * d_model, d_model)
+        if tp:
+            column_parallel(up)
+            row_parallel(down)
+        ffn = nn.Sequential().add(up).add(nn.ReLU()).add(down)
     return (nn.Sequential()
             .add(_Residual(d_model,
                            nn.MultiHeadAttention(d_model, n_head,
                                                  causal=True)))
-            .add(_Residual(d_model, mlp)))
+            .add(_Residual(d_model, ffn)))
 
 
 def transformer_lm(vocab_size: int, d_model: int = 128, n_head: int = 4,
-                   n_layers: int = 2, max_len: int = 4096) -> nn.Sequential:
+                   n_layers: int = 2, max_len: int = 4096,
+                   tp: bool = False) -> nn.Sequential:
     """Token ids (B, T), 1-based -> log-probs (B, T, vocab)."""
     m = (nn.Sequential()
          .add(nn.LookupTable(vocab_size, d_model))
          .add(PositionalEncoding(d_model, max_len)))
     for _ in range(n_layers):
-        m.add(transformer_block(d_model, n_head))
+        m.add(transformer_block(d_model, n_head, tp=tp))
     m.add(LayerNorm(d_model))
     m.add(nn.Linear(d_model, vocab_size))
     m.add(nn.LogSoftMax())
