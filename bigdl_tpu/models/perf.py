@@ -34,6 +34,8 @@ _MODELS = {
     "inception_v1": (lambda: _zoo("inception_v1_no_aux_classifier"),
                      (3, 224, 224), 1000),
     "resnet50": (lambda: _resnet50(), (3, 224, 224), 1000),
+    # token LM: (T,) int features, per-timestep targets (beyond-reference)
+    "transformer": (lambda: _transformer(), (128,), 1024),
 }
 
 
@@ -55,6 +57,12 @@ def _resnet50():
     return m
 
 
+def _transformer():
+    from bigdl_tpu.models.transformer import transformer_lm
+    return transformer_lm(1024, d_model=256, n_head=8, n_layers=4,
+                          max_len=128)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description="zoo throughput harness")
     p.add_argument("-m", "--model", choices=sorted(_MODELS), default="lenet5")
@@ -69,13 +77,23 @@ def main(argv=None):
     model = build()
     rng = np.random.RandomState(0)
     n_records = max(args.batch_size * 2, args.partitions * 2)
-    records = [Sample(rng.uniform(-1, 1, size=shape).astype(np.float32),
-                      np.float32(rng.randint(1, classes + 1)))
-               for _ in range(n_records)]
+    if args.model == "transformer":
+        records = [Sample(rng.randint(1, classes + 1, shape)
+                          .astype(np.float32),
+                          rng.randint(1, classes + 1, shape)
+                          .astype(np.float32))
+                   for _ in range(n_records)]
+        criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                                size_average=True)
+    else:
+        records = [Sample(rng.uniform(-1, 1, size=shape).astype(np.float32),
+                          np.float32(rng.randint(1, classes + 1)))
+                   for _ in range(n_records)]
+        criterion = nn.ClassNLLCriterion()
     ds = DataSet.array(records, args.partitions).transform(
         SampleToMiniBatch(args.batch_size, max(1, args.partitions)))
 
-    opt = optim.Optimizer.create(model, ds, nn.ClassNLLCriterion())
+    opt = optim.Optimizer.create(model, ds, criterion)
     opt.set_optim_method(optim.SGD(learning_rate=0.01, momentum=0.9))
     # warm-up run absorbs the jit compile; the timed run is steady-state
     # (the reference harness likewise reports per-iteration throughput,

@@ -26,6 +26,7 @@ _DEFAULTS: Dict[str, Any] = {
     "bigdl.check.singleton": False,
     "bigdl.summary.flushSecs": 2.0,
     "bigdl.compilation.cacheDir": None,    # jax persistent compile cache
+    "bigdl.pipeline.depth": 8,             # driver-loop dispatch pipeline
 }
 
 _OVERRIDES: Dict[str, Any] = {}
