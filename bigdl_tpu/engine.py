@@ -22,6 +22,44 @@ import jax
 import numpy as np
 
 
+class DispatchPipeline:
+    """Bounded queue of in-flight device results with async device→host
+    copies — the dispatch-pipelining idiom shared by the training driver,
+    evaluator, and predictor.
+
+    Each device round-trip (reading a loss/output) costs a full RTT when
+    the chip sits behind a network tunnel; keeping ``depth - 1`` results
+    in flight and starting the host copy at dispatch hides it.  ``depth``
+    defaults to ``bigdl.pipeline.depth`` (1 = fully synchronous).
+
+    ``drain(item, next_item_or_None)`` is called FIFO as results retire;
+    ``next_item`` peeks the queue so callers can measure inter-dispatch
+    intervals."""
+
+    def __init__(self, drain, depth: Optional[int] = None):
+        from collections import deque
+        from bigdl_tpu.utils import config
+        self.depth = max(1, depth if depth is not None
+                         else config.get_int("bigdl.pipeline.depth", 8))
+        self._drain = drain
+        self._q = deque()
+
+    def push(self, out_dev, *meta) -> None:
+        if hasattr(out_dev, "copy_to_host_async"):
+            out_dev.copy_to_host_async()
+        self._q.append((out_dev,) + meta)
+        while len(self._q) >= self.depth:
+            self._pop()
+
+    def flush(self) -> None:
+        while self._q:
+            self._pop()
+
+    def _pop(self) -> None:
+        item = self._q.popleft()
+        self._drain(item, self._q[0] if self._q else None)
+
+
 class _EngineState:
     def __init__(self):
         self.engine_type: str = "tpu"

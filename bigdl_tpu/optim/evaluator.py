@@ -67,6 +67,19 @@ def evaluate_dataset(model: Module, dataset,
         totals: List[ValidationResult] = [None] * len(methods)
         it = dataset.data(train=False) if isinstance(
             dataset, AbstractDataSet) else iter(dataset)
+        # same dispatch pipeline as the training driver: keep batches in
+        # flight with async device→host copies so each batch doesn't pay
+        # a full device round-trip (bigdl.pipeline.depth, default 8)
+        from bigdl_tpu.engine import DispatchPipeline
+
+        def drain(item, _nxt):
+            out_dev, tgt = item
+            out = np.asarray(out_dev)
+            for i, m in enumerate(methods):
+                r = m.apply(out, tgt)
+                totals[i] = r if totals[i] is None else totals[i] + r
+
+        pipeline = DispatchPipeline(drain)
         for batch in it:
             if batch_sharding is not None and batch.size() % axis_size == 0:
                 inputs = jax.tree_util.tree_map(
@@ -74,11 +87,8 @@ def evaluate_dataset(model: Module, dataset,
                     batch.get_input())
             else:
                 inputs = _to_device(batch.get_input())
-            targets = batch.get_target()
-            out = np.asarray(fwd(inputs))
-            for i, m in enumerate(methods):
-                r = m.apply(out, targets)
-                totals[i] = r if totals[i] is None else totals[i] + r
+            pipeline.push(fwd(inputs), batch.get_target())
+        pipeline.flush()
         return [(m, t) for m, t in zip(methods, totals) if t is not None]
     finally:
         if was_training:

@@ -311,20 +311,15 @@ class Optimizer:
         # always before any sync point (validation, checkpoint, end).
         # Consequence: the ``min_loss`` trigger sees the loss up to
         # `depth` iterations late.
-        from collections import deque
-        from bigdl_tpu.utils import config as _config
-        # depth 1 = fully synchronous (each loss read before the next
-        # dispatch); depth N keeps N-1 iterations in flight
-        depth = max(1, _config.get_int("bigdl.pipeline.depth", 8))
-        pending = deque()   # (loss_dev, bsz, t0_ns, epoch, recs, neval)
+        from bigdl_tpu.engine import DispatchPipeline
 
-        def flush_one():
-            loss_dev, bsz, t0, epoch, recs, neval = pending.popleft()
+        def drain(item, nxt):
+            loss_dev, bsz, t0, epoch, recs, neval = item
             loss = float(loss_dev)
             # per-iteration wall time = interval to the NEXT dispatch (the
             # flush happens up to depth-1 dispatches later, so "now - t0"
             # would overstate it depth-fold)
-            next_t0 = pending[0][2] if pending else time.time_ns()
+            next_t0 = nxt[2] if nxt is not None else time.time_ns()
             dt = max(next_t0 - t0, 1)
             self.metrics.add("computing time for each node", dt)
             state["Loss"] = loss
@@ -336,9 +331,8 @@ class Optimizer:
                 loss)
             self._summarize_train(loss, throughput, neval)
 
-        def flush_pending():
-            while pending:
-                flush_one()
+        pipeline = DispatchPipeline(drain)
+        flush_pending = pipeline.flush
 
         while not self.end_when(state):
             t_data = time.time_ns()
@@ -354,13 +348,9 @@ class Optimizer:
             t0 = time.time_ns()
             loss_dev = run_step(inputs, targets, hyper, rng)
             self.optim_method.step_done()
-            if hasattr(loss_dev, "copy_to_host_async"):
-                loss_dev.copy_to_host_async()
-            pending.append((loss_dev, bsz, t0, state["epoch"],
-                            state["recordsProcessedThisEpoch"] + bsz,
-                            state["neval"]))
-            while len(pending) >= depth:
-                flush_one()
+            pipeline.push(loss_dev, bsz, t0, state["epoch"],
+                          state["recordsProcessedThisEpoch"] + bsz,
+                          state["neval"])
 
             state["recordsProcessedThisEpoch"] += bsz
 

@@ -40,10 +40,16 @@ class Predictor:
         was_training = self.model.train_mode
         self.model.evaluate()
         try:
+            from bigdl_tpu.engine import DispatchPipeline
             fwd = _eval_forward(self.model)
+            # pipelined like evaluate_dataset: bounded in-flight batches
+            # (unbounded dispatch would pin every output in device memory)
             outs: List[np.ndarray] = []
+            pipeline = DispatchPipeline(
+                lambda item, _nxt: outs.append(np.asarray(item[0])))
             for batch in self._batches(dataset, batch_size):
-                outs.append(np.asarray(fwd(_to_device(batch.get_input()))))
+                pipeline.push(fwd(_to_device(batch.get_input())))
+            pipeline.flush()
             if not outs:
                 return np.zeros((0,))
             return np.concatenate(outs, axis=0)

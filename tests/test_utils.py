@@ -154,3 +154,29 @@ print("BRINGUP_OK")
                          capture_output=True, text=True, timeout=120,
                          env=env)
     assert "BRINGUP_OK" in out.stdout, out.stderr[-2000:]
+
+
+class TestDispatchPipeline:
+    def test_depth_one_is_synchronous(self):
+        from bigdl_tpu.engine import DispatchPipeline
+        drained = []
+        p = DispatchPipeline(lambda item, nxt: drained.append(item[0]),
+                             depth=1)
+        p.push("a")
+        assert drained == ["a"], "depth=1 must drain at every push"
+        p.push("b")
+        assert drained == ["a", "b"]
+
+    def test_bounded_in_flight_and_fifo(self):
+        from bigdl_tpu.engine import DispatchPipeline
+        drained = []
+        p = DispatchPipeline(lambda item, nxt: drained.append(
+            (item[0], None if nxt is None else nxt[0])), depth=3)
+        for v in "abcde":
+            p.push(v)
+        # depth 3 keeps 2 in flight: a/b/c drained, with next-item peeks
+        assert [d[0] for d in drained] == ["a", "b", "c"]
+        assert drained[0] == ("a", "b")
+        p.flush()
+        assert [d[0] for d in drained] == list("abcde")
+        assert drained[-1] == ("e", None)
