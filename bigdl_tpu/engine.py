@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import os
 import threading
+from collections import deque
 from typing import Optional, Sequence
 
 import jax
@@ -37,7 +38,6 @@ class DispatchPipeline:
     intervals."""
 
     def __init__(self, drain, depth: Optional[int] = None):
-        from collections import deque
         from bigdl_tpu.utils import config
         self.depth = max(1, depth if depth is not None
                          else config.get_int("bigdl.pipeline.depth", 8))

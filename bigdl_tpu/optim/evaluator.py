@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bigdl_tpu.engine import DispatchPipeline
 from bigdl_tpu.engine import to_device as _to_device
 from bigdl_tpu.dataset.dataset import AbstractDataSet
 from bigdl_tpu.dataset.sample import MiniBatch, Sample
@@ -70,8 +71,6 @@ def evaluate_dataset(model: Module, dataset,
         # same dispatch pipeline as the training driver: keep batches in
         # flight with async device→host copies so each batch doesn't pay
         # a full device round-trip (bigdl.pipeline.depth, default 8)
-        from bigdl_tpu.engine import DispatchPipeline
-
         def drain(item, _nxt):
             out_dev, tgt = item
             out = np.asarray(out_dev)

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bigdl_tpu.engine import DispatchPipeline
 from bigdl_tpu.engine import to_device as _to_device
 from bigdl_tpu.dataset.dataset import AbstractDataSet, LocalDataSet, ShardedDataSet
 from bigdl_tpu.dataset.sample import MiniBatch
@@ -311,8 +312,6 @@ class Optimizer:
         # always before any sync point (validation, checkpoint, end).
         # Consequence: the ``min_loss`` trigger sees the loss up to
         # `depth` iterations late.
-        from bigdl_tpu.engine import DispatchPipeline
-
         def drain(item, nxt):
             loss_dev, bsz, t0, epoch, recs, neval = item
             loss = float(loss_dev)

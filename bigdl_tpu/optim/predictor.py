@@ -9,6 +9,7 @@ from typing import Iterable, List
 
 import numpy as np
 
+from bigdl_tpu.engine import DispatchPipeline
 from bigdl_tpu.dataset.dataset import AbstractDataSet
 from bigdl_tpu.dataset.sample import Sample
 from bigdl_tpu.dataset.transformer import SampleToMiniBatch
@@ -40,7 +41,6 @@ class Predictor:
         was_training = self.model.train_mode
         self.model.evaluate()
         try:
-            from bigdl_tpu.engine import DispatchPipeline
             fwd = _eval_forward(self.model)
             # pipelined like evaluate_dataset: bounded in-flight batches
             # (unbounded dispatch would pin every output in device memory)

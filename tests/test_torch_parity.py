@@ -339,3 +339,60 @@ class TestCriterionParity:
         ours = float(nn.CrossEntropyCriterion().forward(logits, target))
         want = float(F.cross_entropy(_t(logits), _t(target).long() - 1))
         assert abs(ours - want) < 1e-4
+
+
+class TestRandomizedConvPoolSweep:
+    """Fuzz-style parity: random geometry configs against torch (seeded).
+    Broadens the hand-picked cases above across the kernel/stride/pad
+    space where off-by-one output-size bugs live."""
+
+    def test_conv2d_sweep(self):
+        rng = np.random.RandomState(42)
+        for trial in range(12):
+            cin = int(rng.randint(1, 5))
+            cout = int(rng.randint(1, 6))
+            kw, kh = int(rng.randint(1, 5)), int(rng.randint(1, 5))
+            dw, dh = int(rng.randint(1, 4)), int(rng.randint(1, 4))
+            pw, ph = int(rng.randint(0, 3)), int(rng.randint(0, 3))
+            h = int(rng.randint(kh + 2, 14))
+            w = int(rng.randint(kw + 2, 14))
+            m = nn.SpatialConvolution(cin, cout, kw, kh, dw, dh, pw, ph)
+            m._ensure_init()
+            x = rng.normal(size=(2, cin, h, w)).astype(np.float32)
+            ours = _np(m.forward(x))
+            tw = _t(_np(m.params["weight"]).transpose(3, 2, 0, 1))
+            want = F.conv2d(_t(x), tw, _t(m.params["bias"]),
+                            stride=(dh, dw), padding=(ph, pw)).numpy()
+            np.testing.assert_allclose(
+                ours, want, rtol=RTOL, atol=1e-4,
+                err_msg=f"trial {trial}: cin{cin} cout{cout} k({kh},{kw}) "
+                        f"s({dh},{dw}) p({ph},{pw}) in({h},{w})")
+
+    def test_pool_sweep(self):
+        rng = np.random.RandomState(7)
+        for trial in range(12):
+            k = int(rng.randint(2, 5))
+            d = int(rng.randint(1, 4))
+            p = int(rng.randint(0, (k + 1) // 2))
+            h = int(rng.randint(k + 2, 16))
+            ceil = bool(rng.randint(0, 2))
+            x = rng.normal(size=(2, 3, h, h)).astype(np.float32)
+
+            mp = nn.SpatialMaxPooling(k, k, d, d, p, p)
+            if ceil:
+                mp = mp.ceil()
+            want = F.max_pool2d(_t(x), k, d, p, ceil_mode=ceil).numpy()
+            np.testing.assert_allclose(
+                _np(mp.forward(x)), want, rtol=RTOL, atol=ATOL,
+                err_msg=f"max trial {trial}: k{k} d{d} p{p} h{h} ceil{ceil}")
+
+            include = bool(rng.randint(0, 2))
+            ap = nn.SpatialAveragePooling(k, k, d, d, p, p,
+                                          ceil_mode=ceil,
+                                          count_include_pad=include)
+            want = F.avg_pool2d(_t(x), k, d, p, ceil_mode=ceil,
+                                count_include_pad=include).numpy()
+            np.testing.assert_allclose(
+                _np(ap.forward(x)), want, rtol=RTOL, atol=1e-4,
+                err_msg=f"avg trial {trial}: k{k} d{d} p{p} h{h} "
+                        f"ceil{ceil} incl{include}")
