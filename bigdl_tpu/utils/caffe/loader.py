@@ -54,19 +54,67 @@ def _conv_geom(cp):
     return kh, kw, sh, sw, ph, pw
 
 
+# V1 LayerType enum -> V2 type string (the upgrade caffe itself performs
+# in upgrade_proto.cpp; reference handles V1 via V1LayerConverter.scala)
+_V1_TYPE = {
+    "CONCAT": "Concat", "CONVOLUTION": "Convolution", "DROPOUT": "Dropout",
+    "ELTWISE": "Eltwise", "FLATTEN": "Flatten",
+    "INNER_PRODUCT": "InnerProduct", "LRN": "LRN", "POOLING": "Pooling",
+    "RELU": "ReLU", "SIGMOID": "Sigmoid", "SOFTMAX": "Softmax",
+    "SOFTMAX_LOSS": "SoftmaxWithLoss", "SPLIT": "Split", "TANH": "TanH",
+    "DATA": "Data", "ACCURACY": "Accuracy",
+}
+_V1_PARAMS = ("concat_param", "convolution_param", "dropout_param",
+              "eltwise_param", "inner_product_param", "lrn_param",
+              "pooling_param", "softmax_param")
+
+
+def _upgrade_v1(net, strict: bool = True) -> None:
+    """Rewrite legacy ``layers`` (V1LayerParameter) into ``layer`` entries
+    so every converter below sees one format.  ``strict=False`` (the
+    weights pass) skips unsupported layer types instead of raising —
+    only blobs are read from a caffemodel, and data/solver-era layers
+    never carry blobs the importer needs."""
+    if net.layers and net.layer:
+        raise ValueError("net mixes legacy 'layers' and new 'layer' "
+                         "entries — upgrade the prototxt to one format "
+                         "(caffe's own upgrader rejects mixed nets)")
+    for v1 in net.layers:
+        tname = pb.V1LayerParameter.LayerType.Name(v1.type)
+        if tname not in _V1_TYPE:
+            if not strict:
+                continue
+            raise ValueError(f"{v1.name}: unsupported legacy layer type "
+                             f"{tname}")
+        layer = net.layer.add()
+        layer.name = v1.name
+        layer.type = _V1_TYPE[tname]
+        layer.bottom.extend(v1.bottom)
+        layer.top.extend(v1.top)
+        layer.include.extend(v1.include)
+        layer.blobs.extend(v1.blobs)
+        for p in _V1_PARAMS:
+            if v1.HasField(p):
+                getattr(layer, p).CopyFrom(getattr(v1, p))
+    del net.layers[:]
+
+
 class CaffeLoader:
-    """(reference ``CaffeLoader.scala:56``)."""
+    """(reference ``CaffeLoader.scala:56,267`` + ``V1LayerConverter.scala``:
+    legacy ``layers``-format prototxts/caffemodels are upgraded in place)."""
 
     def __init__(self, def_path: str, model_path: Optional[str] = None):
         from google.protobuf import text_format
         self.net = pb.NetParameter()
         with open(def_path) as f:
             text_format.Merge(f.read(), self.net)
+        _upgrade_v1(self.net)
         self.blobs: Dict[str, List[np.ndarray]] = {}
         if model_path:
             weights = pb.NetParameter()
             with open(model_path, "rb") as f:
                 weights.ParseFromString(f.read())
+            _upgrade_v1(weights, strict=False)
             for layer in weights.layer:
                 if layer.blobs:
                     self.blobs[layer.name] = [_blob_array(b)
@@ -95,20 +143,44 @@ class CaffeLoader:
                     if layer.bottom:
                         tops[top] = tops[layer.bottom[0]]
                 continue
-            if layer.type == "Input":
-                node = ModuleNode(nn.Identity(name=layer.name))
+            if layer.type in ("Input", "Data"):
+                # legacy DATA layers are the V1 ingest tier: each top
+                # (data/label) becomes a graph input
                 for top in layer.top:
+                    node = ModuleNode(nn.Identity(name=f"{layer.name}_{top}"))
                     tops[top] = node
-                inputs.append(node)
+                    inputs.append(node)
+                continue
+            if layer.type == "Accuracy":
+                # eval-only metric layer: no module, but its bottoms are
+                # consumed (they must not dangle into spurious outputs)
+                for b in layer.bottom:
+                    last_cons[b] = idx
+                continue
+            if layer.type == "Split":
+                # V1 explicit fan-out: all tops alias the bottom (and are
+                # produced here, so a dangling branch can be an output)
+                src = tops[layer.bottom[0]]
+                last_cons[layer.bottom[0]] = idx
+                for top in layer.top:
+                    tops[top] = src
+                    produced.append(top)
+                    last_prod[top] = idx
                 continue
             node = ModuleNode(self._convert(layer))
-            preds = [self._pred(tops, layer, i)
-                     for i in range(len(layer.bottom))]
+            bottoms = list(layer.bottom)
+            if layer.type == "SoftmaxWithLoss" and len(bottoms) > 1:
+                bottoms = bottoms[:1]       # drop the label bottom
+            preds = [self._pred(tops, layer, i, bottoms[i])
+                     for i in range(len(bottoms))]
             if preds:
                 node.inputs(*preds)
             for b in layer.bottom:
                 last_cons[b] = idx
-            for top in layer.top:
+            # the canonical pre-2014 train prototxt ends in a TOPLESS loss
+            # layer; give it a synthetic top so the net keeps an output
+            layer_tops = list(layer.top) or [layer.name]
+            for top in layer_tops:
                 tops[top] = node
                 produced.append(top)
                 last_prod[top] = idx
@@ -134,10 +206,11 @@ class CaffeLoader:
                              "consumed, or the net is input-only)")
         return Graph(inputs, out_nodes)
 
-    def _pred(self, tops, layer, i: int) -> ModuleNode:
+    def _pred(self, tops, layer, i: int,
+              bottom: Optional[str] = None) -> ModuleNode:
         """Predecessor node for bottom i, inserting a scale node for
         Eltwise SUM coefficients (a - b imports as a + (-1)*b)."""
-        node = tops[layer.bottom[i]]
+        node = tops[bottom if bottom is not None else layer.bottom[i]]
         if layer.type == "Eltwise":
             ep = layer.eltwise_param
             coeffs = list(ep.coeff)
@@ -182,6 +255,10 @@ class CaffeLoader:
             if not blobs:
                 raise ValueError(f"{name}: InnerProduct needs weights")
             w = blobs[0]                           # (out, in)
+            if w.ndim == 4:
+                # genuine V1-era caffemodels predate BlobShape and store IP
+                # weights via legacy dims (1, 1, out, in)
+                w = w.reshape(w.shape[-2], w.shape[-1])
             if ip.transpose:
                 w = w.T
             b = blobs[1].reshape(-1) if (ip.bias_term and
@@ -221,6 +298,10 @@ class CaffeLoader:
             return nn.Tanh(name=name)
         if t == "Sigmoid":
             return nn.Sigmoid(name=name)
+        if t == "SoftmaxWithLoss":
+            # inference view of the training loss head: channel softmax
+            # over the prediction bottom (the label bottom was dropped)
+            return _ChannelSoftMax(name=name)
         if t == "Softmax":
             axis = int(layer.softmax_param.axis) if layer.HasField(
                 "softmax_param") else 1

@@ -156,3 +156,143 @@ class TestCaffeRegressions:
         out = np.asarray(net.evaluate().forward(x))
         assert out.shape == (2, 6)
         assert np.all(out >= 0), "trailing in-place ReLU not applied"
+
+
+class TestLegacyV1Format:
+    """Pre-2014 `layers { type: ENUM }` prototxts/caffemodels (reference
+    ``V1LayerConverter.scala``): upgraded in place, converted by the same
+    V2 converter set."""
+
+    _PROTO = '''name: "legacy"
+layers { name: "mnist" type: DATA top: "data" top: "label"
+         include { phase: TEST } }
+layers { name: "conv1" type: CONVOLUTION bottom: "data" top: "conv1"
+         convolution_param { num_output: 2 kernel_size: 3 } }
+layers { name: "relu1" type: RELU bottom: "conv1" top: "conv1" }
+layers { name: "pool1" type: POOLING bottom: "conv1" top: "pool1"
+         pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
+layers { name: "ip1" type: INNER_PRODUCT bottom: "pool1" top: "ip1"
+         inner_product_param { num_output: 3 } }
+layers { name: "split" type: SPLIT bottom: "ip1" top: "ip1_a" top: "ip1_b" }
+layers { name: "accuracy" type: ACCURACY bottom: "ip1_a" bottom: "label" }
+layers { name: "loss" type: SOFTMAX_LOSS bottom: "ip1_b" bottom: "label"
+         top: "loss" }
+'''
+
+    def _weights(self, tmp_path):
+        import bigdl_tpu.utils.caffe.caffe_minimal_pb2 as pb
+        rng = np.random.RandomState(0)
+        net = pb.NetParameter()
+        conv = net.layers.add()
+        conv.name, conv.type = "conv1", pb.V1LayerParameter.CONVOLUTION
+        w = rng.normal(size=(2, 1, 3, 3)).astype(np.float32)
+        b = rng.normal(size=(2,)).astype(np.float32)
+        for arr in (w, b):
+            blob = conv.blobs.add()
+            blob.shape.dim.extend(arr.shape)
+            blob.data.extend(arr.ravel().tolist())
+        ip = net.layers.add()
+        ip.name, ip.type = "ip1", pb.V1LayerParameter.INNER_PRODUCT
+        # 6x6 input -> conv3 -> 4x4 -> pool2 -> 2x2 -> flatten 2*2*2=8
+        wip = rng.normal(size=(3, 8)).astype(np.float32)
+        bip = rng.normal(size=(3,)).astype(np.float32)
+        for arr in (wip, bip):
+            blob = ip.blobs.add()
+            blob.shape.dim.extend(arr.shape)
+            blob.data.extend(arr.ravel().tolist())
+        path = str(tmp_path / "legacy.caffemodel")
+        with open(path, "wb") as f:
+            f.write(net.SerializeToString())
+        return path, w, b, wip, bip
+
+    def test_v1_train_val_net_loads_and_matches_manual(self, tmp_path):
+        proto = tmp_path / "legacy.prototxt"
+        proto.write_text(self._PROTO)
+        weights, w, b, wip, bip = self._weights(tmp_path)
+        net = load_caffe(str(proto), weights)
+
+        x = np.random.RandomState(1).normal(
+            size=(1, 1, 6, 6)).astype(np.float32)
+        # graph inputs: [data, label] (DATA layer tops); label unused
+        out = np.asarray(net.evaluate().forward([x, np.zeros((1,), np.float32)]))
+
+        ref = (nn.Sequential()
+               .add(nn.SpatialConvolution(1, 2, 3, 3,
+                                          init_weight=np.transpose(w, (2, 3, 1, 0)),
+                                          init_bias=b))
+               .add(nn.ReLU())
+               .add(nn.SpatialMaxPooling(2, 2, 2, 2).ceil())
+               .add(nn.InferReshape([0, -1]))
+               .add(nn.Linear(8, 3, init_weight=np.ascontiguousarray(wip.T),
+                              init_bias=bip)))
+        logits = np.asarray(ref.evaluate().forward(x))
+        want = np.exp(logits) / np.exp(logits).sum(axis=1, keepdims=True)
+        np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+    def test_unsupported_v1_type_reports_name(self, tmp_path):
+        proto = tmp_path / "bad.prototxt"
+        proto.write_text('layers { name: "w" type: WINDOW_DATA top: "x" }\n')
+        with pytest.raises(ValueError, match="WINDOW_DATA|24"):
+            load_caffe(str(proto))
+
+    def test_topless_loss_and_legacy_4d_ip_blobs(self, tmp_path):
+        """The canonical pre-2014 train prototxt: topless SOFTMAX_LOSS and
+        BlobShape-free 4-D legacy-dim weight blobs."""
+        import bigdl_tpu.utils.caffe.caffe_minimal_pb2 as pb
+        proto = tmp_path / "legacy.prototxt"
+        proto.write_text('''name: "legacy"
+input: "data"
+input_shape { dim: 1 dim: 4 }
+layers { name: "ip1" type: INNER_PRODUCT bottom: "data" top: "ip1"
+         inner_product_param { num_output: 3 } }
+layers { name: "loss" type: SOFTMAX_LOSS bottom: "ip1" bottom: "label" }
+''')
+        rng = np.random.RandomState(2)
+        w = rng.normal(size=(3, 4)).astype(np.float32)
+        b = rng.normal(size=(3,)).astype(np.float32)
+        net = pb.NetParameter()
+        ip = net.layers.add()
+        ip.name, ip.type = "ip1", pb.V1LayerParameter.INNER_PRODUCT
+        blob = ip.blobs.add()      # legacy dims, NO BlobShape
+        blob.num, blob.channels = 1, 1
+        blob.height, blob.width = 3, 4
+        blob.data.extend(w.ravel().tolist())
+        bb = ip.blobs.add()
+        bb.num = bb.channels = bb.height = 1
+        bb.width = 3
+        bb.data.extend(b.tolist())
+        # an exotic layer in the WEIGHTS net must not block the load
+        junk = net.layers.add()
+        junk.name, junk.type = "im2col", pb.V1LayerParameter.IM2COL
+        weights = str(tmp_path / "legacy.caffemodel")
+        with open(weights, "wb") as f:
+            f.write(net.SerializeToString())
+
+        loaded = load_caffe(str(proto), weights)
+        x = rng.normal(size=(1, 4)).astype(np.float32)
+        out = np.asarray(loaded.evaluate().forward(x))
+        logits = x @ w.T + b
+        want = np.exp(logits) / np.exp(logits).sum(axis=1, keepdims=True)
+        np.testing.assert_allclose(out, want[:, :, None] if out.ndim == 3
+                                   else want, rtol=1e-4, atol=1e-5)
+
+    def test_dangling_split_branch_is_output(self, tmp_path):
+        proto = tmp_path / "split.prototxt"
+        proto.write_text('''name: "s"
+input: "data"
+input_shape { dim: 1 dim: 4 }
+layers { name: "split" type: SPLIT bottom: "data" top: "a" top: "b" }
+layers { name: "acc" type: ACCURACY bottom: "a" bottom: "a" }
+''')
+        net = load_caffe(str(proto))
+        x = np.ones((1, 4), np.float32)
+        out = np.asarray(net.evaluate().forward(x))
+        np.testing.assert_allclose(out, x)
+
+    def test_mixed_layer_formats_rejected(self, tmp_path):
+        proto = tmp_path / "mix.prototxt"
+        proto.write_text(
+            'layers { name: "c" type: CONVOLUTION top: "c" }\n'
+            'layer { name: "r" type: "ReLU" bottom: "c" top: "c" }\n')
+        with pytest.raises(ValueError, match="mixes legacy"):
+            load_caffe(str(proto))
