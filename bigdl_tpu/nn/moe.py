@@ -22,25 +22,37 @@ from bigdl_tpu.nn.module import Module
 
 
 class MixtureOfExperts(Module):
-    """Top-1 (Switch) gated mixture of ``n_experts`` homogeneous experts.
+    """Top-k gated mixture of ``n_experts`` homogeneous experts
+    (``top_k=1``: Switch; ``top_k=2``: the GShard configuration).
 
     ``expert``: a template Module mapping (tokens, d_model) -> (tokens,
     d_model); its structure is replicated per expert with independent
     parameters (stacked leaf-wise under the ``"experts"`` key).
 
-    Routing: softmax gate over experts, each token goes to its argmax
-    expert; each expert processes at most ``capacity`` tokens
-    (``ceil(tokens / n_experts * capacity_factor)``), overflow tokens pass
-    through with zero expert output (standard Switch behavior).
+    Routing: softmax gate over experts, each token goes to its ``top_k``
+    highest-gate experts with the selected gate values renormalized to
+    sum to 1 per token; each expert processes at most ``capacity`` tokens
+    per choice tier combined, with overflow contributions dropped to zero
+    (standard Switch/GShard behavior).  The Switch load-balancing
+    diagnostic ``n_experts * sum_e(token_fraction_e * mean_gate_e)``
+    (minimized at 1.0 by a uniform router) is returned in the module
+    state under ``"aux_loss"``: read it from ``model.state`` after a
+    TRAINING-mode forward (the stateful shell persists new state only in
+    train mode) or take it from ``apply``'s returned state directly; under
+    expert parallelism pass ``return_aux=True`` to
+    ``expert_parallel_apply``.
     """
 
     def __init__(self, d_model: int, expert: Module, n_experts: int,
-                 capacity_factor: float = 1.25, name=None):
+                 capacity_factor: float = 1.25, top_k: int = 1, name=None):
         super().__init__(name)
+        if not 1 <= top_k <= n_experts:
+            raise ValueError(f"top_k {top_k} must be in [1, {n_experts}]")
         self.d_model = d_model
         self.expert = expert
         self.n_experts = n_experts
         self.capacity_factor = capacity_factor
+        self.top_k = top_k
 
     def _init_params(self, rng):
         ks = jax.random.split(rng, self.n_experts + 1)
@@ -61,41 +73,67 @@ class MixtureOfExperts(Module):
                 "MixtureOfExperts experts must be stateless (no BatchNorm "
                 "running statistics) — state updates cannot be threaded "
                 "through the routed dispatch")
-        return {"expert": expert_state}
+        return {"expert": expert_state,
+                "aux_loss": jnp.zeros(())}
 
     def capacity(self, n_tokens: int) -> int:
-        """Per-expert token capacity for a dispatch over ``n_tokens``.
+        """Per-expert token capacity for a dispatch over ``n_tokens``:
+        scales with ``top_k`` (each token makes k assignments, so a
+        balanced router sends k*t/E per expert — GShard's convention).
         Under expert parallelism this applies per device shard (each shard
         routes its local tokens), so the global per-expert budget is
         n_shards * capacity(local_tokens)."""
-        return max(1, math.ceil(n_tokens / self.n_experts
+        return max(1, math.ceil(n_tokens * self.top_k / self.n_experts
                                 * self.capacity_factor))
 
     def route(self, params, flat):
-        """(tokens, d) -> (dispatch (t, E, C), combine (t, E, C)).
+        """(tokens, d) -> (dispatch (t, E, C), combine (t, E, C), aux).
 
         ``dispatch`` is the 0/1 routing tensor (token t occupies capacity
-        slot c of expert e); ``combine`` additionally carries the gate
-        probability, so ``combine @ expert_out`` is the weighted output.
+        slot c of expert e); ``combine`` additionally carries the
+        (renormalized) gate probability, so ``combine @ expert_out`` is
+        the weighted output; ``aux`` is the Switch load-balancing scalar.
         """
         t = flat.shape[0]
         cap = self.capacity(t)
         gates = jax.nn.softmax(flat @ params["gate"], axis=-1)   # (t, E)
-        expert_idx = jnp.argmax(gates, axis=-1)                  # (t,)
-        # queue bookkeeping in int32: a low-precision activation dtype
-        # (bf16 is first-class here) cannot count past 256 exactly, which
-        # would double-book capacity slots
-        onehot_i = jax.nn.one_hot(expert_idx, self.n_experts,
-                                  dtype=jnp.int32)               # (t, E)
-        pos = jnp.cumsum(onehot_i, axis=0) * onehot_i - 1        # (t, E)
-        keep = (pos >= 0) & (pos < cap)
-        slot = jax.nn.one_hot(jnp.where(keep, pos, -1), cap,
-                              dtype=flat.dtype)                  # (t, E, C)
-        onehot = onehot_i.astype(flat.dtype)
-        dispatch = slot * onehot[:, :, None]
-        gate_val = jnp.sum(gates * onehot, axis=-1)              # (t,)
-        combine = dispatch * gate_val[:, None, None]
-        return dispatch, combine
+
+        # top-k selection in one op; queue bookkeeping in int32 — a
+        # low-precision activation dtype (bf16 is first-class here) cannot
+        # count past 256 exactly, which would double-book capacity slots.
+        # Later tiers queue AFTER all earlier tiers of the same expert
+        # (GShard's ordering), via the per-expert count offset.
+        top_gates, top_idx = jax.lax.top_k(gates, self.top_k)    # (t, k)
+        counts = jnp.zeros((self.n_experts,), jnp.int32)
+        chosen_oh, chosen_slot, chosen_gate = [], [], []
+        for k in range(self.top_k):
+            oh = jax.nn.one_hot(top_idx[:, k], self.n_experts,
+                                dtype=jnp.int32)
+            pos = (jnp.cumsum(oh, axis=0) * oh - 1) + counts[None, :] * oh
+            keep = (pos >= 0) & (pos < cap) & (oh > 0)
+            slot = jax.nn.one_hot(jnp.where(keep, pos, -1), cap,
+                                  dtype=flat.dtype)              # (t, E, C)
+            chosen_oh.append(oh)
+            chosen_slot.append(slot * oh.astype(flat.dtype)[:, :, None])
+            chosen_gate.append(top_gates[:, k])                  # (t,)
+            counts = counts + jnp.sum(oh, axis=0)
+
+        # top_k=1 (Switch) scales by the raw gate probability; top_k>1
+        # renormalizes the selected gates per token (GShard)
+        gate_stack = jnp.stack(chosen_gate, axis=0)              # (k, t)
+        if self.top_k > 1:
+            denom = jnp.maximum(jnp.sum(gate_stack, axis=0), 1e-9)
+        else:
+            denom = jnp.ones_like(gate_stack[0])
+        dispatch = sum(chosen_slot)
+        combine = sum(s * (g / denom)[:, None, None]
+                      for s, g in zip(chosen_slot, gate_stack))
+
+        # Switch load-balancing diagnostic over the TOP-1 assignment
+        frac_tokens = jnp.mean(chosen_oh[0].astype(gates.dtype), axis=0)
+        mean_gate = jnp.mean(gates, axis=0)
+        aux = self.n_experts * jnp.sum(frac_tokens * mean_gate)
+        return dispatch, combine, aux
 
     def expert_forward(self, params, expert_in, state, training, rng):
         """vmapped expert application over the stacked (E, C, d) inputs."""
@@ -107,9 +145,11 @@ class MixtureOfExperts(Module):
 
     def apply(self, params, input, state, training=False, rng=None):
         flat = jnp.reshape(input, (-1, self.d_model))
-        dispatch, combine = self.route(params, flat)
+        dispatch, combine, aux = self.route(params, flat)
         expert_in = jnp.einsum("tec,td->ecd", dispatch, flat)
         expert_out = self.expert_forward(params, expert_in, state,
                                          training, rng)
         out = jnp.einsum("tec,ecd->td", combine, expert_out)
-        return jnp.reshape(out, input.shape), state
+        new_state = dict(state)
+        new_state["aux_loss"] = aux
+        return jnp.reshape(out, input.shape), new_state

@@ -38,12 +38,16 @@ def ep_shard_params(params, mesh: Mesh, axis: str = "expert"):
 
 def expert_parallel_apply(moe: MixtureOfExperts, params, x: jnp.ndarray,
                           mesh: Mesh, axis: str = "expert",
-                          training: bool = False, rng=None):
+                          training: bool = False, rng=None,
+                          return_aux: bool = False):
     """MoE forward with experts AND tokens sharded over ``axis``.
 
     ``x``: (batch, ..., d_model) with batch divisible by the axis size.
     Differentiable; gradient layouts mirror the inputs (expert grads stay
-    expert-sharded)."""
+    expert-sharded).  ``return_aux=True`` additionally returns the Switch
+    load-balancing scalar averaged over token shards (under EP — the one
+    setting where balance really matters — the per-shard diagnostic must
+    be pmeant, or it would be silently dropped)."""
     from bigdl_tpu.parallel.all_reduce import shard_map
 
     n = mesh.shape[axis]
@@ -57,7 +61,7 @@ def expert_parallel_apply(moe: MixtureOfExperts, params, x: jnp.ndarray,
 
     def shard_fn(p, xs):
         flat = jnp.reshape(xs, (-1, moe.d_model))          # local tokens
-        dispatch, combine = moe.route(p, flat)             # (t, E, C)
+        dispatch, combine, aux = moe.route(p, flat)        # (t, E, C)
         expert_in = jnp.einsum("tec,td->ecd", dispatch, flat)
         # exchange queues: split the expert dim across devices, gather the
         # capacity dim — each device ends up with (E/n, n*C, d): every
@@ -69,9 +73,10 @@ def expert_parallel_apply(moe: MixtureOfExperts, params, x: jnp.ndarray,
         out = lax.all_to_all(out, axis, split_axis=1, concat_axis=0,
                              tiled=True)                   # (E, C, d)
         y = jnp.einsum("tec,ecd->td", combine, out)
-        return jnp.reshape(y, xs.shape)
+        return jnp.reshape(y, xs.shape), lax.pmean(aux, axis)
 
     fn = shard_map(shard_fn, mesh=mesh,
                    in_specs=({"gate": P(), "experts": P(axis)}, P(axis)),
-                   out_specs=P(axis), check_rep=False)
-    return fn(params, x)
+                   out_specs=(P(axis), P()), check_rep=False)
+    y, aux = fn(params, x)
+    return (y, aux) if return_aux else y

@@ -28,7 +28,7 @@ class TestMixtureOfExperts:
         moe = _moe(capacity_factor=0.5)       # force drops
         x = jnp.asarray(np.random.RandomState(0)
                         .normal(size=(16, D)).astype(np.float32))
-        dispatch, combine = moe.route(moe.params, x)
+        dispatch, combine, aux = moe.route(moe.params, x)
         # each token occupies at most one (expert, slot)
         per_token = np.asarray(jnp.sum(dispatch, axis=(1, 2)))
         assert set(np.unique(per_token)) <= {0.0, 1.0}
@@ -128,6 +128,95 @@ def test_routing_bookkeeping_survives_bf16():
     x = jnp.asarray(np.random.RandomState(5)
                     .normal(size=(600, D)).astype(np.float32)
                     ).astype(jnp.bfloat16)
-    dispatch, _ = moe.route(moe.params, x)
+    dispatch, _, _ = moe.route(moe.params, x)
     per_slot = np.asarray(jnp.sum(dispatch.astype(jnp.float32), axis=0))
     assert per_slot.max() <= 1.0, "capacity slot double-booked"
+
+
+class TestTopK:
+    def test_top2_routes_to_two_experts_with_renormalized_gates(self):
+        moe = _moe()
+        moe.top_k = 2
+        rng = np.random.RandomState(6)
+        x = jnp.asarray(rng.normal(size=(10, D)).astype(np.float32))
+        dispatch, combine, _ = moe.route(moe.params, x)
+        # every token occupies exactly two (expert, slot) cells
+        per_token = np.asarray(jnp.sum(dispatch, axis=(1, 2)))
+        np.testing.assert_allclose(per_token, 2.0)
+        # combine weights renormalize to 1 per token
+        w_token = np.asarray(jnp.sum(combine, axis=(1, 2)))
+        np.testing.assert_allclose(w_token, 1.0, rtol=1e-5)
+        # the two chosen experts are the top-2 gates
+        gates = np.asarray(jax.nn.softmax(x @ moe.params["gate"], axis=-1))
+        chosen = np.asarray(jnp.sum(dispatch, axis=2))           # (t, E)
+        for t in range(10):
+            top2 = set(np.argsort(gates[t])[::-1][:2])
+            assert set(np.nonzero(chosen[t])[0]) == top2
+
+    def test_top2_forward_matches_manual_blend(self):
+        moe = _moe(seed=11)
+        moe.top_k = 2
+        rng = np.random.RandomState(7)
+        x = jnp.asarray(rng.normal(size=(6, D)).astype(np.float32))
+        out = np.asarray(moe.forward(x))
+        p = moe.params
+        gates = np.asarray(jax.nn.softmax(x @ p["gate"], axis=-1))
+        for t in range(6):
+            top2 = np.argsort(gates[t])[::-1][:2]
+            g = gates[t, top2] / gates[t, top2].sum()
+            want = 0.0
+            for e, gv in zip(top2, g):
+                ep = jax.tree_util.tree_map(lambda a, e=e: a[e], p["experts"])
+                y, _ = moe.expert.apply(ep, x[t:t + 1], moe.state["expert"])
+                want = want + gv * np.asarray(y[0])
+            np.testing.assert_allclose(out[t], want, rtol=1e-4, atol=1e-5)
+
+    def test_aux_loss_in_state_and_uniform_floor(self):
+        moe = _moe()
+        x = np.random.RandomState(8).normal(size=(64, D)).astype(np.float32)
+        _, new_state = moe.apply(moe.params, jnp.asarray(x), moe.state)
+        aux = float(new_state["aux_loss"])
+        # uniform router floor is 1.0; any routing stays >= ~1
+        assert aux >= 0.99, aux
+
+    def test_top_k_bounds(self):
+        with pytest.raises(ValueError, match="top_k"):
+            MixtureOfExperts(D, nn.Linear(D, D), E, top_k=E + 1)
+
+    def test_ep_parity_with_top2(self):
+        mesh = Engine.create_mesh((N_DEV,), ("expert",),
+                                  devices=jax.devices()[:N_DEV])
+        moe = _moe(capacity_factor=8.0, seed=13)
+        moe.top_k = 2
+        x = jnp.asarray(np.random.RandomState(9)
+                        .normal(size=(16, D)).astype(np.float32))
+        want = np.asarray(moe.forward(x))
+        params = ep_shard_params(moe.params, mesh)
+        got = np.asarray(expert_parallel_apply(moe, params, x, mesh))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_capacity_scales_with_top_k():
+    moe1 = _moe(capacity_factor=1.25)
+    moe2 = _moe(capacity_factor=1.25)
+    moe2.top_k = 2
+    assert moe2.capacity(64) == 2 * moe1.capacity(64)
+    # default capacity must not systematically drop top-2 assignments
+    # under near-uniform routing
+    rng = np.random.RandomState(10)
+    x = jnp.asarray(rng.normal(size=(64, D)).astype(np.float32) * 0.01)
+    dispatch, _, _ = moe2.route(moe2.params, x)
+    per_token = np.asarray(jnp.sum(dispatch, axis=(1, 2)))
+    assert per_token.mean() > 1.9, "top-2 assignments dropped at default cf"
+
+
+def test_ep_returns_pmeant_aux():
+    mesh = Engine.create_mesh((N_DEV,), ("expert",),
+                              devices=jax.devices()[:N_DEV])
+    moe = _moe(capacity_factor=8.0)
+    x = jnp.asarray(np.random.RandomState(11)
+                    .normal(size=(16, D)).astype(np.float32))
+    params = ep_shard_params(moe.params, mesh)
+    y, aux = expert_parallel_apply(moe, params, x, mesh, return_aux=True)
+    assert y.shape == (16, D)
+    assert float(aux) >= 0.99
