@@ -55,6 +55,25 @@ def tree_add(a, b):
     return jax.tree_util.tree_map(jnp.add, a, b)
 
 
+# state keys that carry per-forward diagnostics (e.g. MoE's load-balance
+# scalar) rather than cross-step semantics like BatchNorm running stats —
+# guards that require "stateless" modules must ignore these
+DIAGNOSTIC_STATE_KEYS = ("aux_loss",)
+
+
+def semantic_state_leaves(state):
+    """State leaves excluding per-forward diagnostics: the leaves whose
+    values must actually thread across steps."""
+    def strip(s):
+        if isinstance(s, dict):
+            return {k: strip(v) for k, v in s.items()
+                    if k not in DIAGNOSTIC_STATE_KEYS}
+        if isinstance(s, (list, tuple)):
+            return [strip(v) for v in s]
+        return s
+    return jax.tree_util.tree_leaves(strip(state))
+
+
 def _child_rng(rng, i: int):
     return None if rng is None else jax.random.fold_in(rng, i)
 

@@ -67,8 +67,9 @@ class MixtureOfExperts(Module):
     def _init_state(self):
         # experts must be stateless: per-expert running statistics are not
         # threaded through the vmapped dispatch (guarded in expert_forward)
+        from bigdl_tpu.nn.module import semantic_state_leaves
         expert_state = self.expert._init_state()
-        if jax.tree_util.tree_leaves(expert_state):
+        if semantic_state_leaves(expert_state):
             raise ValueError(
                 "MixtureOfExperts experts must be stateless (no BatchNorm "
                 "running statistics) — state updates cannot be threaded "
@@ -105,7 +106,8 @@ class MixtureOfExperts(Module):
         # (GShard's ordering), via the per-expert count offset.
         top_gates, top_idx = jax.lax.top_k(gates, self.top_k)    # (t, k)
         counts = jnp.zeros((self.n_experts,), jnp.int32)
-        chosen_oh, chosen_slot, chosen_gate = [], [], []
+        chosen_slot, chosen_gate = [], []
+        top1_oh = None                      # tier-0 assignment, for aux
         for k in range(self.top_k):
             oh = jax.nn.one_hot(top_idx[:, k], self.n_experts,
                                 dtype=jnp.int32)
@@ -113,7 +115,8 @@ class MixtureOfExperts(Module):
             keep = (pos >= 0) & (pos < cap) & (oh > 0)
             slot = jax.nn.one_hot(jnp.where(keep, pos, -1), cap,
                                   dtype=flat.dtype)              # (t, E, C)
-            chosen_oh.append(oh)
+            if top1_oh is None:
+                top1_oh = oh
             chosen_slot.append(slot * oh.astype(flat.dtype)[:, :, None])
             chosen_gate.append(top_gates[:, k])                  # (t,)
             counts = counts + jnp.sum(oh, axis=0)
@@ -130,7 +133,7 @@ class MixtureOfExperts(Module):
                       for s, g in zip(chosen_slot, gate_stack))
 
         # Switch load-balancing diagnostic over the TOP-1 assignment
-        frac_tokens = jnp.mean(chosen_oh[0].astype(gates.dtype), axis=0)
+        frac_tokens = jnp.mean(top1_oh.astype(gates.dtype), axis=0)
         mean_gate = jnp.mean(gates, axis=0)
         aux = self.n_experts * jnp.sum(frac_tokens * mean_gate)
         return dispatch, combine, aux

@@ -73,10 +73,13 @@ def expert_parallel_apply(moe: MixtureOfExperts, params, x: jnp.ndarray,
         out = lax.all_to_all(out, axis, split_axis=1, concat_axis=0,
                              tiled=True)                   # (E, C, d)
         y = jnp.einsum("tec,ecd->td", combine, out)
-        return jnp.reshape(y, xs.shape), lax.pmean(aux, axis)
+        y = jnp.reshape(y, xs.shape)
+        if return_aux:
+            return y, lax.pmean(aux, axis)
+        return y
 
+    out_specs = (P(axis), P()) if return_aux else P(axis)
     fn = shard_map(shard_fn, mesh=mesh,
                    in_specs=({"gate": P(), "experts": P(axis)}, P(axis)),
-                   out_specs=(P(axis), P()), check_rep=False)
-    y, aux = fn(params, x)
-    return (y, aux) if return_aux else y
+                   out_specs=out_specs, check_rep=False)
+    return fn(params, x)

@@ -23,7 +23,7 @@ Usage::
 
 from __future__ import annotations
 
-from typing import List
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -52,7 +52,8 @@ def pipeline_shard_params(stacked, mesh: Mesh, axis: str = "stage"):
 
 
 def _check_block(block: Module) -> None:
-    state_leaves = jax.tree_util.tree_leaves(block.state)
+    from bigdl_tpu.nn.module import semantic_state_leaves
+    state_leaves = semantic_state_leaves(block.state)
     if state_leaves:
         raise ValueError(
             "pipeline blocks must be stateless (no BatchNorm running "
@@ -61,18 +62,31 @@ def _check_block(block: Module) -> None:
 
 
 def pipeline_apply(block: Module, stacked_params, x: jnp.ndarray,
-                   n_micro: int, mesh: Mesh, axis: str = "stage"):
+                   n_micro: int, mesh: Mesh, axis: str = "stage",
+                   data_axis: Optional[str] = None):
     """Run the S-stage pipeline over ``x`` (batch, ...) and return the
-    final-stage output for the whole batch, replicated.
+    final-stage output for the whole batch, replicated over stages.
 
     ``x`` is split into ``n_micro`` microbatches along dim 0; at steady
     state all S stages work on different microbatches concurrently.
     Differentiable end-to-end: wrap in a loss and ``jax.grad`` — per-stage
     weight gradients come back with the same (S, ...) stage-sharded layout.
+
+    ``data_axis``: pp x dp composition on a 2-D mesh (e.g.
+    ``("data", "stage")``): the batch additionally shards over
+    ``data_axis`` (each data replica runs its own pipeline over its batch
+    shard; ``n_micro`` applies per shard), stage params replicate across
+    data replicas, and autodiff inserts the gradient psum over ``data``
+    via the replicated-in transpose — one jax.grad covers both axes.
     """
     from bigdl_tpu.parallel.all_reduce import shard_map
 
     n_stages = mesh.shape[axis]
+    if data_axis is not None:
+        n_data = mesh.shape[data_axis]
+        if x.shape[0] % n_data != 0:
+            raise ValueError(f"batch {x.shape[0]} must divide by the "
+                             f"'{data_axis}' axis size {n_data}")
     _check_block(block)
     for leaf in jax.tree_util.tree_leaves(stacked_params):
         if leaf.shape[0] != n_stages:
@@ -80,15 +94,18 @@ def pipeline_apply(block: Module, stacked_params, x: jnp.ndarray,
                 f"stacked params carry {leaf.shape[0]} stages but the "
                 f"'{axis}' axis has {n_stages} devices — with a mismatch "
                 "each device would silently run only its first local stage")
-    if n_micro < 1 or x.shape[0] % n_micro != 0:
-        raise ValueError(f"batch {x.shape[0]} not divisible into "
-                         f"{n_micro} microbatches")
-    mb = x.shape[0] // n_micro
-    xm = x.reshape((n_micro, mb) + x.shape[1:])
+    local_batch = x.shape[0] // (mesh.shape[data_axis]
+                                 if data_axis is not None else 1)
+    if n_micro < 1 or local_batch % n_micro != 0:
+        raise ValueError(f"per-replica batch {local_batch} not divisible "
+                         f"into {n_micro} microbatches")
+    mb = local_batch // n_micro
     state = block.state
     perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
 
     def shard_fn(stage_p, xs):
+        # xs is this data replica's batch shard; microbatch it locally
+        xs = xs.reshape((n_micro, mb) + xs.shape[1:])
         sp = jax.tree_util.tree_map(lambda a: a[0], stage_p)  # my stage
         idx = lax.axis_index(axis)
 
@@ -109,10 +126,10 @@ def pipeline_apply(block: Module, stacked_params, x: jnp.ndarray,
         outs = lax.psum(
             jnp.where(idx == n_stages - 1, outs, jnp.zeros_like(outs)),
             axis)
-        return outs
+        return outs.reshape((n_micro * mb,) + outs.shape[2:])
 
+    x_spec = P(data_axis) if data_axis is not None else P()
     fn = shard_map(shard_fn, mesh=mesh,
-                   in_specs=(P(axis), P()), out_specs=P(),
+                   in_specs=(P(axis), x_spec), out_specs=x_spec,
                    check_rep=False)
-    outs = fn(stacked_params, xm)
-    return outs.reshape((n_micro * mb,) + outs.shape[2:])
+    return fn(stacked_params, x)
