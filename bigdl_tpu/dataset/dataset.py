@@ -61,8 +61,9 @@ class LocalDataSet(AbstractDataSet):
     def size(self) -> int:
         return len(self.records)
 
-    def shuffle(self) -> None:
-        RandomGenerator.RNG().shuffle(self.index)
+    def shuffle(self, rng=None) -> None:
+        (rng if rng is not None else RandomGenerator.RNG()).shuffle(
+            self.index)
 
     def transform(self, transformer: Transformer) -> "LocalDataSet":
         ds = LocalDataSet.__new__(LocalDataSet)
@@ -96,42 +97,82 @@ class ShardedDataSet(AbstractDataSet):
 
     ``data(train=True)`` yields per-shard iterators via :meth:`shard_data`;
     the distributed optimizer zips shard streams into one global step.
+
+    Multi-host: pass ``local_partitions`` (the data-axis partition ids this
+    process's devices own — :func:`bigdl_tpu.parallel.distri_optimizer.
+    local_data_partitions` computes them from the mesh) and only those
+    shards are materialized; every process constructs the SAME logical
+    dataset (same ``records`` order, same ``partition_num``) but holds just
+    its slice — the reference keeps per-partition records on the executor
+    that owns the partition (``dataset/DataSet.scala:240-314``), never the
+    whole set on one node.  ``size()``/``shuffle()`` stay globally
+    consistent (size counts all partitions; the shared shuffle seed keeps
+    shard index permutations aligned across processes).
     """
 
     def __init__(self, records: Sequence[Any], partition_num: int,
-                 transformers: Optional[List[Transformer]] = None):
+                 transformers: Optional[List[Transformer]] = None,
+                 local_partitions: Optional[Sequence[int]] = None):
         self.partition_num = partition_num
         n = len(records)
         if n < partition_num:
             raise ValueError(f"{n} records < {partition_num} partitions")
+        if local_partitions is None:
+            local_partitions = range(partition_num)
+        self.local_partitions = sorted(set(local_partitions))
+        if not self.local_partitions or not all(
+                0 <= p < partition_num for p in self.local_partitions):
+            raise ValueError(
+                f"local_partitions {self.local_partitions} must be a "
+                f"non-empty subset of range({partition_num})")
         # round-robin assignment keeps shard sizes within 1 of each other,
         # then truncate to equal size (static shapes for XLA)
-        per = n // partition_num
-        self.shards: List[LocalDataSet] = []
-        for p in range(partition_num):
-            recs = [records[i] for i in range(p, per * partition_num,
+        self._per = n // partition_num
+        self._shuffle_round = [0]      # shared across transform() views
+        self.shards: dict = {}
+        for p in self.local_partitions:
+            recs = [records[i] for i in range(p, self._per * partition_num,
                                               partition_num)]
-            self.shards.append(LocalDataSet(recs, transformers))
+            self.shards[p] = LocalDataSet(recs, transformers)
 
     def size(self) -> int:
-        return sum(s.size() for s in self.shards)
+        """GLOBAL record count (all partitions, held locally or not) — the
+        trainer's epoch accounting must agree across processes."""
+        return self._per * self.partition_num
 
     def shuffle(self) -> None:
-        for s in self.shards:
-            s.shuffle()
+        """Per-shard permutations seeded by (global seed, round, partition
+        id) — independent of which process holds the shard or how many
+        shards are local, so every multi-host process derives the SAME
+        epoch order (the reference keeps per-partition RNGs on the
+        executors for the same reason, ``dataset/DataSet.scala:262``)."""
+        base = RandomGenerator.RNG().get_seed()
+        self._shuffle_round[0] += 1
+        rnd = self._shuffle_round[0]
+        for p, s in self.shards.items():
+            seed = (base + 0x9E3779B1 * rnd + 7919 * p) % (2 ** 32)
+            s.shuffle(np.random.RandomState(seed))
 
     def transform(self, transformer: Transformer) -> "ShardedDataSet":
         ds = ShardedDataSet.__new__(ShardedDataSet)
         ds.partition_num = self.partition_num
-        ds.shards = [s.transform(transformer) for s in self.shards]
+        ds.local_partitions = self.local_partitions
+        ds._per = self._per
+        ds._shuffle_round = self._shuffle_round
+        ds.shards = {p: s.transform(transformer)
+                     for p, s in self.shards.items()}
         return ds
 
     def shard_data(self, shard: int, train: bool) -> Iterator:
+        if shard not in self.shards:
+            raise ValueError(
+                f"partition {shard} is not local to this process "
+                f"(local_partitions={self.local_partitions})")
         return self.shards[shard].data(train)
 
     def data(self, train: bool) -> Iterator:
-        """Interleaved global stream (eval convenience)."""
-        its = [s.data(train) for s in self.shards]
+        """Interleaved stream over the LOCAL partitions (eval convenience)."""
+        its = [self.shards[p].data(train) for p in self.local_partitions]
         if train:
             while True:
                 for it in its:

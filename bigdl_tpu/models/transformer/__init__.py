@@ -103,7 +103,9 @@ class _Residual(Container):
 
 def transformer_block(d_model: int, n_head: int, ff_mult: int = 4,
                       tp: bool = False,
-                      moe_experts: int = 0) -> nn.Sequential:
+                      moe_experts: int = 0,
+                      moe_capacity_factor: float = 1.25,
+                      moe_top_k: int = 1) -> nn.Sequential:
     """One pre-norm decoder block: causal MHA + MLP, both residual.
 
     ``tp=True`` tags the MLP pair column/row for the Megatron split
@@ -111,7 +113,10 @@ def transformer_block(d_model: int, n_head: int, ff_mult: int = 4,
     MHA head split applies automatically).  ``moe_experts=E`` replaces the
     dense MLP with a Switch :class:`~bigdl_tpu.nn.MixtureOfExperts` of E
     expert MLPs (expert-parallel over an ``expert`` axis via
-    ``parallel.expert_parallel``)."""
+    ``parallel.expert_parallel``); ``moe_capacity_factor`` /
+    ``moe_top_k`` pass through (capacity_factor >= E/top_k makes routing
+    drop-free and thus microbatch-invariant — see the MoE class
+    docstring)."""
     from bigdl_tpu.parallel.tensor_parallel import (column_parallel,
                                                     row_parallel)
     if moe_experts:
@@ -121,7 +126,9 @@ def transformer_block(d_model: int, n_head: int, ff_mult: int = 4,
                   .add(nn.Linear(d_model, ff_mult * d_model))
                   .add(nn.ReLU())
                   .add(nn.Linear(ff_mult * d_model, d_model)))
-        ffn = nn.MixtureOfExperts(d_model, expert, moe_experts)
+        ffn = nn.MixtureOfExperts(d_model, expert, moe_experts,
+                                  capacity_factor=moe_capacity_factor,
+                                  top_k=moe_top_k)
     else:
         up = nn.Linear(d_model, ff_mult * d_model)
         down = nn.Linear(ff_mult * d_model, d_model)
@@ -138,14 +145,42 @@ def transformer_block(d_model: int, n_head: int, ff_mult: int = 4,
 
 def transformer_lm(vocab_size: int, d_model: int = 128, n_head: int = 4,
                    n_layers: int = 2, max_len: int = 4096,
-                   tp: bool = False) -> nn.Sequential:
-    """Token ids (B, T), 1-based -> log-probs (B, T, vocab)."""
+                   tp: bool = False, moe_experts: int = 0) -> nn.Sequential:
+    """Token ids (B, T), 1-based -> log-probs (B, T, vocab).
+
+    ``moe_experts=E`` makes every block's FFN a Switch MoE (train on a
+    ``("data", "expert")`` mesh for expert parallelism — the driver's
+    ``--expert-parallel``); ``tp=True`` tags Megatron splits (train on a
+    ``("data", "model")`` mesh — ``--tensor-parallel``)."""
     m = (nn.Sequential()
          .add(nn.LookupTable(vocab_size, d_model))
          .add(PositionalEncoding(d_model, max_len)))
     for _ in range(n_layers):
-        m.add(transformer_block(d_model, n_head, tp=tp))
+        m.add(transformer_block(d_model, n_head, tp=tp,
+                                moe_experts=moe_experts))
     m.add(LayerNorm(d_model))
     m.add(nn.Linear(d_model, vocab_size))
     m.add(nn.LogSoftMax())
     return m
+
+
+def transformer_lm_pipeline(vocab_size: int, d_model: int = 128,
+                            n_head: int = 4, n_layers: int = 2,
+                            max_len: int = 4096, moe_experts: int = 0):
+    """``(embed, blocks, head)`` for
+    :class:`~bigdl_tpu.parallel.pipeline.PipelineOptimizer`: the embedding
+    and LM head run replicated, the ``n_layers`` homogeneous decoder
+    blocks pipeline over a ``stage`` mesh axis (one block per stage
+    device — the driver's ``--pipeline``).  ``moe_experts=E`` gives every
+    block a Switch-MoE FFN; the pipeline trainer folds the collected
+    ``aux_loss`` into its objective (``pipeline_apply(return_aux=True)``)."""
+    embed = (nn.Sequential()
+             .add(nn.LookupTable(vocab_size, d_model))
+             .add(PositionalEncoding(d_model, max_len)))
+    blocks = [transformer_block(d_model, n_head, moe_experts=moe_experts)
+              for _ in range(n_layers)]
+    head = (nn.Sequential()
+            .add(LayerNorm(d_model))
+            .add(nn.Linear(d_model, vocab_size))
+            .add(nn.LogSoftMax()))
+    return embed, blocks, head

@@ -55,23 +55,61 @@ def tree_add(a, b):
     return jax.tree_util.tree_map(jnp.add, a, b)
 
 
-# state keys that carry per-forward diagnostics (e.g. MoE's load-balance
-# scalar) rather than cross-step semantics like BatchNorm running stats —
-# guards that require "stateless" modules must ignore these
-DIAGNOSTIC_STATE_KEYS = ("aux_loss",)
+def semantic_state_leaves(module, state=None):
+    """State leaves of ``module`` excluding per-forward diagnostics: the
+    leaves whose values must actually thread across steps.
+
+    A module opts its OWN top-level state keys out by declaring them in
+    ``diagnostic_state_keys`` (e.g. MixtureOfExperts' load-balance scalar
+    ``aux_loss``) — the exclusion is scoped to that module, not a global
+    key-name blocklist, so an unrelated module storing genuine cross-step
+    state under the same name still trips "stateless" guards.  Modules
+    that nest another module's state under a key (MoE's ``"expert"``)
+    declare the mapping in ``state_children`` so the walk recurses with
+    the right owner.  ``state`` overrides the module's live state (used
+    to check a freshly built sub-state before it is installed)."""
+    if state is None:
+        module._ensure_init()
+        state = module.state
+    if isinstance(module, Container):
+        return [leaf for child, s in zip(module.children, state)
+                for leaf in semantic_state_leaves(child, s)]
+    if isinstance(state, dict):
+        sub = getattr(module, "state_children", {}) or {}
+        diag = getattr(module, "diagnostic_state_keys", ()) or ()
+        out = []
+        for k, v in state.items():
+            if k in diag:
+                continue
+            if k in sub:
+                out.extend(semantic_state_leaves(sub[k], v))
+            else:
+                out.extend(jax.tree_util.tree_leaves(v))
+        return out
+    return jax.tree_util.tree_leaves(state)
 
 
-def semantic_state_leaves(state):
-    """State leaves excluding per-forward diagnostics: the leaves whose
-    values must actually thread across steps."""
-    def strip(s):
-        if isinstance(s, dict):
-            return {k: strip(v) for k, v in s.items()
-                    if k not in DIAGNOSTIC_STATE_KEYS}
-        if isinstance(s, (list, tuple)):
-            return [strip(v) for v in s]
-        return s
-    return jax.tree_util.tree_leaves(strip(state))
+def collect_diagnostics(module, state, key: str):
+    """Collect every DECLARED per-forward diagnostic named ``key`` from a
+    state tree, walking modules in parallel (same ownership rules as
+    :func:`semantic_state_leaves`).  Trainers use this to fold MoE's
+    ``aux_loss`` load-balancing term into the objective — only modules
+    that declared the key contribute, so an unrelated state entry with
+    the same name is never swept into the loss."""
+    out = []
+    if isinstance(module, Container):
+        for child, s in zip(module.children, state):
+            out.extend(collect_diagnostics(child, s, key))
+        return out
+    if isinstance(state, dict):
+        diag = getattr(module, "diagnostic_state_keys", ()) or ()
+        sub = getattr(module, "state_children", {}) or {}
+        if key in diag and key in state:
+            out.append(state[key])
+        for k, v in state.items():
+            if k in sub:
+                out.extend(collect_diagnostics(sub[k], v, key))
+    return out
 
 
 def _child_rng(rng, i: int):

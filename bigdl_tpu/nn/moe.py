@@ -33,7 +33,20 @@ class MixtureOfExperts(Module):
     highest-gate experts with the selected gate values renormalized to
     sum to 1 per token; each expert processes at most ``capacity`` tokens
     per choice tier combined, with overflow contributions dropped to zero
-    (standard Switch/GShard behavior).  The Switch load-balancing
+    (standard Switch/GShard behavior).
+
+    **Batch-split semantics.** Capacity-overflow dropping is a property of
+    which tokens *compete* for the same expert slots, so any execution
+    that splits a batch into independent forwards — GPipe microbatching
+    (``parallel.pipeline``), expert-parallel token shards
+    (``parallel.expert_parallel``), gradient accumulation — routes each
+    split with its *own* capacity budget.  When capacity binds, the result
+    therefore differs from a monolithic full-batch forward (different
+    tokens drop); the two agree exactly whenever no token drops.  Pass
+    ``capacity=`` to pin the per-expert, per-forward budget explicitly
+    (e.g. capacity sized to the microbatch), or raise ``capacity_factor``
+    to ``n_experts / top_k`` to make dropping impossible and the layer
+    batch-split-invariant.  The Switch load-balancing
     diagnostic ``n_experts * sum_e(token_fraction_e * mean_gate_e)``
     (minimized at 1.0 by a uniform router) is returned in the module
     state under ``"aux_loss"``: read it from ``model.state`` after a
@@ -44,15 +57,21 @@ class MixtureOfExperts(Module):
     """
 
     def __init__(self, d_model: int, expert: Module, n_experts: int,
-                 capacity_factor: float = 1.25, top_k: int = 1, name=None):
+                 capacity_factor: float = 1.25, top_k: int = 1,
+                 capacity: Optional[int] = None, name=None):
         super().__init__(name)
         if not 1 <= top_k <= n_experts:
             raise ValueError(f"top_k {top_k} must be in [1, {n_experts}]")
+        if capacity is not None and capacity < 1:
+            raise ValueError(f"capacity {capacity} must be >= 1")
         self.d_model = d_model
         self.expert = expert
         self.n_experts = n_experts
         self.capacity_factor = capacity_factor
         self.top_k = top_k
+        self.fixed_capacity = capacity
+        self.expert_parallel = None     # axis name once wired
+        self._ep_shards = 1
 
     def _init_params(self, rng):
         ks = jax.random.split(rng, self.n_experts + 1)
@@ -64,12 +83,21 @@ class MixtureOfExperts(Module):
                                          *per_expert)
         return {"gate": gate, "experts": stacked}
 
+    # aux_loss is a per-forward diagnostic, not cross-step state; the
+    # expert's state nests under "expert" and is owned by self.expert
+    # (see module.semantic_state_leaves)
+    diagnostic_state_keys = ("aux_loss",)
+
+    @property
+    def state_children(self):
+        return {"expert": self.expert}
+
     def _init_state(self):
         # experts must be stateless: per-expert running statistics are not
         # threaded through the vmapped dispatch (guarded in expert_forward)
         from bigdl_tpu.nn.module import semantic_state_leaves
         expert_state = self.expert._init_state()
-        if semantic_state_leaves(expert_state):
+        if semantic_state_leaves(self.expert, expert_state):
             raise ValueError(
                 "MixtureOfExperts experts must be stateless (no BatchNorm "
                 "running statistics) — state updates cannot be threaded "
@@ -81,9 +109,13 @@ class MixtureOfExperts(Module):
         """Per-expert token capacity for a dispatch over ``n_tokens``:
         scales with ``top_k`` (each token makes k assignments, so a
         balanced router sends k*t/E per expert — GShard's convention).
-        Under expert parallelism this applies per device shard (each shard
-        routes its local tokens), so the global per-expert budget is
-        n_shards * capacity(local_tokens)."""
+        A ``capacity=`` constructor override pins this regardless of the
+        forward's token count (stable under batch splitting — see the
+        class docstring).  Under expert parallelism this applies per
+        device shard (each shard routes its local tokens), so the global
+        per-expert budget is n_shards * capacity(local_tokens)."""
+        if self.fixed_capacity is not None:
+            return self.fixed_capacity
         return max(1, math.ceil(n_tokens * self.top_k / self.n_experts
                                 * self.capacity_factor))
 
@@ -138,21 +170,78 @@ class MixtureOfExperts(Module):
         aux = self.n_experts * jnp.sum(frac_tokens * mean_gate)
         return dispatch, combine, aux
 
-    def expert_forward(self, params, expert_in, state, training, rng):
-        """vmapped expert application over the stacked (E, C, d) inputs."""
+    def set_expert_parallel(self, axis_name, n_shards: int
+                            ) -> "MixtureOfExperts":
+        """Wire the trainer's mesh ``expert`` axis (duck-typed, like
+        MultiHeadAttention's ring path): while that axis is bound —
+        inside the distributed trainer's shard_map step — ``apply``
+        switches to the all_to_all dispatch, each device running only
+        its ``n_experts / n_shards`` experts on the tokens every peer
+        routed to them.  Outside the axis (validation, plain forward)
+        the dense path runs unchanged."""
+        if axis_name is not None and self.n_experts % n_shards != 0:
+            raise ValueError(
+                f"n_experts {self.n_experts} must divide by the expert "
+                f"axis size {n_shards}")
+        self.expert_parallel = axis_name
+        self._ep_shards = n_shards if axis_name is not None else 1
+        self._jit_apply = None
+        return self
+
+    def expert_forward(self, params, expert_in, state, training, rng,
+                       experts=None):
+        """vmapped expert application over the stacked (E, C, d) inputs.
+        ``experts`` overrides the stacked tree (the expert-parallel path
+        passes this device's slice)."""
+        stacked = params["experts"] if experts is None else experts
+
         def one(p, xin):
             out, _ = self.expert.apply(p, xin, state["expert"],
                                        training=training, rng=rng)
             return out
-        return jax.vmap(one)(params["experts"], expert_in)
+        return jax.vmap(one)(stacked, expert_in)
 
     def apply(self, params, input, state, training=False, rng=None):
+        from bigdl_tpu.nn.attention import _axis_bound
         flat = jnp.reshape(input, (-1, self.d_model))
-        dispatch, combine, aux = self.route(params, flat)
-        expert_in = jnp.einsum("tec,td->ecd", dispatch, flat)
-        expert_out = self.expert_forward(params, expert_in, state,
-                                         training, rng)
-        out = jnp.einsum("tec,ecd->td", combine, expert_out)
+        ep = self.expert_parallel
+        if ep is not None and _axis_bound(ep):
+            out, aux = self._apply_expert_parallel(params, flat, state,
+                                                   training, rng)
+        else:
+            dispatch, combine, aux = self.route(params, flat)
+            expert_in = jnp.einsum("tec,td->ecd", dispatch, flat)
+            expert_out = self.expert_forward(params, expert_in, state,
+                                             training, rng)
+            out = jnp.einsum("tec,ecd->td", combine, expert_out)
         new_state = dict(state)
         new_state["aux_loss"] = aux
         return jnp.reshape(out, input.shape), new_state
+
+    def _apply_expert_parallel(self, params, flat, state, training, rng):
+        """In-axis all_to_all dispatch (tokens already sharded over the
+        bound ``expert`` axis; params replicated — the trainer's ARP
+        keeps one flat replicated vector).  Same exchange geometry as
+        ``parallel/expert_parallel.expert_parallel_apply``: route local
+        tokens against the full gate, all_to_all the per-expert queues,
+        run only THIS device's expert block, all_to_all back, combine.
+        The aux diagnostic is pmeant over the token shards here so the
+        trainer's loss term sees the global balance."""
+        from jax import lax
+        ep, n = self.expert_parallel, self._ep_shards
+        dispatch, combine, aux = self.route(params, flat)
+        expert_in = jnp.einsum("tec,td->ecd", dispatch, flat)
+        # (E, C, d) -> (E/n, n*C, d): every peer's tokens for my experts
+        expert_in = lax.all_to_all(expert_in, ep, split_axis=0,
+                                   concat_axis=1, tiled=True)
+        e_per = self.n_experts // n
+        start = lax.axis_index(ep) * e_per
+        mine = jax.tree_util.tree_map(
+            lambda a: lax.dynamic_slice_in_dim(a, start, e_per, 0),
+            params["experts"])
+        out = self.expert_forward(params, expert_in, state, training, rng,
+                                  experts=mine)
+        out = lax.all_to_all(out, ep, split_axis=1, concat_axis=0,
+                             tiled=True)                     # (E, C, d)
+        y = jnp.einsum("tec,ecd->td", combine, out)
+        return y, lax.pmean(aux, ep)

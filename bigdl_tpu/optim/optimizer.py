@@ -69,6 +69,19 @@ def mixed_precision_forward(model: Module, params, inputs, mstate,
     return model.apply(params, inputs, mstate, training=training, rng=rng)
 
 
+def moe_aux_penalty(model: Module, new_mstate, weight: float):
+    """MoE load-balancing term: ``weight`` x the sum of every declared
+    ``aux_loss`` diagnostic in the post-forward state (Switch's balancing
+    objective; without this in the loss, routing feels zero pressure and
+    expert collapse is the textbook outcome).  Zero when the model has no
+    MoE (the walk finds nothing at trace time, adding no ops)."""
+    from bigdl_tpu.nn.module import collect_diagnostics
+    aux = collect_diagnostics(model, new_mstate, "aux_loss")
+    if not aux or weight == 0.0:
+        return jnp.zeros(())
+    return weight * sum(aux)
+
+
 def regularization_penalty(module: Module, params) -> jnp.ndarray:
     """Sum per-layer regularizer penalties over the module tree
     (reference applies them in each layer's accGradParameters,
@@ -147,6 +160,7 @@ class Optimizer:
         self.max_drop_percentage: float = 0.0
         self.metrics = Metrics()
         self.precision: Optional[str] = None   # None = fp32; "bf16" = mixed
+        self.moe_aux_weight: float = 0.01      # Switch paper's alpha
         self._step_fn = None
 
     # -- fluent setters (reference Optimizer.scala fluent API) ------------
@@ -196,6 +210,15 @@ class Optimizer:
         if precision not in (None, "bf16"):
             raise ValueError(f"unsupported precision {precision!r}")
         self.precision = precision
+        self._step_fn = None
+        return self
+
+    def set_moe_aux_weight(self, weight: float) -> "Optimizer":
+        """Weight of the MoE load-balancing auxiliary loss folded into the
+        objective (:func:`moe_aux_penalty`).  Default 0.01 — the Switch
+        Transformer paper's alpha; 0 disables the pressure (diagnostic
+        stays readable in module state)."""
+        self.moe_aux_weight = float(weight)
         self._step_fn = None
         return self
 
@@ -310,8 +333,11 @@ class Optimizer:
         # a small step.  Every iteration still gets its reference-protocol
         # log line — it just prints up to `depth` dispatches later, and
         # always before any sync point (validation, checkpoint, end).
-        # Consequence: the ``min_loss`` trigger sees the loss up to
-        # `depth` iterations late.
+        # Loss-reading end triggers (min_loss) set Trigger.reads_loss, and
+        # the loop flushes before evaluating them so they never see a
+        # stale loss — effectively depth=1 while such a trigger is
+        # installed (the user chose stop-on-loss semantics over latency
+        # hiding).
         def drain(item, nxt):
             loss_dev, bsz, t0, epoch, recs, neval = item
             loss = float(loss_dev)
@@ -332,8 +358,14 @@ class Optimizer:
 
         pipeline = DispatchPipeline(drain)
         flush_pending = pipeline.flush
+        end_reads_loss = getattr(self.end_when, "reads_loss", False)
 
-        while not self.end_when(state):
+        def should_end():
+            if end_reads_loss:
+                flush_pending()
+            return self.end_when(state)
+
+        while not should_end():
             t_data = time.time_ns()
             inputs, targets, bsz = fetch_batch()
             self.metrics.add("get batch time", time.time_ns() - t_data)
@@ -470,7 +502,8 @@ def _yields_minibatches(ds: AbstractDataSet) -> bool:
 
     ts = getattr(ds, "transformers", None)
     if ts is None and isinstance(ds, ShardedDataSet):
-        ts = ds.shards[0].transformers
+        # any local shard: every shard carries the same transformer chain
+        ts = next(iter(ds.shards.values())).transformers
     return bool(ts) and any(has_batcher(t) for t in ts)
 
 
@@ -500,6 +533,7 @@ class LocalOptimizer(Optimizer):
             return self._build_feval_step()
 
         precision = self.precision
+        aux_weight = self.moe_aux_weight
 
         def step(params, slots, mstate, inputs, targets, hyper, rng):
             def loss_fn(p):
@@ -507,6 +541,7 @@ class LocalOptimizer(Optimizer):
                     model, p, inputs, mstate, precision, True, rng)
                 loss = criterion.apply(out, targets)
                 loss = loss + regularization_penalty(model, p)
+                loss = loss + moe_aux_penalty(model, new_mstate, aux_weight)
                 return loss, new_mstate
 
             (loss, new_mstate), grads = jax.value_and_grad(

@@ -14,17 +14,24 @@ from typing import Callable, Dict
 
 
 class Trigger:
-    def __init__(self, fn: Callable[[Dict], bool]):
+    def __init__(self, fn: Callable[[Dict], bool], reads_loss: bool = False):
         self._fn = fn
+        # drivers that pipeline loss reads (Engine.DispatchPipeline) must
+        # flush before evaluating a loss-reading trigger, else it sees a
+        # loss up to `depth` iterations stale; the flag propagates through
+        # and_/or_ composition
+        self.reads_loss = reads_loss
 
     def __call__(self, state: Dict) -> bool:
         return self._fn(state)
 
     def and_(self, other: "Trigger") -> "Trigger":
-        return Trigger(lambda s: self(s) and other(s))
+        return Trigger(lambda s: self(s) and other(s),
+                       reads_loss=self.reads_loss or other.reads_loss)
 
     def or_(self, other: "Trigger") -> "Trigger":
-        return Trigger(lambda s: self(s) or other(s))
+        return Trigger(lambda s: self(s) or other(s),
+                       reads_loss=self.reads_loss or other.reads_loss)
 
     def __and__(self, other):
         return self.and_(other)
@@ -77,8 +84,14 @@ def max_score(score: float) -> Trigger:
 
 def min_loss(loss: float) -> Trigger:
     """(reference ``minLoss:119``).  Inert until the first iteration has
-    set ``Loss``."""
+    set ``Loss``.
+
+    ``reads_loss=True``: the training drivers flush their dispatch
+    pipeline before evaluating this trigger, so it always sees the latest
+    iteration's loss — at the cost of serializing device reads (the
+    pipelining win of ``bigdl.pipeline.depth`` does not apply while a
+    loss-reading end trigger is installed)."""
     def fn(s):
         v = s.get("Loss")
         return v is not None and v < loss
-    return Trigger(fn)
+    return Trigger(fn, reads_loss=True)

@@ -12,7 +12,8 @@ from bigdl_tpu.parallel.ring_attention import (ring_attention,
                                                ring_self_attention)
 from bigdl_tpu.parallel.expert_parallel import (ep_shard_params,
                                                 expert_parallel_apply)
-from bigdl_tpu.parallel.pipeline import (pipeline_apply,
+from bigdl_tpu.parallel.pipeline import (PipelineOptimizer,
+                                         pipeline_apply,
                                          pipeline_shard_params,
                                          stack_stage_params,
                                          unstack_stage_params)
@@ -24,6 +25,6 @@ from bigdl_tpu.parallel.tensor_parallel import (column_parallel,
 __all__ = ["AllReduceParameter", "DistriOptimizer", "ring_attention",
            "ring_self_attention", "column_parallel", "row_parallel",
            "tp_shard_params", "tp_specs", "head_count_divisible",
-           "pipeline_apply", "pipeline_shard_params", "stack_stage_params",
-           "unstack_stage_params", "ep_shard_params",
+           "PipelineOptimizer", "pipeline_apply", "pipeline_shard_params",
+           "stack_stage_params", "unstack_stage_params", "ep_shard_params",
            "expert_parallel_apply"]

@@ -20,6 +20,17 @@ one replicated flat vector, slots stay sharded across the mesh, and the
 driver only reads back the scalar loss.  fp16 wire compression maps to an
 optional bf16 cast on the reduce-scatter (``compression='bf16'``).
 
+Multi-host: under ``Engine.init_distributed`` every host process runs this
+same driver loop (multi-controller SPMD).  Each process feeds ONLY the
+data partitions its mesh positions own (:func:`local_data_partitions`;
+the dataset is constructed per process with
+``ShardedDataSet(..., local_partitions=...)``) and the global batch is
+assembled with ``jax.make_array_from_process_local_data`` — the
+reference's executor-local partition caching + locality zip
+(``ZippedPartitionsWithLocalityRDD.scala:28-56``) without a driver-side
+materialization.  Proven by ``tests/test_multihost.py`` (2 OS processes x
+4 virtual devices == the single-process 8-device run).
+
 Straggler mitigation (reference ``:192-216,302-330``) is structurally N/A:
 XLA collectives over ICI are bulk-synchronous with no partial participation;
 the API knob on :class:`Optimizer` is kept inert for parity.
@@ -40,10 +51,55 @@ from bigdl_tpu.engine import Engine
 from bigdl_tpu.dataset.dataset import ShardedDataSet
 from bigdl_tpu.nn.module import Criterion, Module
 from bigdl_tpu.optim.optimizer import (Optimizer, mixed_precision_forward,
+                                       moe_aux_penalty,
                                        regularization_penalty)
 from bigdl_tpu.parallel.all_reduce import AllReduceParameter
 
 logger = logging.getLogger("bigdl_tpu")
+
+
+def _owned_coords_per_axis(mesh: Mesh):
+    """{axis_name: sorted owned coordinates} for this process's devices,
+    plus the owned-position count (for rectangularity checks)."""
+    pid = jax.process_index()
+    devs = np.asarray(mesh.devices)
+    owned = [tuple(int(c) for c in coord)
+             for coord in np.ndindex(devs.shape)
+             if devs[coord].process_index == pid]
+    per_axis = {a: sorted({c[i] for c in owned})
+                for i, a in enumerate(mesh.axis_names)}
+    return per_axis, len(owned)
+
+
+def local_data_partitions(mesh: Mesh, axis: str = "data"):
+    """Data-axis partition ids whose devices this process can address.
+
+    In a multi-host job (``Engine.init_distributed``) each process owns
+    ``jax.local_devices()``; the data-axis coordinate of each owned mesh
+    position names a dataset partition this process must feed — the
+    reference's partition→node locality (one Spark partition cached on
+    the executor that trains it, ``ZippedPartitionsWithLocalityRDD.scala``
+    + ``AllReduceParameter.scala:87-92`` rank-from-partition-id).
+    Single-process this is simply ``range(axis_size)``."""
+    return _owned_coords_per_axis(mesh)[0][axis]
+
+
+def _local_axis_chunks(mesh: Mesh, axis: str):
+    """Sorted owned coordinates along ``axis``, with a rectangularity
+    check: per-process batch assembly slices the global batch as (owned
+    data rows) x (owned seq columns), which is only well-defined when the
+    owned device set is that cartesian product."""
+    per_axis, n_owned = _owned_coords_per_axis(mesh)
+    expect = 1
+    for a in mesh.axis_names:
+        expect *= len(per_axis[a])
+    if n_owned != expect:
+        raise ValueError(
+            f"this process's mesh positions are not rectangular over axes "
+            f"{mesh.axis_names} — per-process batch feeding cannot slice "
+            "the global batch; arrange the mesh so each process owns a "
+            "full block")
+    return per_axis[axis]
 
 
 def _pmean_float(tree, axis: str):
@@ -93,22 +149,41 @@ class DistriOptimizer(Optimizer):
         dimension (the long-context dp x sp layout)."""
         return "seq" if "seq" in self.mesh.shape else None
 
+    @property
+    def expert_axis(self) -> Optional[str]:
+        """Expert-parallel axis: present when the mesh declares an
+        ``expert`` dimension (the dp x ep MoE layout — tokens co-shard
+        over it, MixtureOfExperts layers dispatch with all_to_all)."""
+        return "expert" if "expert" in self.mesh.shape else None
+
+    @property
+    def model_axis(self) -> Optional[str]:
+        """Tensor-parallel axis: present when the mesh declares a
+        ``model`` dimension (the dp x tp Megatron layout — handled by the
+        GSPMD step, not the collective shard_map step)."""
+        return "model" if "model" in self.mesh.shape else None
+
     def _build_step(self, arp: AllReduceParameter):
         from bigdl_tpu.parallel.all_reduce import shard_map
 
         model, criterion, optim = self.model, self.criterion, self.optim_method
         mesh, axis = self.mesh, "data"
         seq_axis = self.seq_axis
-        n = mesh.shape[axis] * (mesh.shape[seq_axis] if seq_axis else 1)
+        expert_axis = self.expert_axis
+        n = (mesh.shape[axis] *
+             (mesh.shape[seq_axis] if seq_axis else 1) *
+             (mesh.shape[expert_axis] if expert_axis else 1))
 
         precision = self.precision
+        aux_weight = self.moe_aux_weight
 
         def shard_step(flat_params, slots, mstate, inputs, targets, hyper, rng):
             # distinct dropout masks per shard, like the reference's
             # independently-seeded model replicas
             rng = jax.random.fold_in(rng, lax.axis_index(axis))
-            if seq_axis:
-                rng = jax.random.fold_in(rng, lax.axis_index(seq_axis))
+            for extra in (seq_axis, expert_axis):
+                if extra:
+                    rng = jax.random.fold_in(rng, lax.axis_index(extra))
 
             def loss_fn(flat):
                 p = arp.unflatten(flat)
@@ -116,6 +191,7 @@ class DistriOptimizer(Optimizer):
                     model, p, inputs, mstate, precision, True, rng)
                 loss = criterion.apply(out, targets)
                 loss = loss + regularization_penalty(model, p)
+                loss = loss + moe_aux_penalty(model, new_mstate, aux_weight)
                 return loss, new_mstate
 
             (loss, new_mstate), flat_grads = jax.value_and_grad(
@@ -126,6 +202,10 @@ class DistriOptimizer(Optimizer):
                 # gradient contributions sum (ring attention's backward is
                 # already chunk-local)
                 flat_grads = lax.psum(flat_grads, seq_axis)
+            if expert_axis:
+                # expert shards saw disjoint tokens AND ran disjoint expert
+                # blocks: contributions sum over the axis
+                flat_grads = lax.psum(flat_grads, expert_axis)
             # reduce-scatter: own gradient slice, summed over shards
             grad_shard = arp.reduce_scatter_gradients(flat_grads, axis) / n
             # ZeRO-1: update only this device's parameter slice + slots
@@ -137,16 +217,19 @@ class DistriOptimizer(Optimizer):
 
             loss = lax.pmean(loss, axis)
             new_mstate = _pmean_float(new_mstate, axis)
-            if seq_axis:
-                loss = lax.pmean(loss, seq_axis)
-                new_mstate = _pmean_float(new_mstate, seq_axis)
+            for extra in (seq_axis, expert_axis):
+                if extra:
+                    loss = lax.pmean(loss, extra)
+                    new_mstate = _pmean_float(new_mstate, extra)
             return new_flat, new_slots, new_mstate, loss
 
         pspec_rep = P()
-        # batch over data; with a seq axis, time (dim 1) over seq
-        pspec_batch = P(axis, seq_axis) if seq_axis else P(axis)
+        # batch over data (co-sharded with expert when present); with a
+        # seq axis, time (dim 1) over seq
+        dim0 = (axis, expert_axis) if expert_axis else axis
+        pspec_batch = P(dim0, seq_axis) if seq_axis else P(dim0)
         # slots are sharded over the data axis only (ZeRO-1); replicated
-        # across seq shards
+        # across seq/expert shards
         pspec_slots = P(axis)
         sharded = shard_map(
             shard_step, mesh=mesh,
@@ -172,8 +255,17 @@ class DistriOptimizer(Optimizer):
 
         model.training()
         model._ensure_init()
+        if self.model_axis:
+            if self.seq_axis or self.expert_axis:
+                raise ValueError(
+                    "the GSPMD tensor-parallel step composes with 'data' "
+                    "only — a mesh mixing 'model' with 'seq'/'expert' is "
+                    "not supported")
+            return self._optimize_gspmd()
         if self.seq_axis:
             self._wire_sequence_parallel(model)
+        if self.expert_axis:
+            self._wire_expert_parallel(model)
 
         arp = AllReduceParameter(model.params, axis_size, self.compression)
         self._arp = arp
@@ -190,9 +282,12 @@ class DistriOptimizer(Optimizer):
         if self._step_fn is None:
             self._step_fn = self._build_step(arp)
 
+        # batch dim co-shards over expert when present (tokens follow the
+        # all_to_all dispatch axis); time (dim 1) over seq
+        dim0 = ("data", "expert") if self.expert_axis else "data"
         if self.seq_axis:
             # time (dim 1) sharded over seq: per-timestep targets required
-            batch_sharding = NamedSharding(mesh, P("data", "seq"))
+            batch_sharding = NamedSharding(mesh, P(dim0, "seq"))
             seq_size = mesh.shape["seq"]
 
             max_seq = getattr(self, "_max_seq_len", None)
@@ -212,17 +307,37 @@ class DistriOptimizer(Optimizer):
                         "would silently clamp; raise max_len")
                 return x
         else:
-            batch_sharding = NamedSharding(mesh, P("data"))
+            batch_sharding = NamedSharding(mesh, P(dim0))
             _check = None
+        # per-process shard feeding: this process pulls ONLY the partitions
+        # its mesh positions own (single-process: all of them) and the
+        # global batch is assembled from every process's local block
+        local_ids = local_data_partitions(mesh)
+        missing = [p for p in local_ids
+                   if p not in getattr(self.dataset, "local_partitions",
+                                       local_ids)]
+        if missing:
+            raise ValueError(
+                f"this process's mesh positions own data partitions "
+                f"{missing} but the dataset does not hold them locally — "
+                f"construct ShardedDataSet(..., local_partitions="
+                f"{local_ids}) on this process")
+        seq_chunks = (_local_axis_chunks(mesh, "seq") if self.seq_axis
+                      else None)
+        expert_chunks = (_local_axis_chunks(mesh, "expert")
+                         if self.expert_axis else None)
         it = {"shards": None}
 
         def reset_epoch():
             self.dataset.shuffle()
-            it["shards"] = [self.dataset.shard_data(p, train=True)
-                            for p in range(self.dataset.partition_num)]
+            it["shards"] = {p: self.dataset.shard_data(p, train=True)
+                            for p in local_ids}
 
         def fetch_batch():
-            return _global_batch(it["shards"], batch_sharding, check=_check)
+            return _global_batch(it["shards"], batch_sharding, mesh,
+                                 self.dataset.partition_num,
+                                 seq_chunks=seq_chunks,
+                                 expert_chunks=expert_chunks, check=_check)
 
         def run_step(inputs, targets, hyper, rng):
             (carry["flat"], carry["slots"], carry["mstate"],
@@ -245,6 +360,107 @@ class DistriOptimizer(Optimizer):
         self._drive(fetch_batch, run_step, reset_epoch, publish,
                     epoch_size=self.dataset.size())
         return model
+
+    def _wire_expert_parallel(self, module) -> None:
+        """Point every MixtureOfExperts at the mesh's ``expert`` axis
+        (duck-typed like the seq wiring): inside the shard_map step each
+        layer dispatches with all_to_all and runs only its expert slice;
+        outside the axis the dense path serves validation/predict.
+        A dp x ep mesh with no MoE layer would silently be plain dp at
+        double the mesh — reject it."""
+        from bigdl_tpu.nn.moe import MixtureOfExperts
+        n = self.mesh.shape["expert"]
+        moes = module.find_modules(MixtureOfExperts)
+        if not moes:
+            raise ValueError(
+                "mesh declares an 'expert' axis but the model has no "
+                "MixtureOfExperts layer — use a ('data',) mesh")
+        for m in moes:
+            m.set_expert_parallel("expert", n)
+
+    def _optimize_gspmd(self) -> Module:
+        """dp x tp trainer: the Megatron tensor-parallel step in the
+        TPU-native idiom — NO hand-written collectives.  Parameters carry
+        ``tp_specs`` NamedShardings over the ``model`` axis (column/row
+        Linear splits, MHA head splits), the batch shards over ``data``,
+        and ONE ordinary jitted step (identical in shape to
+        LocalOptimizer's) lets XLA's SPMD partitioner insert the
+        all-reduces: the per-pair psum on row-parallel outputs and the
+        data-axis gradient reduction (the scaling-book recipe: pick a
+        mesh, annotate shardings, let XLA insert collectives).  Optimizer
+        slots inherit each parameter's sharding, so Adam m/v for a split
+        weight are split the same way — the memory win tensor parallelism
+        exists for."""
+        from bigdl_tpu.parallel.tensor_parallel import (tp_shard_params,
+                                                        tp_specs)
+
+        model, mesh = self.model, self.mesh
+        specs = tp_specs(model, axis="model", mesh=mesh)
+        rep = NamedSharding(mesh, P())
+        carry = {
+            "params": tp_shard_params(model.params, mesh, specs),
+            "mstate": jax.device_put(model.state, rep),
+        }
+        # fresh slots inherit param shardings via zeros_like; resumed
+        # slots (canonical pytree from a snapshot) re-place on first use
+        carry["slots"] = self.optim_method.slots(carry["params"])
+        self.optim_method.state.setdefault("epoch", 1)
+
+        if self._step_fn is None:
+            self._step_fn = self._build_gspmd_step()
+
+        batch_sharding = NamedSharding(mesh, P("data"))
+        local_ids = local_data_partitions(mesh)
+        it = {"shards": None}
+
+        def reset_epoch():
+            self.dataset.shuffle()
+            it["shards"] = {p: self.dataset.shard_data(p, train=True)
+                            for p in local_ids}
+
+        def fetch_batch():
+            return _global_batch(it["shards"], batch_sharding, mesh,
+                                 self.dataset.partition_num)
+
+        def run_step(inputs, targets, hyper, rng):
+            (carry["params"], carry["slots"], carry["mstate"],
+             loss) = self._step_fn(carry["params"], carry["slots"],
+                                   carry["mstate"], inputs, targets,
+                                   hyper, rng)
+            return loss
+
+        def publish():
+            # params/slots are already in the canonical per-parameter
+            # pytree format (no ARP flat vector in the GSPMD design)
+            self._publish(carry["params"], carry["slots"], carry["mstate"])
+
+        reset_epoch()
+        self._drive(fetch_batch, run_step, reset_epoch, publish,
+                    epoch_size=self.dataset.size())
+        return model
+
+    def _build_gspmd_step(self):
+        model, criterion = self.model, self.criterion
+        optim = self.optim_method
+        precision = self.precision
+        aux_weight = self.moe_aux_weight
+
+        def step(params, slots, mstate, inputs, targets, hyper, rng):
+            def loss_fn(p):
+                out, new_mstate = mixed_precision_forward(
+                    model, p, inputs, mstate, precision, True, rng)
+                loss = criterion.apply(out, targets)
+                loss = loss + regularization_penalty(model, p)
+                loss = loss + moe_aux_penalty(model, new_mstate, aux_weight)
+                return loss, new_mstate
+
+            (loss, new_mstate), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            new_params, new_slots = optim.pure_update(grads, params, slots,
+                                                      hyper)
+            return new_params, new_slots, new_mstate, loss
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
 
     def _wire_sequence_parallel(self, module) -> None:
         """Point every MultiHeadAttention at the mesh's seq axis.  The ring
@@ -298,23 +514,77 @@ class DistriOptimizer(Optimizer):
             outer, [arp.flatten(s) for s in subtrees])
 
 
-def _global_batch(shard_iters, batch_sharding, check=None):
-    """Pull one minibatch per shard, concatenate host-side into the global
-    batch, and place it sharded over the mesh's data axis (each device gets
-    exactly its shard's records — the reference's locality-preserving zip,
-    ``ZippedPartitionsWithLocalityRDD.scala:28``).  ``check`` optionally
-    validates each leaf (sequence-parallel shape requirements)."""
-    batches = [next(it) for it in shard_iters]
+def _global_batch(shard_iters, batch_sharding, mesh, partition_num,
+                  seq_chunks=None, expert_chunks=None, check=None):
+    """Pull one minibatch per LOCALLY-OWNED shard, concatenate host-side
+    into this process's block of the global batch, and assemble the global
+    sharded array with ``jax.make_array_from_process_local_data`` (each
+    device gets exactly its shard's records — the reference's
+    locality-preserving zip, ``ZippedPartitionsWithLocalityRDD.scala:28``,
+    with per-node feeding like the reference's executor-cached
+    partitions).  Single-process, where every partition is local, this
+    reduces to placing the whole global batch.
+
+    ``shard_iters``: {partition_id: iterator} for the owned partitions
+    (ordered ascending when iterated).  ``seq_chunks``: owned seq-axis
+    coordinates — when a seq axis exists and this process owns only some
+    time chunks, the time dimension is sliced to the owned (contiguous)
+    chunk range before assembly.  ``expert_chunks``: same for the
+    ``expert`` axis, which co-shards the batch dim — each data
+    partition's rows are sliced to the owned expert chunk range.
+    ``check`` optionally validates each local leaf (sequence-parallel
+    shape requirements).  Returns the GLOBAL batch record count (driver
+    epoch accounting is global)."""
+    batches = [next(shard_iters[p]) for p in sorted(shard_iters)]
     inputs = _cat([b.get_input() for b in batches])
     targets = _cat([b.get_target() for b in batches])
-    bsz = sum(b.size() for b in batches)
+    bsz = sum(b.size() for b in batches) * partition_num // len(batches)
     if check is not None:
         inputs = jax.tree_util.tree_map(check, inputs)
         targets = jax.tree_util.tree_map(check, targets)
-    inputs = jax.tree_util.tree_map(
-        lambda x: jax.device_put(x, batch_sharding), inputs)
-    targets = jax.tree_util.tree_map(
-        lambda x: jax.device_put(x, batch_sharding), targets)
+    if expert_chunks is not None:
+        ep_size = mesh.shape["expert"]
+        if len(expert_chunks) < ep_size:
+            lo, hi = expert_chunks[0], expert_chunks[-1]
+            if list(expert_chunks) != list(range(lo, hi + 1)):
+                raise ValueError(
+                    f"owned expert chunks {expert_chunks} are not "
+                    "contiguous — cannot slice batch rows for this process")
+            n_parts = len(batches)
+
+            def _slice_rows(x):
+                x = np.asarray(x)
+                per = x.shape[0] // n_parts        # rows per data partition
+                sub = per // ep_size               # rows per expert chunk
+                blocks = x.reshape((n_parts, per) + x.shape[1:])
+                return blocks[:, lo * sub:(hi + 1) * sub].reshape(
+                    (-1,) + x.shape[1:])
+
+            inputs = jax.tree_util.tree_map(_slice_rows, inputs)
+            targets = jax.tree_util.tree_map(_slice_rows, targets)
+    if seq_chunks is not None:
+        seq_size = mesh.shape["seq"]
+        if len(seq_chunks) < seq_size:
+            lo, hi = seq_chunks[0], seq_chunks[-1]
+            if list(seq_chunks) != list(range(lo, hi + 1)):
+                raise ValueError(
+                    f"owned seq chunks {seq_chunks} are not contiguous — "
+                    "cannot slice the time dimension for this process")
+
+            def _slice_t(x):
+                x = np.asarray(x)
+                chunk = x.shape[1] // seq_size
+                return x[:, lo * chunk:(hi + 1) * chunk]
+
+            inputs = jax.tree_util.tree_map(_slice_t, inputs)
+            targets = jax.tree_util.tree_map(_slice_t, targets)
+
+    def _assemble(x):
+        return jax.make_array_from_process_local_data(
+            batch_sharding, np.asarray(x))
+
+    inputs = jax.tree_util.tree_map(_assemble, inputs)
+    targets = jax.tree_util.tree_map(_assemble, targets)
     return inputs, targets, bsz
 
 

@@ -93,7 +93,7 @@ class _DoubleTransformer:
 class TestShardedDataSet:
     def test_shard_sizes_equal(self):
         ds = ShardedDataSet(list(range(10)), partition_num=4)
-        sizes = [s.size() for s in ds.shards]
+        sizes = [s.size() for s in ds.shards.values()]
         assert sizes == [2, 2, 2, 2]  # truncated to equal size
 
     def test_shard_disjoint(self):

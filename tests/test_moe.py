@@ -220,3 +220,58 @@ def test_ep_returns_pmeant_aux():
     y, aux = expert_parallel_apply(moe, params, x, mesh, return_aux=True)
     assert y.shape == (16, D)
     assert float(aux) >= 0.99
+
+
+def test_explicit_capacity_pins_budget_across_batch_sizes():
+    """capacity= overrides the factor-derived, token-count-dependent
+    budget: routing geometry is then stable under batch splitting (the
+    microbatching contract documented in moe.py / pipeline.py)."""
+    expert = (nn.Sequential().add(nn.Linear(D, 2 * D)).add(nn.ReLU())
+              .add(nn.Linear(2 * D, D)))
+    moe = MixtureOfExperts(D, expert, E, capacity=5)
+    moe.reset(jax.random.PRNGKey(7))
+    assert moe.capacity(8) == 5 and moe.capacity(64) == 5
+    x = jnp.asarray(np.random.RandomState(12)
+                    .normal(size=(16, D)).astype(np.float32))
+    dispatch, _, _ = moe.route(moe.params, x)
+    assert dispatch.shape == (16, E, 5)
+    with pytest.raises(ValueError, match="capacity"):
+        MixtureOfExperts(D, expert, E, capacity=0)
+
+
+def test_dropfree_routing_is_batch_split_invariant():
+    """With capacity_factor >= E/top_k nothing can drop, so concatenated
+    half-batch forwards equal the full-batch forward exactly — the
+    invariance the pipeline relies on for full-batch MoE parity."""
+    moe = _moe(capacity_factor=float(E))
+    x = np.random.RandomState(13).normal(size=(24, D)).astype(np.float32)
+    full = np.asarray(moe.forward(jnp.asarray(x)))
+    halves = np.concatenate(
+        [np.asarray(moe.forward(jnp.asarray(h)))
+         for h in np.split(x, 2, axis=0)], axis=0)
+    np.testing.assert_allclose(halves, full, rtol=1e-5, atol=1e-6)
+
+
+def test_diagnostic_scoping_is_per_module():
+    """aux_loss exclusion is scoped to MixtureOfExperts' declaration: an
+    unrelated module storing genuine cross-step state under the same key
+    still trips the pipeline statelessness guard."""
+    from bigdl_tpu.nn.module import Module, semantic_state_leaves
+
+    class SneakyState(Module):
+        def _init_params(self, rng):
+            return {}
+
+        def _init_state(self):
+            return {"aux_loss": jnp.zeros((3,))}   # genuine state, bad name
+
+        def apply(self, params, input, state, training=False, rng=None):
+            return input, {"aux_loss": state["aux_loss"] + 1}
+
+    sneaky = SneakyState()
+    sneaky.reset(jax.random.PRNGKey(0))
+    assert semantic_state_leaves(sneaky), \
+        "undeclared aux_loss key must count as semantic state"
+    moe = _moe()
+    assert not semantic_state_leaves(moe), \
+        "MoE's declared diagnostic must be excluded"

@@ -171,6 +171,15 @@ class TestTriggers:
         assert t({"epoch": 1, "neval": 101})
         assert not t({"epoch": 1, "neval": 1})
 
+    def test_reads_loss_flag_propagates_through_combinators(self):
+        # drivers flush the dispatch pipeline before evaluating
+        # loss-reading end triggers — the flag must survive composition
+        assert optim.min_loss(0.1).reads_loss
+        assert not optim.max_epoch(2).reads_loss
+        assert (optim.max_epoch(2) | optim.min_loss(0.1)).reads_loss
+        assert (optim.min_loss(0.1) & optim.max_iteration(9)).reads_loss
+        assert not (optim.max_epoch(2) | optim.max_iteration(9)).reads_loss
+
 
 class TestValidationMethods:
     def test_top1(self):

@@ -103,6 +103,36 @@ class TestTransformerLM:
         acc = float(out.strip().rsplit(" ", 1)[-1])
         assert acc > 0.5, out
 
+    def _drive(self, capsys, extra):
+        from bigdl_tpu.models.transformer import train as drv
+        drv.main(["--synthetic", "48", "--seq-len", "8", "--max-epoch", "2",
+                  "--batch-size", "16", "--d-model", "16", "--heads", "2"]
+                 + extra)
+        out = capsys.readouterr().out
+        return float(out.strip().rsplit(" ", 1)[-1])
+
+    def test_driver_tensor_parallel_flag(self, capsys):
+        acc = self._drive(capsys, ["--partitions", "4",
+                                   "--tensor-parallel", "2"])
+        assert 0.0 <= acc <= 1.0
+
+    def test_driver_expert_parallel_flag(self, capsys):
+        acc = self._drive(capsys, ["--moe-experts", "4", "--partitions", "2",
+                                   "--expert-parallel", "4"])
+        assert 0.0 <= acc <= 1.0
+
+    def test_driver_pipeline_flag(self, capsys):
+        acc = self._drive(capsys, ["--pipeline", "2", "--partitions", "2"])
+        assert 0.0 <= acc <= 1.0
+
+    def test_driver_rejects_mode_combo_and_missing_moe(self):
+        from bigdl_tpu.models.transformer import train as drv
+        with pytest.raises(SystemExit, match="one parallelism"):
+            drv.main(["--synthetic", "8", "--pipeline", "2",
+                      "--tensor-parallel", "2"])
+        with pytest.raises(SystemExit, match="moe-experts"):
+            drv.main(["--synthetic", "8", "--expert-parallel", "2"])
+
 
 def test_odd_d_model_positional_encoding():
     pe = PositionalEncoding(7, max_len=16)
