@@ -198,11 +198,14 @@ class PReLU(Module):
     """ReLU with learnable negative slope (reference ``nn/PReLU.scala``).
     n_output_plane=0 -> one shared slope; else one per channel (dim 1)."""
 
-    def __init__(self, n_output_plane: int = 0, name=None):
+    def __init__(self, n_output_plane: int = 0, init_weight=None, name=None):
         super().__init__(name)
         self.n_output_plane = n_output_plane
+        self.init_weight = init_weight
 
     def _init_params(self, rng):
+        if self.init_weight is not None:
+            return {"weight": jnp.asarray(self.init_weight).reshape(-1)}
         n = max(1, self.n_output_plane)
         return {"weight": jnp.full((n,), 0.25)}
 

@@ -148,11 +148,14 @@ class LookupTable(Module):
 class Add(Module):
     """Learnable per-element bias (reference ``nn/Add.scala``)."""
 
-    def __init__(self, input_size: int, name=None):
+    def __init__(self, input_size: int, init_bias=None, name=None):
         super().__init__(name)
         self.input_size = input_size
+        self.init_bias = init_bias
 
     def _init_params(self, rng):
+        if self.init_bias is not None:
+            return {"bias": jnp.asarray(self.init_bias).reshape(-1)}
         stdv = 1.0 / math.sqrt(self.input_size)
         return {"bias": jax.random.uniform(rng, (self.input_size,),
                                            minval=-stdv, maxval=stdv)}

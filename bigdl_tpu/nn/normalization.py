@@ -31,6 +31,7 @@ class BatchNormalization(Module):
 
     def __init__(self, n_output: int, eps: float = 1e-5, momentum: float = 0.1,
                  affine: bool = True, init_weight=None, init_bias=None,
+                 init_running_mean=None, init_running_var=None,
                  name=None):
         super().__init__(name)
         self.n_output = n_output
@@ -39,6 +40,10 @@ class BatchNormalization(Module):
         self.affine = affine
         self.init_weight = init_weight
         self.init_bias = init_bias
+        # pre-trained running statistics (model importers: caffe BATCHNORM
+        # stores mean/var blobs, not affine params)
+        self.init_running_mean = init_running_mean
+        self.init_running_var = init_running_var
 
     def _init_params(self, rng):
         if not self.affine:
@@ -50,8 +55,13 @@ class BatchNormalization(Module):
         return {"weight": w, "bias": b}
 
     def _init_state(self):
-        return {"running_mean": jnp.zeros((self.n_output,)),
-                "running_var": jnp.ones((self.n_output,))}
+        mean = (jnp.asarray(self.init_running_mean)
+                if self.init_running_mean is not None
+                else jnp.zeros((self.n_output,)))
+        var = (jnp.asarray(self.init_running_var)
+               if self.init_running_var is not None
+               else jnp.ones((self.n_output,)))
+        return {"running_mean": mean, "running_var": var}
 
     # channel axis (1 = torch NCHW convention; NHWC variants use -1)
     channel_axis = 1
@@ -95,9 +105,11 @@ class SpatialBatchNormalization(BatchNormalization):
     TF-import and TPU-preferred activation layout)."""
 
     def __init__(self, n_output, eps=1e-5, momentum=0.1, affine=True,
-                 init_weight=None, init_bias=None, format="NCHW", name=None):
+                 init_weight=None, init_bias=None, init_running_mean=None,
+                 init_running_var=None, format="NCHW", name=None):
         super().__init__(n_output, eps, momentum, affine, init_weight,
-                         init_bias, name=name)
+                         init_bias, init_running_mean, init_running_var,
+                         name=name)
         self.channel_axis = 1 if format == "NCHW" else -1
 
 

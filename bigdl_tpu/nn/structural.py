@@ -363,12 +363,19 @@ class Scale(Module):
     """cmul + cadd with learnable size-shaped weight and bias
     (reference ``nn/Scale.scala``)."""
 
-    def __init__(self, size: Sequence[int], name=None):
+    def __init__(self, size: Sequence[int], init_weight=None,
+                 init_bias=None, name=None):
         super().__init__(name)
         self.size = tuple(size)
+        self.init_weight = init_weight
+        self.init_bias = init_bias
 
     def _init_params(self, rng):
-        return {"weight": jnp.ones(self.size), "bias": jnp.zeros(self.size)}
+        w = (jnp.asarray(self.init_weight).reshape(self.size)
+             if self.init_weight is not None else jnp.ones(self.size))
+        b = (jnp.asarray(self.init_bias).reshape(self.size)
+             if self.init_bias is not None else jnp.zeros(self.size))
+        return {"weight": w, "bias": b}
 
     def apply(self, params, input, state, training=False, rng=None):
         w, b = params["weight"], params["bias"]

@@ -30,6 +30,38 @@ def _blob_array(blob) -> np.ndarray:
     return data.reshape(tuple(dims) if dims else (-1,))
 
 
+class _CaffeSlice(nn.Module):
+    """caffe Slice: split ``axis`` into ``n_out`` groups (keeping the
+    dim), at explicit ``slice_point`` boundaries or equally.  Emits a
+    Table; the loader wires one SelectTable per top.  (The reference maps
+    Slice to SplitTable, which removes the dim — this keeps caffe's
+    actual blob shapes.)"""
+
+    def __init__(self, axis: int, n_out: int, points=(), name=None):
+        super().__init__(name)
+        self.axis = axis
+        self.n_out = n_out
+        self.points = tuple(int(p) for p in points)
+
+    def apply(self, params, input, state, training=False, rng=None):
+        size = input.shape[self.axis]
+        if self.points:
+            bounds = (0,) + self.points + (size,)
+        else:
+            if size % self.n_out != 0:
+                raise ValueError(
+                    f"{self.name}: axis {self.axis} size {size} does not "
+                    f"split equally into {self.n_out} tops")
+            step = size // self.n_out
+            bounds = tuple(range(0, size + 1, step))
+        outs = []
+        for i in range(self.n_out):
+            idx = [slice(None)] * input.ndim
+            idx[self.axis] = slice(bounds[i], bounds[i + 1])
+            outs.append(input[tuple(idx)])
+        return outs, state
+
+
 class _ChannelSoftMax(nn.Module):
     """Softmax over axis 1 — caffe's default normalization axis for any
     blob rank (our ``nn.SoftMax`` normalizes the last axis, which only
@@ -63,10 +95,26 @@ _V1_TYPE = {
     "RELU": "ReLU", "SIGMOID": "Sigmoid", "SOFTMAX": "Softmax",
     "SOFTMAX_LOSS": "SoftmaxWithLoss", "SPLIT": "Split", "TANH": "TanH",
     "DATA": "Data", "ACCURACY": "Accuracy",
+    "ABSVAL": "AbsVal", "EXP": "Exp", "POWER": "Power", "SLICE": "Slice",
+    "THRESHOLD": "Threshold", "EUCLIDEAN_LOSS": "EuclideanLoss",
 }
 _V1_PARAMS = ("concat_param", "convolution_param", "dropout_param",
               "eltwise_param", "inner_product_param", "lrn_param",
-              "pooling_param", "softmax_param")
+              "pooling_param", "power_param", "slice_param",
+              "threshold_param", "exp_param", "softmax_param")
+
+# loss-layer -> criterion channel (reference CaffeLoader.tryAddCriterion,
+# ``CaffeLoader.scala:401-418``).  value = (criterion factory,
+# criterion_only): criterion-only loss layers contribute NO module to the
+# inference graph (their bottoms are just consumed), while the others keep
+# an inference-view module (channel softmax / sigmoid head)
+_LOSS_CRITERIONS = {
+    "SoftmaxWithLoss": (lambda: nn.ClassNLLCriterion(), False),
+    "EuclideanLoss": (lambda: nn.MSECriterion(), True),
+    "HingeLoss": (lambda: nn.HingeEmbeddingCriterion(), True),
+    "SigmoidCrossEntropyLoss": (lambda: nn.CrossEntropyCriterion(), False),
+    "ContrastiveLoss": (lambda: nn.CosineEmbeddingCriterion(), True),
+}
 
 
 def _upgrade_v1(net, strict: bool = True) -> None:
@@ -110,6 +158,9 @@ class CaffeLoader:
             text_format.Merge(f.read(), self.net)
         _upgrade_v1(self.net)
         self.blobs: Dict[str, List[np.ndarray]] = {}
+        # criterions detected from train-protocol loss layers (reference
+        # ``tryAddCriterion``); read via ``criterion()`` after load()
+        self.criterions: List[nn.Criterion] = []
         if model_path:
             weights = pb.NetParameter()
             with open(model_path, "rb") as f:
@@ -167,9 +218,39 @@ class CaffeLoader:
                     produced.append(top)
                     last_prod[top] = idx
                 continue
+            if layer.type in _LOSS_CRITERIONS:
+                factory, criterion_only = _LOSS_CRITERIONS[layer.type]
+                self.criterions.append(factory())
+                if criterion_only:
+                    # pure training-loss layer: no inference module.  Only
+                    # the LABEL bottoms are consumed — the prediction
+                    # bottom stays dangling so the inference graph keeps
+                    # its natural output (the reference drops the loss
+                    # layer the same way)
+                    for b in layer.bottom[1:]:
+                        last_cons[b] = idx
+                    continue
+            if layer.type == "Slice":
+                # one slice node feeding a SelectTable per top (caffe's
+                # multi-top split along an axis, slice_point supported)
+                sp = layer.slice_param
+                split = ModuleNode(_CaffeSlice(
+                    int(sp.axis), len(layer.top),
+                    points=list(sp.slice_point), name=layer.name))
+                split.inputs(tops[layer.bottom[0]])
+                last_cons[layer.bottom[0]] = idx
+                for i, top in enumerate(layer.top):
+                    sel = ModuleNode(nn.SelectTable(
+                        i + 1, name=f"{layer.name}_{top}"))
+                    sel.inputs(split)
+                    tops[top] = sel
+                    produced.append(top)
+                    last_prod[top] = idx
+                continue
             node = ModuleNode(self._convert(layer))
             bottoms = list(layer.bottom)
-            if layer.type == "SoftmaxWithLoss" and len(bottoms) > 1:
+            if (layer.type in ("SoftmaxWithLoss", "SigmoidCrossEntropyLoss")
+                    and len(bottoms) > 1):
                 bottoms = bottoms[:1]       # drop the label bottom
             preds = [self._pred(tops, layer, i, bottoms[i])
                      for i in range(len(bottoms))]
@@ -341,8 +422,119 @@ class CaffeLoader:
             return nn.CMulTable(name=name)
         if t == "Flatten":
             return nn.InferReshape([0, -1], name=name)
+        if t == "BatchNorm":
+            # blobs = [mean, variance, scale_factor]; BVLC stores the
+            # UNSCALED sums — divide by the scale factor for the running
+            # statistics.  No affine params (that is the paired Scale
+            # layer's job, like caffe itself).
+            if not blobs:
+                raise ValueError(f"{name}: BatchNorm needs a caffemodel "
+                                 "(mean/var blobs)")
+            eps = float(layer.batch_norm_param.eps) if layer.HasField(
+                "batch_norm_param") else 1e-5
+            mean, var = blobs[0].reshape(-1), blobs[1].reshape(-1)
+            sf = float(blobs[2].reshape(-1)[0]) if len(blobs) > 2 else 1.0
+            if sf == 0.0:
+                sf = 1.0
+            return nn.SpatialBatchNormalization(
+                int(mean.shape[0]), eps=eps, affine=False,
+                init_running_mean=mean / sf, init_running_var=var / sf,
+                name=name)
+        if t == "Scale":
+            # blobs = [gamma(, beta if bias_term)] — the affine half of a
+            # caffe BatchNorm+Scale pair (channel-wise when 1-D)
+            sp = (layer.scale_param if layer.HasField("scale_param")
+                  else pb.ScaleParameter())
+            if not blobs:
+                raise ValueError(f"{name}: Scale without caffemodel blobs "
+                                 "unsupported (size is only recorded in "
+                                 "the blob shapes)")
+            gamma = blobs[0]
+            beta = (blobs[1] if (sp.bias_term and len(blobs) > 1)
+                    else np.zeros_like(gamma))
+            return nn.Scale(gamma.shape, init_weight=gamma, init_bias=beta,
+                            name=name)
+        if t == "Bias":
+            # learnable per-element bias (reference maps to nn.Add)
+            if not blobs:
+                raise ValueError(f"{name}: Bias without caffemodel blobs "
+                                 "unsupported")
+            b = blobs[0].reshape(-1)
+            return nn.Add(int(b.shape[0]), init_bias=b, name=name)
+        if t == "PReLU":
+            # blob = per-channel slopes (shared -> one element)
+            if blobs:
+                slopes = blobs[0].reshape(-1)
+                return nn.PReLU(int(slopes.shape[0])
+                                if slopes.shape[0] > 1 else 0,
+                                init_weight=slopes, name=name)
+            return nn.PReLU(name=name)
+        if t == "ELU":
+            alpha = float(layer.elu_param.alpha) if layer.HasField(
+                "elu_param") else 1.0
+            return nn.ELU(alpha, name=name)
+        if t == "Power":
+            pp = (layer.power_param if layer.HasField("power_param")
+                  else pb.PowerParameter())
+            return nn.Power(float(pp.power), float(pp.scale),
+                            float(pp.shift), name=name)
+        if t == "Log":
+            # reference imports LOG as plain nn.Log (base/scale/shift
+            # defaults); reject the parameterized form honestly
+            lp = (layer.log_param if layer.HasField("log_param")
+                  else pb.LogParameter())
+            if (lp.base != -1.0 or lp.scale != 1.0 or lp.shift != 0.0):
+                raise ValueError(f"{name}: parameterized Log "
+                                 "(base/scale/shift) unsupported")
+            return nn.Log(name=name)
+        if t == "Exp":
+            ep = (layer.exp_param if layer.HasField("exp_param")
+                  else pb.ExpParameter())
+            if (ep.base != -1.0 or ep.scale != 1.0 or ep.shift != 0.0):
+                raise ValueError(f"{name}: parameterized Exp "
+                                 "(base/scale/shift) unsupported")
+            return nn.Exp(name=name)
+        if t == "AbsVal":
+            return nn.Abs(name=name)
+        if t == "Threshold":
+            th = (float(layer.threshold_param.threshold)
+                  if layer.HasField("threshold_param") else 0.0)
+            return nn.Threshold(th, name=name)
+        if t == "Reshape":
+            rp = layer.reshape_param
+            dims = [int(d) for d in rp.shape.dim]
+            if int(rp.axis) != 0 or int(rp.num_axes) != -1:
+                raise ValueError(f"{name}: Reshape axis/num_axes "
+                                 "unsupported (whole-blob reshape only)")
+            return nn.InferReshape(dims, name=name)
+        if t == "Tile":
+            tp = layer.tile_param
+            return nn.Replicate(int(tp.tiles), int(tp.axis), name=name)
+        if t in ("Recurrent", "RNN"):
+            # parity with the reference's placeholder import
+            # (``Converter.fromCaffeRecurrent`` constructs a bare
+            # Recurrent container; the user adds the cell)
+            return nn.Recurrent(name=name)
+        if t == "SigmoidCrossEntropyLoss":
+            # inference view of the sigmoid-cross-entropy head (the
+            # criterion channel captured CrossEntropyCriterion)
+            return nn.Sigmoid(name=name)
         raise ValueError(f"unsupported caffe layer type {t!r} at {name!r} "
                          "(reference CaffeLoader converter not implemented)")
+
+    def criterion(self) -> Optional[nn.Criterion]:
+        """The criterion detected from the train prototxt's loss layers
+        (reference ``CaffeLoader.tryAddCriterion``): None when the
+        prototxt is inference-only, the single criterion when one loss
+        layer exists, a ParallelCriterion over all of them otherwise."""
+        if not self.criterions:
+            return None
+        if len(self.criterions) == 1:
+            return self.criterions[0]
+        pc = nn.ParallelCriterion()
+        for c in self.criterions:
+            pc.add(c)
+        return pc
 
 
 def load_caffe(def_path: str, model_path: Optional[str] = None) -> Graph:

@@ -54,6 +54,19 @@ def save(model, def_path: str, model_path: str,
         layer.top.append(top)
         bottom = top
         _fill(layer, m)
+        if isinstance(m, nn.BatchNormalization) and m.affine:
+            # caffe factors affine BN into a BatchNorm + Scale pair
+            scale = net.layer.add()
+            scale.name = f"{m.name}_scale"
+            scale.type = "Scale"
+            scale.bottom.append(top)
+            stop = f"{top}_scale"
+            scale.top.append(stop)
+            bottom = stop
+            scale.scale_param.bias_term = True
+            p = m.params
+            scale.blobs.append(_blob(np.asarray(p["weight"])))
+            scale.blobs.append(_blob(np.asarray(p["bias"])))
 
     with open(def_path, "w") as f:
         # blobs stay out of the prototxt (structure only)
@@ -123,15 +136,61 @@ def _fill(layer, m) -> None:
     elif isinstance(m, nn.Dropout):
         layer.type = "Dropout"
         layer.dropout_param.dropout_ratio = m.p
+    elif isinstance(m, nn.BatchNormalization):
+        # stats half only; save() appends the Scale half when affine
+        layer.type = "BatchNorm"
+        layer.batch_norm_param.eps = m.eps
+        st = m.state
+        layer.blobs.append(_blob(np.asarray(st["running_mean"])))
+        layer.blobs.append(_blob(np.asarray(st["running_var"])))
+        layer.blobs.append(_blob(np.ones((1,), np.float32)))  # scale factor
+    elif isinstance(m, nn.Scale):
+        layer.type = "Scale"
+        layer.scale_param.bias_term = True
+        layer.blobs.append(_blob(np.asarray(p["weight"])))
+        layer.blobs.append(_blob(np.asarray(p["bias"])))
+    elif isinstance(m, nn.Add):
+        layer.type = "Bias"
+        layer.blobs.append(_blob(np.asarray(p["bias"])))
+    elif isinstance(m, nn.PReLU):
+        layer.type = "PReLU"
+        layer.prelu_param.channel_shared = m.n_output_plane == 0
+        layer.blobs.append(_blob(np.asarray(p["weight"])))
+    elif isinstance(m, nn.ELU):
+        layer.type = "ELU"
+        layer.elu_param.alpha = m.alpha
+    elif isinstance(m, nn.Power):
+        layer.type = "Power"
+        pw = layer.power_param
+        pw.power, pw.scale, pw.shift = m.power, m.scale, m.shift
+    elif isinstance(m, nn.Log):
+        layer.type = "Log"
+    elif isinstance(m, nn.Exp):
+        layer.type = "Exp"
+    elif isinstance(m, nn.Abs):
+        layer.type = "AbsVal"
+    elif isinstance(m, nn.Threshold):
+        layer.type = "Threshold"
+        layer.threshold_param.threshold = m.th
+    elif isinstance(m, nn.Replicate):
+        layer.type = "Tile"
+        layer.tile_param.axis = m.dim
+        layer.tile_param.tiles = m.n_features
+    elif isinstance(m, nn.Recurrent):
+        layer.type = "Recurrent"
     elif isinstance(m, (nn.Reshape, nn.View, nn.InferReshape)):
         size = (m.size if not isinstance(m, nn.View) else m.sizes)
-        if len([s for s in size if s != 0]) != 1:
-            # caffe Flatten collapses all per-sample dims to one; any other
-            # reshape has no caffe counterpart
+        if len([s for s in size if s != 0]) == 1:
+            # per-sample flatten has a dedicated caffe type
+            layer.type = "Flatten"
+        elif isinstance(m, nn.InferReshape):
+            layer.type = "Reshape"
+            layer.reshape_param.shape.dim.extend(int(s) for s in size)
+        else:
             raise ValueError(
                 f"{m.name}: reshape to {tuple(size)} has no caffe mapping "
-                "(only per-sample flatten exports as Flatten)")
-        layer.type = "Flatten"
+                "(InferReshape exports as Reshape; View/Reshape only as "
+                "per-sample Flatten)")
     elif isinstance(m, nn.Identity):
         layer.type = "Input"
     else:

@@ -66,8 +66,8 @@ class TestCaffeRoundTrip:
         proto.write_text(
             'name: "bad"\ninput: "data"\n'
             'input_shape { dim: 1 dim: 4 }\n'
-            'layer { name: "x" type: "PReLU" bottom: "data" top: "x" }\n')
-        with pytest.raises(ValueError, match="PReLU"):
+            'layer { name: "x" type: "MVN" bottom: "data" top: "x" }\n')
+        with pytest.raises(ValueError, match="MVN"):
             load_caffe(str(proto))
 
     def test_train_phase_layers_skipped(self, tmp_path):
@@ -296,3 +296,211 @@ layers { name: "acc" type: ACCURACY bottom: "a" bottom: "a" }
             'layer { name: "r" type: "ReLU" bottom: "c" top: "c" }\n')
         with pytest.raises(ValueError, match="mixes legacy"):
             load_caffe(str(proto))
+
+
+class TestConverterRegistryParity:
+    """The reference's full converter registry (``Converter.scala:573-605``):
+    BatchNorm/Scale (the ResNet-era pair) plus the activation/shape layer
+    set and the loss->criterion channel (``CaffeLoader.scala:401-418``)."""
+
+    def _write_net(self, tmp_path, prototxt, weight_layers):
+        """weight_layers: [(name, type, [np blobs])] -> caffemodel file."""
+        from bigdl_tpu.utils.caffe import caffe_minimal_pb2 as pb
+        proto = tmp_path / "net.prototxt"
+        proto.write_text(prototxt)
+        net = pb.NetParameter()
+        for name, ltype, blobs in weight_layers:
+            layer = net.layer.add()
+            layer.name, layer.type = name, ltype
+            for arr in blobs:
+                b = layer.blobs.add()
+                b.shape.dim.extend(arr.shape)
+                b.data.extend(float(v) for v in arr.ravel())
+        weights = tmp_path / "net.caffemodel"
+        weights.write_bytes(net.SerializeToString())
+        return str(proto), str(weights)
+
+    def test_batchnorm_scale_eltwise_resnet_branch(self, tmp_path):
+        """The reference-era ResNet building block: Conv -> BatchNorm ->
+        Scale -> ReLU with an Eltwise residual add — golden parity against
+        the manual computation from the same blobs."""
+        rng = np.random.RandomState(0)
+        C = 4
+        kern = rng.normal(size=(C, C, 3, 3)).astype(np.float32) * 0.2
+        mean = rng.normal(size=(C,)).astype(np.float32)
+        var = rng.uniform(0.5, 2.0, size=(C,)).astype(np.float32)
+        sf = np.asarray([4.0], np.float32)          # BVLC unscaled sums
+        gamma = rng.uniform(0.5, 1.5, size=(C,)).astype(np.float32)
+        beta = rng.normal(size=(C,)).astype(np.float32)
+        proto, weights = self._write_net(
+            tmp_path,
+            'name: "branch"\ninput: "data"\n'
+            'input_shape { dim: 1 dim: 4 dim: 6 dim: 6 }\n'
+            'layer { name: "conv" type: "Convolution" bottom: "data" '
+            'top: "c" convolution_param { num_output: 4 kernel_size: 3 '
+            'pad: 1 bias_term: false } }\n'
+            'layer { name: "bn" type: "BatchNorm" bottom: "c" top: "c" '
+            'batch_norm_param { eps: 0.001 } }\n'
+            'layer { name: "sc" type: "Scale" bottom: "c" top: "c" '
+            'scale_param { bias_term: true } }\n'
+            'layer { name: "sum" type: "Eltwise" bottom: "c" '
+            'bottom: "data" top: "s" }\n'
+            'layer { name: "relu" type: "ReLU" bottom: "s" top: "s" }\n',
+            [("conv", "Convolution", [kern * sf[0] / sf[0]]),
+             ("bn", "BatchNorm", [mean * sf[0], var * sf[0], sf]),
+             ("sc", "Scale", [gamma, beta])])
+        # re-write conv blob without the silly identity math
+        net = load_caffe(proto, weights)
+        x = rng.normal(size=(2, C, 6, 6)).astype(np.float32)
+        got = np.asarray(net.evaluate().forward(x))
+
+        import jax.numpy as jnp
+        import jax
+        conv = jax.lax.conv_general_dilated(
+            jnp.asarray(x), jnp.asarray(kern), (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        bn = (np.asarray(conv) - mean[None, :, None, None]) / np.sqrt(
+            var[None, :, None, None] + 1e-3)
+        scaled = bn * gamma[None, :, None, None] + beta[None, :, None, None]
+        want = np.maximum(scaled + x, 0.0)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_batchnorm_affine_roundtrip_export(self, tmp_path):
+        """Affine BN exports as a BatchNorm + Scale pair and re-imports
+        with forward parity (the VERDICT done-criterion round trip)."""
+        m = (nn.Sequential()
+             .add(nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1,
+                                        name="conv"))
+             .add(nn.SpatialBatchNormalization(4, name="bn"))
+             .add(nn.ReLU(name="relu")))
+        m._ensure_init()
+        # non-trivial running stats + affine params
+        rng = np.random.RandomState(1)
+        bn = m.children[1]
+        bn.state["running_mean"] = rng.normal(size=(4,)).astype(np.float32)
+        bn.state["running_var"] = rng.uniform(
+            0.5, 2.0, size=(4,)).astype(np.float32)
+        proto = str(tmp_path / "bn.prototxt")
+        weights = str(tmp_path / "bn.caffemodel")
+        persister.save(m, proto, weights, input_shape=[1, 3, 8, 8])
+        assert 'type: "BatchNorm"' in open(proto).read()
+        assert 'type: "Scale"' in open(proto).read()
+        back = load_caffe(proto, weights)
+        x = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(back.evaluate().forward(x)),
+            np.asarray(m.evaluate().forward(x)), rtol=1e-4, atol=1e-4)
+
+    def test_activation_and_shape_layers_roundtrip(self, tmp_path):
+        """ELU/PReLU/Power/Log/Exp/AbsVal/Threshold/Bias/Tile/Reshape all
+        export and re-import with forward parity."""
+        m = (nn.Sequential()
+             .add(nn.ELU(0.7, name="elu"))
+             .add(nn.Abs(name="abs"))
+             .add(nn.Power(2.0, 1.5, 0.25, name="pow"))
+             .add(nn.Log(name="log"))
+             .add(nn.Exp(name="exp"))
+             .add(nn.Threshold(0.9, name="th"))
+             .add(nn.PReLU(4, name="prelu"))
+             .add(nn.Add(4, name="bias"))
+             .add(nn.InferReshape([0, 2, 2], name="rs")))
+        m._ensure_init()
+        proto = str(tmp_path / "acts.prototxt")
+        weights = str(tmp_path / "acts.caffemodel")
+        persister.save(m, proto, weights, input_shape=[1, 4])
+        back = load_caffe(proto, weights)
+        x = np.random.RandomState(2).normal(size=(3, 4)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(back.evaluate().forward(x)),
+            np.asarray(m.evaluate().forward(x)), rtol=1e-5, atol=1e-6)
+
+    def test_tile_roundtrip(self, tmp_path):
+        m = nn.Sequential().add(nn.Replicate(3, 2, name="tile"))
+        m._ensure_init()
+        proto = str(tmp_path / "t.prototxt")
+        weights = str(tmp_path / "t.caffemodel")
+        persister.save(m, proto, weights, input_shape=[1, 4])
+        back = load_caffe(proto, weights)
+        x = np.random.RandomState(3).normal(size=(2, 4)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(back.forward(x)),
+                                   np.asarray(m.forward(x)))
+
+    def test_slice_imports_with_slice_points(self, tmp_path):
+        proto = tmp_path / "sl.prototxt"
+        proto.write_text(
+            'name: "sl"\ninput: "data"\n'
+            'input_shape { dim: 1 dim: 6 }\n'
+            'layer { name: "sl" type: "Slice" bottom: "data" top: "a" '
+            'top: "b" slice_param { axis: 1 slice_point: 2 } }\n'
+            'layer { name: "pa" type: "Power" bottom: "a" top: "pa" '
+            'power_param { power: 1 scale: 2 } }\n'
+            'layer { name: "pb" type: "Power" bottom: "b" top: "pb" '
+            'power_param { power: 1 scale: 3 } }\n')
+        net = load_caffe(str(proto))
+        x = np.arange(12, dtype=np.float32).reshape(2, 6)
+        out = net.evaluate().forward(x)
+        np.testing.assert_allclose(np.asarray(out[0]), x[:, :2] * 2)
+        np.testing.assert_allclose(np.asarray(out[1]), x[:, 2:] * 3)
+
+    def test_loss_layers_become_criterions(self, tmp_path):
+        """SOFTMAX_LOSS keeps the inference softmax AND registers
+        ClassNLLCriterion; EuclideanLoss is criterion-only (no module,
+        bottoms consumed)."""
+        from bigdl_tpu.utils.caffe.loader import CaffeLoader
+        proto = tmp_path / "train.prototxt"
+        proto.write_text(
+            'name: "train"\ninput: "data"\ninput: "label"\n'
+            'input_shape { dim: 1 dim: 4 }\ninput_shape { dim: 1 }\n'
+            'layer { name: "ip" type: "InnerProduct" bottom: "data" '
+            'top: "ip" inner_product_param { num_output: 3 } }\n'
+            'layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" '
+            'bottom: "label" top: "loss" }\n')
+        from bigdl_tpu.utils.caffe import caffe_minimal_pb2 as pb
+        net = pb.NetParameter()
+        layer = net.layer.add()
+        layer.name, layer.type = "ip", "InnerProduct"
+        w = np.random.RandomState(4).normal(size=(3, 4)).astype(np.float32)
+        for arr in (w, np.zeros(3, np.float32)):
+            b = layer.blobs.add()
+            b.shape.dim.extend(arr.shape)
+            b.data.extend(float(v) for v in arr.ravel())
+        weights = tmp_path / "train.caffemodel"
+        weights.write_bytes(net.SerializeToString())
+        loader = CaffeLoader(str(proto), str(weights))
+        g = loader.load()
+        crit = loader.criterion()
+        assert isinstance(crit, nn.ClassNLLCriterion)
+        x = np.random.RandomState(5).normal(size=(2, 4)).astype(np.float32)
+        out = np.asarray(g.evaluate().forward([x, np.zeros((2, 1),
+                                                           np.float32)]))
+        np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-5)
+
+        proto2 = tmp_path / "euc.prototxt"
+        proto2.write_text(
+            'name: "euc"\ninput: "pred"\ninput: "tgt"\n'
+            'input_shape { dim: 1 dim: 4 }\ninput_shape { dim: 1 dim: 4 }\n'
+            'layer { name: "id" type: "Power" bottom: "pred" top: "out" }\n'
+            'layer { name: "loss" type: "EuclideanLoss" bottom: "out" '
+            'bottom: "tgt" top: "loss" }\n')
+        loader2 = CaffeLoader(str(proto2))
+        g2 = loader2.load()
+        assert isinstance(loader2.criterion(), nn.MSECriterion)
+        # criterion-only layer left no module: "out" is the graph output
+        y = np.ones((1, 4), np.float32)
+        out2 = np.asarray(g2.evaluate().forward([y, y]))
+        np.testing.assert_allclose(out2, y)
+
+    def test_v1_power_threshold_slice_upgrade(self, tmp_path):
+        proto = tmp_path / "v1.prototxt"
+        proto.write_text(
+            'name: "v1"\ninput: "data"\n'
+            'input_dim: 1\ninput_dim: 4\n'
+            'layers { name: "p" type: POWER bottom: "data" top: "p" '
+            'power_param { power: 2 } }\n'
+            'layers { name: "t" type: THRESHOLD bottom: "p" top: "t" '
+            'threshold_param { threshold: 4 } }\n')
+        net = load_caffe(str(proto))
+        x = np.asarray([[1., 2., 3., 4.]], np.float32)
+        out = np.asarray(net.evaluate().forward(x))
+        np.testing.assert_allclose(out, (x ** 2 > 4).astype(np.float32) *
+                                   (x ** 2))
