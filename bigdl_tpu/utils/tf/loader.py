@@ -82,6 +82,71 @@ class _ReduceMean(nn.Module):
                         keepdims=self.keep_dims), state
 
 
+class _Permute(nn.Module):
+    """Static-axis transpose (TF Transpose with Const perm)."""
+
+    def __init__(self, perm, name=None):
+        super().__init__(name)
+        self.perm = tuple(int(p) for p in perm)
+
+    def apply(self, params, input, state, training=False, rng=None):
+        return jnp.transpose(input, self.perm), state
+
+
+class _LRNLastAxis(nn.Module):
+    """TF ``tf.nn.lrn`` semantics: window of ``2*depth_radius+1`` over the
+    LAST axis (TF LRN is NHWC-only), denom = (bias + alpha*sum(sq))^beta —
+    note TF's alpha is NOT divided by the window size (caffe's is)."""
+
+    def __init__(self, depth_radius, bias, alpha, beta, name=None):
+        super().__init__(name)
+        self.depth_radius = int(depth_radius)
+        self.bias = float(bias)
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+
+    def apply(self, params, input, state, training=False, rng=None):
+        sq = input * input
+        size = 2 * self.depth_radius + 1
+        pad = [(0, 0)] * (input.ndim - 1) + [(self.depth_radius,
+                                              self.depth_radius)]
+        padded = jnp.pad(sq, pad)
+        c = input.shape[-1]
+        window = padded[..., 0:c]
+        for i in range(1, size):
+            window = window + padded[..., i:i + c]
+        return input / (self.bias + self.alpha * window) ** self.beta, state
+
+
+class _StridedSliceStatic(nn.Module):
+    """TF StridedSlice with Const begin/end/strides and begin/end/shrink
+    masks (no ellipsis/new_axis); all bounds static."""
+
+    def __init__(self, begin, end, strides, begin_mask, end_mask,
+                 shrink_mask, name=None):
+        super().__init__(name)
+        self.begin = [int(b) for b in begin]
+        self.end = [int(e) for e in end]
+        self.strides = [int(s) for s in strides]
+        self.begin_mask = int(begin_mask)
+        self.end_mask = int(end_mask)
+        self.shrink_mask = int(shrink_mask)
+
+    def apply(self, params, input, state, training=False, rng=None):
+        idx = []
+        for d in range(input.ndim):
+            if d >= len(self.begin):
+                idx.append(slice(None))
+                continue
+            if self.shrink_mask & (1 << d):
+                idx.append(self.begin[d])
+                continue
+            b = None if self.begin_mask & (1 << d) else self.begin[d]
+            e = None if self.end_mask & (1 << d) else self.end[d]
+            idx.append(slice(b, e, self.strides[d]))
+        return input[tuple(idx)], state
+
+
 class TensorflowLoader:
     """Pattern-matching GraphDef → Graph converter."""
 
@@ -140,14 +205,45 @@ class TensorflowLoader:
             seen += 1
         return node if node.op == "Const" else None
 
-    def _convert(self, name: str) -> ModuleNode:
-        name = name.split(":")[0]
-        if name in self._converted:
-            return self._converted[name]
+    def _convert(self, ref: str) -> ModuleNode:
+        name, _, out_idx = ref.lstrip("^").partition(":")
+        idx = int(out_idx) if out_idx else 0
         node = self.nodes[name]
-        mn = self._emit(node)
-        self._converted[name] = mn
+        multi = node.op in ("Split", "Unpack")
+        key = f"{name}:{idx}" if (idx or multi) else name
+        if key in self._converted:
+            return self._converted[key]
+        mn = self._emit_indexed(node, idx) if multi else self._emit(node)
+        self._converted[key] = mn
         return mn
+
+    def _emit_indexed(self, node, idx: int) -> ModuleNode:
+        """Multi-output ops: each ``name:idx`` reference becomes its own
+        selector node over the shared upstream input."""
+        if node.op == "Split":
+            # Split(split_dim Const, value), attr num_split: output idx is
+            # the idx-th of num_split equal slices along the axis
+            dim_node = self._resolve_const(self._in(node, 0))
+            if dim_node is None:
+                raise ValueError(f"Split {node.name}: dynamic split_dim "
+                                 "unsupported")
+            axis = int(_const_value(dim_node))
+            n = int(node.attr["num_split"].i)
+            m = nn.SplitAndSelect(axis + 1 if axis >= 0 else axis,
+                                  idx + 1, n, name=f"{node.name}_{idx}")
+            return ModuleNode(m).inputs(self._convert(node.input[1]))
+        # Unpack(value), attrs num/axis: removes the axis — SplitTable
+        # (shared base node) + SelectTable per output index
+        axis = int(node.attr["axis"].i)
+        base_key = f"{node.name}:__table"
+        base = self._converted.get(base_key)
+        if base is None:
+            st = nn.SplitTable(axis + 1 if axis >= 0 else axis,
+                               name=node.name)
+            base = ModuleNode(st).inputs(self._convert(node.input[0]))
+            self._converted[base_key] = base
+        sel = nn.SelectTable(idx + 1, name=f"{node.name}_{idx}")
+        return ModuleNode(sel).inputs(base)
 
     def _emit(self, node) -> ModuleNode:
         op = node.op
@@ -263,8 +359,8 @@ class TensorflowLoader:
             v = _const_value(b)
             if v.ndim == 0:
                 return self._unary(node, nn.AddConstant(float(v)))
-            raise ValueError(f"Add {node.name}: tensor Const addend "
-                             "unsupported")
+            # tensor Const addend: the Const handler makes it a graph
+            # value, the add is an ordinary CAddTable
         m = nn.CAddTable()
         m.name = node.name
         return ModuleNode(m).inputs(self._convert(node.input[0]),
@@ -332,6 +428,124 @@ class TensorflowLoader:
         keep = bool(node.attr["keep_dims"].b)
         m = _ReduceMean(axes, keep, name=node.name)
         return ModuleNode(m).inputs(self._convert(node.input[0]))
+
+    def _op_pack(self, node):
+        """Pack(values..., N, axis) -> nn.Pack (stack along a new dim)."""
+        n = int(node.attr["N"].i)
+        axis = int(node.attr["axis"].i)
+        m = nn.Pack(axis + 1 if axis >= 0 else axis)
+        m.name = node.name
+        return ModuleNode(m).inputs(*[self._convert(node.input[i])
+                                      for i in range(n)])
+
+    def _op_stridedslice(self, node):
+        parts = [self._resolve_const(self._in(node, i)) for i in (1, 2, 3)]
+        if any(p is None for p in parts):
+            raise ValueError(f"{node.name}: dynamic StridedSlice bounds "
+                             "unsupported")
+        begin, end, strides = (_const_value(p).reshape(-1) for p in parts)
+        if int(node.attr["ellipsis_mask"].i) or \
+                int(node.attr["new_axis_mask"].i):
+            raise ValueError(f"{node.name}: ellipsis/new_axis StridedSlice "
+                             "masks unsupported")
+        m = _StridedSliceStatic(begin, end, strides,
+                                node.attr["begin_mask"].i,
+                                node.attr["end_mask"].i,
+                                node.attr["shrink_axis_mask"].i,
+                                name=node.name)
+        return ModuleNode(m).inputs(self._convert(node.input[0]))
+
+    def _op_const(self, node):
+        """Standalone Const reachable as a graph value (TF folds static
+        shapes/fills into these).  Sourceless — Graph feeds it the graph
+        input, which nn.Const ignores."""
+        return ModuleNode(nn.Const(_const_value(node), name=node.name))
+
+    def _op_selectv2(self, node):
+        """SelectV2: only the modern tf.nn.dropout subgraph —
+        SelectV2(GreaterEqual(RandomUniform, rate), Mul(x, 1/keep), 0)
+        imports as nn.Dropout(rate)."""
+        cond = self._in(node, 0)
+        t = self._in(node, 1)
+        if cond.op == "GreaterEqual":
+            rnd = self._in(cond, 0)
+            rate_node = self._resolve_const(self._in(cond, 1))
+            if rnd.op == "RandomUniform" and rate_node is not None:
+                rate = float(_const_value(rate_node))
+                src_ref = None
+                if t.op == "Mul":
+                    # strip the 1/keep prescale on the kept branch
+                    for i, j in ((1, 0), (0, 1)):
+                        if self._resolve_const(self._in(t, i)) is not None:
+                            src_ref = t.input[j]
+                            break
+                if src_ref is not None:
+                    m = nn.Dropout(rate)
+                    m.name = node.name
+                    return ModuleNode(m).inputs(self._convert(src_ref))
+        raise ValueError(f"SelectV2 {node.name}: only the tf.nn.dropout "
+                         "subgraph pattern is supported")
+
+    def _op_shape(self, node):
+        return self._unary(node, nn.Shape())
+
+    def _op_transpose(self, node):
+        perm_node = self._resolve_const(self._in(node, 1))
+        if perm_node is None:
+            raise ValueError(f"{node.name}: dynamic Transpose perm "
+                             "unsupported")
+        return self._unary(node, _Permute(_const_value(perm_node)
+                                          .reshape(-1)))
+
+    def _op_lrn(self, node):
+        return self._unary(node, _LRNLastAxis(
+            node.attr["depth_radius"].i or 5,
+            node.attr["bias"].f if node.attr["bias"].f else 1.0,
+            node.attr["alpha"].f if node.attr["alpha"].f else 1.0,
+            node.attr["beta"].f if node.attr["beta"].f else 0.5))
+
+    def _op_fill(self, node):
+        """Fill(dims, value): folded to a Const when both are static (the
+        jit-friendly form — a dynamic output shape cannot trace)."""
+        dims_node = self._resolve_const(self._in(node, 0))
+        val_node = self._resolve_const(self._in(node, 1))
+        if dims_node is None or val_node is None:
+            raise ValueError(f"{node.name}: dynamic Fill unsupported "
+                             "(XLA needs a static output shape)")
+        dims = tuple(int(d) for d in _const_value(dims_node).reshape(-1))
+        value = _const_value(val_node)
+        m = nn.Const(np.full(dims, value), name=node.name)
+        return ModuleNode(m).inputs(self._convert(node.input[0]))
+
+    def _op_mul(self, node):
+        """Mul: the tf.nn.dropout(v1) subgraph
+        Mul(RealDiv(x, keep), Floor(Add(RandomUniform, keep))) imports as
+        nn.Dropout (the reference's DropoutTF pattern); a scalar-Const
+        factor becomes MulConstant; otherwise elementwise CMulTable."""
+        ins = [self._in(node, 0), self._in(node, 1)]
+        ops = [n.op for n in ins]
+        if "RealDiv" in ops and "Floor" in ops:
+            div = ins[ops.index("RealDiv")]
+            keep_node = self._resolve_const(self._in(div, 1))
+            if keep_node is not None:
+                keep = float(_const_value(keep_node))
+                m = nn.Dropout(1.0 - keep)
+                m.name = node.name
+                return ModuleNode(m).inputs(self._convert(div.input[0]))
+        for i, other in ((0, 1), (1, 0)):
+            c = self._resolve_const(ins[i])
+            if c is not None:
+                v = _const_value(c)
+                if v.ndim == 0:
+                    m = nn.MulConstant(float(v))
+                    m.name = node.name
+                    return ModuleNode(m).inputs(
+                        self._convert(node.input[other]))
+                break   # tensor Const factor: elementwise via CMulTable
+        m = nn.CMulTable()
+        m.name = node.name
+        return ModuleNode(m).inputs(self._convert(node.input[0]),
+                                    self._convert(node.input[1]))
 
     def _op_maxpool(self, node):
         return self._pool(node, nn.SpatialMaxPooling)

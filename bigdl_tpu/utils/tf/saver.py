@@ -100,6 +100,31 @@ def _emit_module(tf, module, x):
         return tf.squeeze(x)
     if isinstance(module, (nn.Identity, nn.Dropout)):
         return tf.identity(x)   # Dropout exports as inference-time identity
+    if isinstance(module, nn.BatchNormalization):
+        # fused inference form (FusedBatchNormV3) — the same op this
+        # package's loader imports, so the round trip is exact
+        st = module.state
+        n = module.n_output
+        scale = _np(p["weight"]) if module.affine else np.ones(n, np.float32)
+        offset = _np(p["bias"]) if module.affine else np.zeros(n, np.float32)
+        fmt = "NCHW" if getattr(module, "channel_axis", 1) == 1 else "NHWC"
+        y, _, _ = tf.compat.v1.nn.fused_batch_norm(
+            x, scale.astype(np.float32), offset.astype(np.float32),
+            mean=_np(st["running_mean"]).astype(np.float32),
+            variance=_np(st["running_var"]).astype(np.float32),
+            epsilon=module.eps, data_format=fmt, is_training=False)
+        return y
+    if isinstance(module, nn.SpatialCrossMapLRN):
+        # tf.nn.lrn is NHWC-only and its alpha is per-element (caffe's is
+        # divided by the window size): transpose around the op and rescale
+        if module.size % 2 == 0:
+            raise ValueError(f"LRN {module.name}: even window size has no "
+                             "TF depth_radius equivalent")
+        xt = tf.transpose(x, [0, 2, 3, 1])
+        y = tf.nn.lrn(xt, depth_radius=(module.size - 1) // 2,
+                      bias=module.k, alpha=module.alpha / module.size,
+                      beta=module.beta)
+        return tf.transpose(y, [0, 3, 1, 2])
     raise ValueError(
         f"layer {type(module).__name__} has no GraphDef export mapping "
         "(reference BigDLToTensorflow scope)")
@@ -128,6 +153,11 @@ def _emit_graph(tf, graph, x):
         m = node.element
         if isinstance(m, nn.CAddTable):
             outputs[id(node)] = tf.add_n(ins)
+        elif isinstance(m, nn.CMulTable):
+            y = ins[0]
+            for extra in ins[1:]:
+                y = tf.multiply(y, extra)
+            outputs[id(node)] = y
         elif isinstance(m, nn.JoinTable):
             # our JoinTable dimension is 1-based over the full tensor
             outputs[id(node)] = tf.concat(ins, axis=m.dimension - 1)

@@ -389,3 +389,158 @@ class TestLoaderRegressions:
         theirs = _run_tf(gd, "input", xv, "output")
         assert ours.shape == theirs.shape == (2, 6)
         np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-4)
+
+
+class TestTensorflowPatternParity:
+    """The remaining reference TensorflowToBigDL patterns (VERDICT r2 row
+    31): Split/Pack/Unpack/StridedSlice/Shape/Fill/Mul/Dropout import and
+    BatchNorm/LRN/table-op export — each golden-checked against real TF."""
+
+    def _golden(self, build, x, rtol=1e-5, atol=1e-5, outputs=("output",)):
+        from bigdl_tpu.utils.tf import TensorflowLoader
+        g = tf.Graph()
+        with g.as_default():
+            build(tf)
+        gd = g.as_graph_def()
+        model = TensorflowLoader.load(gd, ["input"], list(outputs))
+        ours = model.evaluate().forward(x)
+        if len(outputs) == 1:
+            ours = [ours]
+        for out_name, mine in zip(outputs, ours):
+            theirs = _run_tf(gd, "input", x, out_name)
+            np.testing.assert_allclose(np.asarray(mine), theirs,
+                                       rtol=rtol, atol=atol)
+
+    def test_split_mul_parity(self):
+        def build(tf):
+            x = tf.compat.v1.placeholder(tf.float32, [None, 6],
+                                         name="input")
+            a, b = tf.split(x, 2, axis=1)
+            tf.multiply(a, b, name="output")
+        x = np.random.RandomState(0).normal(size=(3, 6)).astype(np.float32)
+        self._golden(build, x)
+
+    def test_unpack_pack_parity(self):
+        def build(tf):
+            x = tf.compat.v1.placeholder(tf.float32, [2, 3, 4],
+                                         name="input")
+            parts = tf.unstack(x, axis=1)
+            tf.stack(parts[::-1], axis=1, name="output")
+        x = np.random.RandomState(1).normal(size=(2, 3, 4)).astype(np.float32)
+        self._golden(build, x)
+
+    def test_strided_slice_parity(self):
+        def build(tf):
+            x = tf.compat.v1.placeholder(tf.float32, [2, 6, 4],
+                                         name="input")
+            tf.identity(x[:, 1:5:2, ::2], name="output")
+        x = np.random.RandomState(2).normal(size=(2, 6, 4)).astype(np.float32)
+        self._golden(build, x)
+
+    def test_strided_slice_shrink_axis_parity(self):
+        def build(tf):
+            x = tf.compat.v1.placeholder(tf.float32, [2, 6, 4],
+                                         name="input")
+            tf.identity(x[:, 2], name="output")
+        x = np.random.RandomState(3).normal(size=(2, 6, 4)).astype(np.float32)
+        self._golden(build, x)
+
+    def test_shape_and_fill_parity(self):
+        from bigdl_tpu.utils.tf import TensorflowLoader
+        g = tf.Graph()
+        with g.as_default():
+            # dynamic batch keeps the Shape op live (static shapes fold)
+            x = tf.compat.v1.placeholder(tf.float32, [None, 5],
+                                         name="input")
+            tf.identity(tf.shape(x), name="shape_out")
+            f = tf.fill([2, 5], 3.5)   # static: folds to Const / Fill
+            tf.add(x, f, name="output")
+        gd = g.as_graph_def()
+        x = np.random.RandomState(4).normal(size=(2, 5)).astype(np.float32)
+        model = TensorflowLoader.load(gd, ["input"], ["shape_out"])
+        np.testing.assert_array_equal(
+            np.asarray(model.evaluate().forward(x)), [2, 5])
+        model2 = TensorflowLoader.load(gd, ["input"], ["output"])
+        got = np.asarray(model2.evaluate().forward(x))
+        np.testing.assert_allclose(got, _run_tf(gd, "input", x, "output"),
+                                   rtol=1e-6)
+
+    def test_scalar_mul_const_parity(self):
+        def build(tf):
+            x = tf.compat.v1.placeholder(tf.float32, [None, 4],
+                                         name="input")
+            tf.multiply(x, tf.constant(2.5), name="output")
+        x = np.random.RandomState(5).normal(size=(3, 4)).astype(np.float32)
+        self._golden(build, x)
+
+    def test_dropout_subgraph_imports_as_dropout(self):
+        """The tf.nn.dropout(v1) mul/div/floor subgraph maps to nn.Dropout
+        — identity at inference, the reference's DropoutTF pattern."""
+        from bigdl_tpu.utils.tf import TensorflowLoader
+        g = tf.Graph()
+        with g.as_default():
+            x = tf.compat.v1.placeholder(tf.float32, [2, 4], name="input")
+            y = tf.compat.v1.nn.dropout(x, keep_prob=0.6)
+            tf.identity(y, name="output")
+        model = TensorflowLoader.load(g.as_graph_def(), ["input"],
+                                      ["output"])
+        drops = model.find_modules(nn.Dropout)
+        assert drops and abs(drops[0].p - 0.4) < 1e-6
+        x = np.random.RandomState(6).normal(size=(2, 4)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(model.evaluate().forward(x)),
+                                   x, rtol=1e-6)
+
+    def test_lrn_import_parity(self):
+        def build(tf):
+            x = tf.compat.v1.placeholder(tf.float32, [2, 6, 6, 8],
+                                         name="input")
+            tf.nn.lrn(x, depth_radius=2, bias=1.5, alpha=0.3, beta=0.6,
+                      name="output")
+        x = np.random.RandomState(7).normal(
+            size=(2, 6, 6, 8)).astype(np.float32)
+        self._golden(build, x, rtol=1e-4, atol=1e-4)
+
+    def test_bn_export_roundtrip_and_tf_parity(self, tmp_path):
+        from bigdl_tpu.utils.tf import TensorflowLoader, saver
+        rng = np.random.RandomState(8)
+        model = (nn.Sequential()
+                 .add(nn.SpatialConvolution(3, 4, 3, 3, 1, 1, -1, -1,
+                                            format="NHWC"))
+                 .add(nn.SpatialBatchNormalization(4, format="NHWC"))
+                 .add(nn.ReLU()))
+        model._ensure_init()
+        bn = model.children[1]
+        bn.state["running_mean"] = rng.normal(size=(4,)).astype(np.float32)
+        bn.state["running_var"] = rng.uniform(
+            0.5, 2.0, size=(4,)).astype(np.float32)
+        path = str(tmp_path / "bn.pb")
+        saver.save(model, [None, 8, 8, 3], path)
+        x = rng.normal(size=(2, 8, 8, 3)).astype(np.float32)
+        ours = np.asarray(model.evaluate().forward(x))
+        gd = tf.compat.v1.GraphDef()
+        with open(path, "rb") as f:
+            gd.ParseFromString(f.read())
+        theirs = _run_tf(gd, "input", x, "output")
+        np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-4)
+        back = TensorflowLoader.load(gd, ["input"], ["output"])
+        np.testing.assert_allclose(np.asarray(back.evaluate().forward(x)),
+                                   ours, rtol=1e-4, atol=1e-4)
+
+    def test_lrn_export_roundtrip_and_tf_parity(self, tmp_path):
+        from bigdl_tpu.utils.tf import TensorflowLoader, saver
+        model = (nn.Sequential()
+                 .add(nn.SpatialCrossMapLRN(5, 1.0, 0.75, 1.0)))
+        model._ensure_init()
+        path = str(tmp_path / "lrn.pb")
+        saver.save(model, [None, 8, 6, 6], path)
+        x = np.random.RandomState(9).normal(
+            size=(2, 8, 6, 6)).astype(np.float32)
+        ours = np.asarray(model.evaluate().forward(x))
+        gd = tf.compat.v1.GraphDef()
+        with open(path, "rb") as f:
+            gd.ParseFromString(f.read())
+        theirs = _run_tf(gd, "input", x, "output")
+        np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-4)
+        back = TensorflowLoader.load(gd, ["input"], ["output"])
+        np.testing.assert_allclose(np.asarray(back.evaluate().forward(x)),
+                                   ours, rtol=1e-4, atol=1e-4)
