@@ -161,27 +161,48 @@ def main():
         print(json.dumps(result))
         return
 
-    # Long-context diagnostic (stderr only): transformer-LM training
-    # tokens/sec through the same fused step — the beyond-reference
-    # flagship; failures here must not touch the headline number.
+    # Long-context flagship leg: a REALISTIC LM shape — 134M params,
+    # d1024/L8/T2048/B8 bf16 (head_dim 128) — through the same fused
+    # step.  Measured r3 on one v5e: ~107k tokens/s = ~55% MFU (the
+    # earlier d256/T512 toy leg sat at ~6%: latency-bound, not a model
+    # of anything).  Flash attention RE-measured at THIS shape is still
+    # slower than XLA's fused path (67k vs 99k tokens/s at B4), so the
+    # default attention stays; see bench_lm.json for the pinned record.
+    # Failures here must not touch the headline metric.
     try:
+        import jax as _jax
         import bigdl_tpu.nn as nn
         from bigdl_tpu.models.transformer import transformer_lm
 
-        b, t = 16, 512
-        lm = transformer_lm(1024, d_model=256, n_head=8, n_layers=4,
-                            max_len=t)
+        v, d, nl, h, t, b = 16384, 1024, 8, 8, 2048, 8
+        lm = transformer_lm(v, d_model=d, n_head=h, n_layers=nl, max_len=t)
         r_lm = bench_model(
-            lm, b, (t,), 1024, steps=args.steps,
+            lm, b, (t,), v, steps=args.steps,
             precision="bf16",
             criterion=nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
                                                   size_average=True),
             make_batch=lambda rng, bsz: (
-                rng.randint(1, 1025, (bsz, t)).astype(np.float32),
-                rng.randint(1, 1025, (bsz, t)).astype(np.float32)))
-        _log(f"transformer-lm (b{b} T{t} d256 L4, bf16): "
-             f"{r_lm['images_per_sec'] * t:,.0f} tokens/s "
-             f"({r_lm['step_ms']:.1f} ms/step)")
+                rng.randint(1, v + 1, (bsz, t)).astype(np.float32),
+                rng.randint(1, v + 1, (bsz, t)).astype(np.float32)))
+        toks = r_lm["images_per_sec"] * t
+        n_params = sum(int(np.prod(l.shape))
+                       for l in _jax.tree_util.tree_leaves(lm.params))
+        # training matmul FLOPs/token: 6*params + attention 12*L*d*T;
+        # bf16 peak of one v5e chip ~197 TFLOP/s
+        mfu = toks * (6 * n_params + 12 * nl * d * t) / 197e12
+        _log(f"transformer-lm (B{b} T{t} d{d} L{nl} vocab {v}, "
+             f"{n_params / 1e6:.0f}M params, bf16): {toks:,.0f} tokens/s "
+             f"({r_lm['step_ms']:.1f} ms/step, MFU {mfu * 100:.1f}%)")
+        lm_record = {"metric": "transformer_lm_train_tokens_per_sec",
+                     "value": round(toks, 0), "unit": "tokens/sec",
+                     "mfu": round(mfu, 3),
+                     "config": {"batch": b, "seq_len": t, "d_model": d,
+                                "n_layers": nl, "n_head": h, "vocab": v,
+                                "params_m": round(n_params / 1e6, 1),
+                                "precision": "bf16"}}
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "bench_lm.json"), "w") as f:
+            json.dump(lm_record, f, indent=1)
     except Exception as e:  # diagnostic only
         _log(f"transformer-lm bench skipped: {e}")
 
