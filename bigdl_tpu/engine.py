@@ -60,6 +60,66 @@ class DispatchPipeline:
         self._drain(item, self._q[0] if self._q else None)
 
 
+class BatchPrefetcher:
+    """Background thread running ``fetch()`` ahead of the training loop.
+
+    The fetch path ends in a host→device transfer (``device_put`` /
+    ``make_array_from_process_local_data``) that costs a tunnel
+    round-trip when the chip is remote (~15 ms measured) — overlapping
+    it with the jitted step removes it from the critical path.  Single
+    producer: the loop thread only consumes, so dataset iterators are
+    never touched concurrently (an epoch reset swaps the iterator
+    reference the fetch closure reads — the training stream is infinite,
+    so a batch prefetched across the boundary stays valid, exactly like
+    the reference's pipelined RDD fetch).
+
+    ``depth`` defaults to ``bigdl.prefetch.depth`` (2); 0 disables (the
+    call becomes a passthrough).  Exceptions in the producer re-raise at
+    the consuming call site.
+    """
+
+    def __init__(self, fetch, depth: Optional[int] = None):
+        import queue
+
+        from bigdl_tpu.utils import config
+        self.depth = (depth if depth is not None
+                      else config.get_int("bigdl.prefetch.depth", 2))
+        self._fetch = fetch
+        if self.depth <= 0:
+            return
+        self._q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                item = (None, self._fetch())
+            except BaseException as e:  # noqa: BLE001 — re-raised at call
+                item = (e, None)
+            while not self._stop.is_set():
+                try:
+                    self._q.put(item, timeout=0.1)
+                    break
+                except Exception:
+                    continue
+            if item[0] is not None:
+                return
+
+    def __call__(self):
+        if self.depth <= 0:
+            return self._fetch()
+        err, batch = self._q.get()
+        if err is not None:
+            raise err
+        return batch
+
+    def stop(self):
+        if self.depth > 0:
+            self._stop.set()
+
+
 class _EngineState:
     def __init__(self):
         self.engine_type: str = "tpu"

@@ -365,53 +365,67 @@ class Optimizer:
                 flush_pending()
             return self.end_when(state)
 
-        while not should_end():
-            t_data = time.time_ns()
-            inputs, targets, bsz = fetch_batch()
-            self.metrics.add("get batch time", time.time_ns() - t_data)
+        # batch prefetch: the host->device transfer inside fetch_batch is
+        # a tunnel round-trip — run it ahead on a producer thread.  Safe
+        # across epoch rollovers: the producer alone touches the dataset
+        # iterators (single producer), the training stream is infinite,
+        # and reset_epoch only swaps the iterator reference the fetch
+        # closure reads.  bigdl.prefetch.depth=0 restores synchronous
+        # fetching.
+        from bigdl_tpu.engine import BatchPrefetcher
+        fetch = BatchPrefetcher(fetch_batch)
+        try:
+            while not should_end():
+                t_data = time.time_ns()
+                inputs, targets, bsz = fetch()
+                self.metrics.add("get batch time", time.time_ns() - t_data)
 
-            self.optim_method.state["epoch"] = state["epoch"]
-            hyper = self.optim_method.hyper()
-            rng = (jax.random.PRNGKey(rng_counter) if stochastic else
-                   jax.random.PRNGKey(0))
-            rng_counter += 1
+                self.optim_method.state["epoch"] = state["epoch"]
+                hyper = self.optim_method.hyper()
+                rng = (jax.random.PRNGKey(rng_counter) if stochastic else
+                       jax.random.PRNGKey(0))
+                rng_counter += 1
 
-            t0 = time.time_ns()
-            loss_dev = run_step(inputs, targets, hyper, rng)
-            self.optim_method.step_done()
-            pipeline.push(loss_dev, bsz, t0, state["epoch"],
-                          state["recordsProcessedThisEpoch"] + bsz,
-                          state["neval"])
+                t0 = time.time_ns()
+                loss_dev = run_step(inputs, targets, hyper, rng)
+                self.optim_method.step_done()
+                pipeline.push(loss_dev, bsz, t0, state["epoch"],
+                              state["recordsProcessedThisEpoch"] + bsz,
+                              state["neval"])
 
-            state["recordsProcessedThisEpoch"] += bsz
+                state["recordsProcessedThisEpoch"] += bsz
 
-            # epoch rollover + reshuffle (reference DistriOptimizer:333-344)
-            if state["recordsProcessedThisEpoch"] >= epoch_size:
-                state["epoch"] += 1
-                state["recordsProcessedThisEpoch"] = 0
-                reset_epoch()
+                # epoch rollover + reshuffle (reference
+                # DistriOptimizer:333-344)
+                if state["recordsProcessedThisEpoch"] >= epoch_size:
+                    state["epoch"] += 1
+                    state["recordsProcessedThisEpoch"] = 0
+                    reset_epoch()
 
-            state["neval"] += 1
-            # keep the snapshot's epoch current across the rollover so a
-            # resumed run continues at the right epoch
-            self.optim_method.state["epoch"] = state["epoch"]
+                state["neval"] += 1
+                # keep the snapshot's epoch current across the rollover so
+                # a resumed run continues at the right epoch
+                self.optim_method.state["epoch"] = state["epoch"]
 
-            v_due = self._validation_due(state)
-            c_due = self._checkpoint_due(state)
-            p_due = (self.train_summary is not None and
-                     getattr(self.train_summary, "save_parameters_due",
-                             lambda s: False)(state))
-            if v_due or c_due or p_due:
-                flush_pending()       # ordered log lines before validation
-                publish()
-                if v_due:
-                    self._run_validation(state)
-                if c_due:
-                    self._run_checkpoint(state)
-                if p_due:
-                    # weight histograms (reference DistriOptimizer:426-456)
-                    self.train_summary.save_parameters(self.model,
-                                                       state["neval"] - 1)
+                v_due = self._validation_due(state)
+                c_due = self._checkpoint_due(state)
+                p_due = (self.train_summary is not None and
+                         getattr(self.train_summary, "save_parameters_due",
+                                 lambda s: False)(state))
+                if v_due or c_due or p_due:
+                    flush_pending()   # ordered log lines before validation
+                    publish()
+                    if v_due:
+                        self._run_validation(state)
+                    if c_due:
+                        self._run_checkpoint(state)
+                    if p_due:
+                        # weight histograms (reference
+                        # DistriOptimizer:426-456)
+                        self.train_summary.save_parameters(
+                            self.model, state["neval"] - 1)
+        finally:
+            fetch.stop()
 
         flush_pending()
         publish()

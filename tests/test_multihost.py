@@ -96,7 +96,10 @@ def test_two_process_training_matches_single_process():
             stderr=subprocess.PIPE, text=True) for pid in (0, 1)]
         outs = []
         for p in procs:
-            out, err = p.communicate(timeout=600)
+            # generous: under full-suite CPU contention the two extra
+            # processes (each compiling on a 4-device virtual mesh) can
+            # take minutes; 15 s on an idle machine
+            out, err = p.communicate(timeout=1200)
             outs.append((p.returncode, out, err))
         for rc, out, err in outs:
             assert rc == 0 and "WORKER_OK" in out, (out, err[-3000:])
