@@ -104,7 +104,12 @@ def regularization_penalty(module: Module, params) -> jnp.ndarray:
 
 class Checkpoint:
     """model.<neval> / optimMethod.<neval> snapshot protocol
-    (reference ``optim/DistriOptimizer.scala:394-416``)."""
+    (reference ``optim/DistriOptimizer.scala:394-416``).
+
+    ``path`` may be local or any fsspec scheme (``hdfs://``, ``s3://``,
+    ``memory://``, …) — the reference checkpoints to HDFS the same way
+    (``File.saveToHdfs:106``); listing/joining go through
+    ``utils.file_io`` so ``latest()`` resolves remotely too."""
 
     def __init__(self, path: str, trigger: Trigger, isOverwrite: bool = True):
         self.path = path
@@ -113,17 +118,16 @@ class Checkpoint:
 
     def save(self, model: Module, optim: OptimMethod, neval: int) -> None:
         from bigdl_tpu.utils import file_io
-        os.makedirs(self.path, exist_ok=True)
-        file_io.save(model, os.path.join(self.path, f"model.{neval}"),
+        file_io.makedirs(self.path)
+        file_io.save(model, file_io.join(self.path, f"model.{neval}"),
                      self.overwrite)
-        file_io.save(optim, os.path.join(self.path, f"optimMethod.{neval}"),
+        file_io.save(optim, file_io.join(self.path, f"optimMethod.{neval}"),
                      self.overwrite)
 
     def latest(self) -> Optional[Tuple[str, str, int]]:
-        if not os.path.isdir(self.path):
-            return None
+        from bigdl_tpu.utils import file_io
         nevals = []
-        for f in os.listdir(self.path):
+        for f in file_io.listdir(self.path):
             if f.startswith("model."):
                 try:
                     nevals.append(int(f.split(".")[1]))
@@ -132,8 +136,8 @@ class Checkpoint:
         if not nevals:
             return None
         n = max(nevals)
-        return (os.path.join(self.path, f"model.{n}"),
-                os.path.join(self.path, f"optimMethod.{n}"), n)
+        return (file_io.join(self.path, f"model.{n}"),
+                file_io.join(self.path, f"optimMethod.{n}"), n)
 
 
 class Optimizer:

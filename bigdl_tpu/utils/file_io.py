@@ -17,11 +17,55 @@ import tempfile
 from typing import Any
 
 _REMOTE_SCHEMES = ("hdfs://", "s3://", "s3a://", "s3n://", "gs://",
-                   "abfs://", "http://", "https://")
+                   "abfs://", "http://", "https://", "memory://")
 
 
 def _is_remote(path: str) -> bool:
     return path.startswith(_REMOTE_SCHEMES)
+
+
+def _fs(path: str):
+    """(filesystem, in-fs path) for a remote scheme via fsspec."""
+    import fsspec
+    for alias in ("s3a://", "s3n://"):
+        if path.startswith(alias):
+            path = "s3://" + path[len(alias):]
+    fs, fpath = fsspec.core.url_to_fs(path)
+    return fs, fpath
+
+
+def makedirs(path: str) -> None:
+    """Directory creation for local or remote checkpoint roots
+    (reference checkpoints live under an HDFS dir, ``File.scala:106``)."""
+    if _is_remote(path):
+        fs, p = _fs(path)
+        fs.makedirs(p, exist_ok=True)
+        return
+    if path.startswith("file://"):
+        path = path[len("file://"):]
+    os.makedirs(path, exist_ok=True)
+
+
+def listdir(path: str):
+    """Base names under a local or remote directory; [] when absent."""
+    if _is_remote(path):
+        fs, p = _fs(path)
+        if not fs.exists(p):
+            return []
+        return [e.rstrip("/").rsplit("/", 1)[-1]
+                for e in fs.ls(p, detail=False)]
+    if path.startswith("file://"):
+        path = path[len("file://"):]
+    if not os.path.isdir(path):
+        return []
+    return os.listdir(path)
+
+
+def join(base: str, *parts: str) -> str:
+    """Path join that keeps remote scheme separators."""
+    if _is_remote(base) or base.startswith("file://"):
+        return "/".join([base.rstrip("/")] + [p.strip("/") for p in parts])
+    return os.path.join(base, *parts)
 
 
 def _fsspec_open(path: str, mode: str):

@@ -148,3 +148,69 @@ class TestKillAndResume:
 
         np.testing.assert_allclose(np.asarray(w_c), np.asarray(w_a),
                                    rtol=1e-4, atol=1e-6)
+
+
+class TestRemoteCheckpointIntegration:
+    """Integration-grade remote persistence (reference tags real-HDFS/S3
+    integration suites, ``integration/HdfsSpec.scala``): the FULL
+    train -> checkpoint -> crash -> retry-from-snapshot cycle against a
+    remote fsspec filesystem (memory:// — the scheme this image can
+    actually host; hdfs://, s3://, gs:// route through the identical
+    code path, differing only in the installed client)."""
+
+    def _clean(self):
+        import fsspec
+        fs = fsspec.filesystem("memory")
+        if fs.exists("/bigdl_it"):
+            fs.rm("/bigdl_it", recursive=True)
+
+    def test_checkpoint_roundtrip_over_remote_scheme(self):
+        self._clean()
+        samples = synthetic_separable(128, 4, n_classes=2, seed=3)
+        ds = LocalDataSet(samples).transform(SampleToMiniBatch(32))
+        model = _mlp(4, 2)
+        opt = optim.Optimizer.create(model, ds, nn.ClassNLLCriterion())
+        opt.set_optim_method(optim.SGD(learning_rate=0.5))
+        opt.set_end_when(optim.max_epoch(3))
+        opt.set_checkpoint("memory://bigdl_it/ckpt", optim.every_epoch())
+        trained = opt.optimize()
+
+        latest = opt.checkpoint.latest()
+        assert latest is not None
+        model_path, optim_path, n = latest
+        assert model_path.startswith("memory://")
+        reloaded = file_io.load(model_path)
+        x = np.stack([s.feature for s in samples[:16]])
+        np.testing.assert_allclose(
+            np.asarray(reloaded.evaluate().forward(x)),
+            np.asarray(trained.evaluate().forward(x)),
+            rtol=1e-6)
+        # optim snapshot round-trips with its counters
+        ro = file_io.load(optim_path)
+        assert ro.state["evalCounter"] > 0
+
+    def test_retry_restores_from_remote_snapshot(self):
+        self._clean()
+        samples = synthetic_separable(128, 4, n_classes=2, seed=3)
+        injector = FailOnce(fail_at=9)
+        ds = (LocalDataSet(samples).transform(SampleToMiniBatch(32))
+              .transform(injector))
+        model = _mlp(4, 2)
+        opt = optim.Optimizer.create(model, ds, nn.ClassNLLCriterion())
+        opt.set_optim_method(optim.SGD(learning_rate=0.5))
+        opt.set_end_when(optim.max_epoch(8))
+        opt.set_checkpoint("memory://bigdl_it/retry",
+                           optim.several_iteration(2))
+        trained = opt.optimize()
+        assert injector.tripped
+        acc = Evaluator(trained).test(
+            samples, [optim.Top1Accuracy()], 32)[0][1].final_result()
+        assert acc > 0.9, f"remote-checkpoint recovery failed: acc={acc}"
+
+    def test_overwrite_false_guard_applies_remotely(self):
+        self._clean()
+        file_io.save({"v": 1}, "memory://bigdl_it/obj")
+        with pytest.raises(FileExistsError):
+            file_io.save({"v": 2}, "memory://bigdl_it/obj",
+                         overwrite=False)
+        assert file_io.load("memory://bigdl_it/obj")["v"] == 1
