@@ -158,3 +158,209 @@ def test_dataset_missing_local_partition_rejected():
     opt.set_end_when(optim.max_iteration(1))
     with pytest.raises(ValueError, match="local_partitions"):
         opt.optimize()
+
+
+_SP_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    pid = int(sys.argv[1]); port = sys.argv[2]; outdir = sys.argv[3]
+    from bigdl_tpu.engine import Engine
+    Engine.init_distributed(f"127.0.0.1:{port}", 2, pid)
+
+    import numpy as np
+    import bigdl_tpu.nn as nn
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.dataset import Sample, SampleToMiniBatch
+    from bigdl_tpu.dataset.dataset import ShardedDataSet
+    from bigdl_tpu.parallel import DistriOptimizer
+    from bigdl_tpu.parallel.distri_optimizer import local_data_partitions
+    from bigdl_tpu.nn.attention import MultiHeadAttention
+
+    # dp=1 x sp=8: the single data row spans BOTH processes, so each
+    # process owns only half the seq chunks — the partial-axis
+    # time-slicing path in _global_batch must engage
+    mesh = Engine.create_mesh((1, 8), ("data", "seq"))
+    local = local_data_partitions(mesh)
+    assert local == [0], local
+
+    d_model, seq_t = 16, 32
+    rng = np.random.RandomState(3)
+    seqs = [Sample(rng.normal(size=(seq_t, d_model)).astype(np.float32),
+                   (rng.randint(0, 4, seq_t) + 1).astype(np.float32))
+            for _ in range(8)]
+    lm = (nn.Sequential()
+          .add(nn.Linear(d_model, d_model))
+          .add(MultiHeadAttention(d_model, 2, causal=True))
+          .add(nn.Linear(d_model, 4))
+          .add(nn.LogSoftMax()))
+    lm.reset(jax.random.PRNGKey(11))
+    ds = ShardedDataSet(seqs, 1, local_partitions=local).transform(
+        SampleToMiniBatch(4, 1))
+    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                       size_average=True)
+    opt = DistriOptimizer(lm, ds, crit, mesh=mesh)
+    opt.set_optim_method(optim.SGD(learning_rate=0.1, momentum=0.9))
+    opt.set_end_when(optim.max_iteration(4))
+    trained = opt.optimize()
+    w, _ = trained.get_parameters()
+    np.save(os.path.join(outdir, f"sp_w{pid}.npy"), np.asarray(w))
+    print("SP_WORKER_OK", pid)
+""")
+
+
+@pytest.mark.slow
+def test_two_process_seq_parallel_partial_chunk_ownership():
+    """dp1 x sp8 across 2 processes: each process owns only HALF the seq
+    chunks of the one data row, so _global_batch's time-slicing path runs
+    for real; final weights must match the single-process (1, 8) run."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = _clean_env()
+    with tempfile.TemporaryDirectory() as outdir:
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", _SP_WORKER, str(pid), str(port), outdir],
+            cwd=repo_root, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True) for pid in (0, 1)]
+        outs = []
+        for p in procs:
+            out, err = p.communicate(timeout=1200)
+            outs.append((p.returncode, out, err))
+        for rc, out, err in outs:
+            assert rc == 0 and "SP_WORKER_OK" in out, (out, err[-3000:])
+        w0 = np.load(os.path.join(outdir, "sp_w0.npy"))
+        w1 = np.load(os.path.join(outdir, "sp_w1.npy"))
+        np.testing.assert_array_equal(w0, w1)
+
+        # single-process oracle on the same (1, 8) mesh
+        import jax
+        import bigdl_tpu.nn as nn
+        import bigdl_tpu.optim as optim
+        from bigdl_tpu.dataset import Sample, SampleToMiniBatch
+        from bigdl_tpu.dataset.dataset import ShardedDataSet
+        from bigdl_tpu.engine import Engine
+        from bigdl_tpu.nn.attention import MultiHeadAttention
+        from bigdl_tpu.parallel import DistriOptimizer
+
+        d_model, seq_t = 16, 32
+        rng = np.random.RandomState(3)
+        seqs = [Sample(rng.normal(size=(seq_t, d_model)).astype(np.float32),
+                       (rng.randint(0, 4, seq_t) + 1).astype(np.float32))
+                for _ in range(8)]
+        lm = (nn.Sequential()
+              .add(nn.Linear(d_model, d_model))
+              .add(MultiHeadAttention(d_model, 2, causal=True))
+              .add(nn.Linear(d_model, 4))
+              .add(nn.LogSoftMax()))
+        lm.reset(jax.random.PRNGKey(11))
+        mesh = Engine.create_mesh((1, 8), ("data", "seq"))
+        ds = ShardedDataSet(seqs, 1).transform(SampleToMiniBatch(4, 1))
+        crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                           size_average=True)
+        opt = DistriOptimizer(lm, ds, crit, mesh=mesh)
+        opt.set_optim_method(optim.SGD(learning_rate=0.1, momentum=0.9))
+        opt.set_end_when(optim.max_iteration(4))
+        w_single, _ = opt.optimize().get_parameters()
+        np.testing.assert_allclose(w0, np.asarray(w_single),
+                                   rtol=2e-4, atol=2e-5)
+
+
+_EP_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    pid = int(sys.argv[1]); port = sys.argv[2]; outdir = sys.argv[3]
+    from bigdl_tpu.engine import Engine
+    Engine.init_distributed(f"127.0.0.1:{port}", 2, pid)
+
+    import numpy as np
+    import bigdl_tpu.nn as nn
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.dataset import SampleToMiniBatch
+    from bigdl_tpu.dataset.dataset import ShardedDataSet
+    from bigdl_tpu.dataset.datasets import synthetic_separable
+    from bigdl_tpu.nn.moe import MixtureOfExperts
+    from bigdl_tpu.parallel import DistriOptimizer
+    from bigdl_tpu.parallel.distri_optimizer import local_data_partitions
+
+    # dp=1 x ep=8: each process owns half the expert chunks of the one
+    # data partition -> _global_batch's batch-row slicing engages
+    mesh = Engine.create_mesh((1, 8), ("data", "expert"))
+    local = local_data_partitions(mesh)
+    assert local == [0], local
+
+    samples = synthetic_separable(64, 4, n_classes=2, seed=3)
+    D = 8
+    expert = (nn.Sequential().add(nn.Linear(D, 16)).add(nn.ReLU())
+              .add(nn.Linear(16, D)))
+    moe = MixtureOfExperts(D, expert, 8, capacity_factor=8.0)
+    m = (nn.Sequential().add(nn.Linear(4, D)).add(nn.Tanh()).add(moe)
+         .add(nn.Linear(D, 2)).add(nn.LogSoftMax()))
+    m.reset(jax.random.PRNGKey(7))
+    ds = ShardedDataSet(samples, 1, local_partitions=local).transform(
+        SampleToMiniBatch(32, 1))
+    opt = DistriOptimizer(m, ds, nn.ClassNLLCriterion(), mesh=mesh)
+    opt.set_optim_method(optim.SGD(learning_rate=0.2, momentum=0.9))
+    opt.set_end_when(optim.max_iteration(4))
+    trained = opt.optimize()
+    w, _ = trained.get_parameters()
+    np.save(os.path.join(outdir, f"ep_w{pid}.npy"), np.asarray(w))
+    print("EP_WORKER_OK", pid)
+""")
+
+
+@pytest.mark.slow
+def test_two_process_expert_parallel_partial_chunk_ownership():
+    """dp1 x ep8 across 2 processes: each process owns half the expert
+    chunks, so _global_batch's batch-row slicing runs for real; weights
+    must match the single-process (1, 8) run (drop-free capacity)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = _clean_env()
+    with tempfile.TemporaryDirectory() as outdir:
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", _EP_WORKER, str(pid), str(port), outdir],
+            cwd=repo_root, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True) for pid in (0, 1)]
+        outs = []
+        for p in procs:
+            out, err = p.communicate(timeout=1200)
+            outs.append((p.returncode, out, err))
+        for rc, out, err in outs:
+            assert rc == 0 and "EP_WORKER_OK" in out, (out, err[-3000:])
+        w0 = np.load(os.path.join(outdir, "ep_w0.npy"))
+        w1 = np.load(os.path.join(outdir, "ep_w1.npy"))
+        np.testing.assert_array_equal(w0, w1)
+
+        import jax
+        import bigdl_tpu.nn as nn
+        import bigdl_tpu.optim as optim
+        from bigdl_tpu.dataset import SampleToMiniBatch
+        from bigdl_tpu.dataset.dataset import ShardedDataSet
+        from bigdl_tpu.dataset.datasets import synthetic_separable
+        from bigdl_tpu.engine import Engine
+        from bigdl_tpu.nn.moe import MixtureOfExperts
+        from bigdl_tpu.parallel import DistriOptimizer
+
+        samples = synthetic_separable(64, 4, n_classes=2, seed=3)
+        D = 8
+        expert = (nn.Sequential().add(nn.Linear(D, 16)).add(nn.ReLU())
+                  .add(nn.Linear(16, D)))
+        moe = MixtureOfExperts(D, expert, 8, capacity_factor=8.0)
+        m = (nn.Sequential().add(nn.Linear(4, D)).add(nn.Tanh()).add(moe)
+             .add(nn.Linear(D, 2)).add(nn.LogSoftMax()))
+        m.reset(jax.random.PRNGKey(7))
+        mesh = Engine.create_mesh((1, 8), ("data", "expert"))
+        ds = ShardedDataSet(samples, 1).transform(SampleToMiniBatch(32, 1))
+        opt = DistriOptimizer(m, ds, nn.ClassNLLCriterion(), mesh=mesh)
+        opt.set_optim_method(optim.SGD(learning_rate=0.2, momentum=0.9))
+        opt.set_end_when(optim.max_iteration(4))
+        w_single, _ = opt.optimize().get_parameters()
+        np.testing.assert_allclose(w0, np.asarray(w_single),
+                                   rtol=2e-4, atol=2e-5)
