@@ -336,15 +336,42 @@ class TensorflowLoader:
             format=_data_format(node), name=name or node.name)
         return ModuleNode(conv).inputs(self._convert(node.input[0]))
 
+    def _op_depthwiseconv2dnative(self, node, bias=None, name=None):
+        """Depthwise conv = grouped conv with groups == in channels: TF
+        kernel (kh, kw, C, M) reshapes to HWIO (kh, kw, 1, C*M) — XLA's
+        feature_group_count assigns output block [c*M, (c+1)*M) to input
+        channel c, matching TF's output ordering exactly."""
+        w_node = self._resolve_const(self._in(node, 1))
+        if w_node is None:
+            raise ValueError(f"{node.name}: non-Const depthwise weights")
+        dil = list(node.attr["dilations"].list.i)
+        if dil and any(d != 1 for d in dil):
+            raise ValueError(f"{node.name}: dilated depthwise conv "
+                             "unsupported by the import patterns")
+        w = _const_value(w_node)
+        kh, kw, n_in, mult = w.shape
+        sh, sw = _strides_hw(node)
+        same = node.attr["padding"].s == b"SAME"
+        conv = nn.SpatialConvolution(
+            n_in, n_in * mult, kw, kh, sw, sh,
+            pad_w=-1 if same else 0, pad_h=-1 if same else 0,
+            n_group=n_in, init_weight=w.reshape(kh, kw, 1, n_in * mult),
+            init_bias=bias, with_bias=bias is not None,
+            format=_data_format(node), name=name or node.name)
+        return ModuleNode(conv).inputs(self._convert(node.input[0]))
+
     def _op_biasadd(self, node):
         pre = self._in(node, 0)
         b_node = self._resolve_const(self._in(node, 1))
-        if b_node is not None and pre.op in ("Conv2D", "MatMul"):
+        if b_node is not None and pre.op in ("Conv2D", "MatMul",
+                                             "DepthwiseConv2dNative"):
             # fuse: Conv2D/MatMul + BiasAdd -> one layer (reference
             # TensorflowToBigDL's Conv2D/FullConnection patterns)
             bias = _const_value(b_node)
-            handler = (self._op_conv2d if pre.op == "Conv2D"
-                       else self._op_matmul)
+            handler = {"Conv2D": self._op_conv2d,
+                       "MatMul": self._op_matmul,
+                       "DepthwiseConv2dNative":
+                           self._op_depthwiseconv2dnative}[pre.op]
             mn = handler(pre, bias=bias, name=node.name)
             if self._consumers.get(pre.name, 0) == 1:
                 # safe to alias only when the BiasAdd is the sole consumer

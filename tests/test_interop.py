@@ -544,3 +544,20 @@ class TestTensorflowPatternParity:
         back = TensorflowLoader.load(gd, ["input"], ["output"])
         np.testing.assert_allclose(np.asarray(back.evaluate().forward(x)),
                                    ours, rtol=1e-4, atol=1e-4)
+
+    def test_depthwise_conv_parity(self):
+        """DepthwiseConv2dNative (+BiasAdd fusion) imports as grouped
+        SpatialConvolution with TF's exact channel ordering."""
+        def build(tf):
+            rng = np.random.RandomState(10)
+            x = tf.compat.v1.placeholder(tf.float32, [None, 8, 8, 6],
+                                         name="input")
+            k = tf.constant(rng.normal(size=(3, 3, 6, 2))
+                            .astype(np.float32) * 0.3)
+            b = tf.constant(rng.normal(size=(12,)).astype(np.float32) * .1)
+            y = tf.nn.bias_add(tf.nn.depthwise_conv2d(
+                x, k, strides=[1, 1, 1, 1], padding="SAME"), b)
+            tf.nn.relu(y, name="output")
+        x = np.random.RandomState(11).normal(
+            size=(2, 8, 8, 6)).astype(np.float32)
+        self._golden(build, x, rtol=1e-4, atol=1e-4)
