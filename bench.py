@@ -200,6 +200,16 @@ def main():
                                 "n_layers": nl, "n_head": h, "vocab": v,
                                 "params_m": round(n_params / 1e6, 1),
                                 "precision": "bf16"}}
+        base_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "bench_baseline.json")
+        if os.path.exists(base_path):
+            with open(base_path) as f:
+                pinned = json.load(f).get(
+                    "transformer_lm_train_tokens_per_sec")
+            if pinned:
+                lm_record["vs_baseline"] = round(toks / pinned, 3)
+                _log(f"  lm vs pinned baseline: {toks / pinned:.3f}")
         with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "bench_lm.json"), "w") as f:
             json.dump(lm_record, f, indent=1)

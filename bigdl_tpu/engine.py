@@ -73,18 +73,28 @@ class BatchPrefetcher:
     so a batch prefetched across the boundary stays valid, exactly like
     the reference's pipelined RDD fetch).
 
+    Epoch rollovers happen ON the producer (``on_batch`` hook, wired by
+    the driver): the producer alone counts records and calls
+    ``reset_epoch`` at the boundary, so the dataset's iterators and
+    shuffled index arrays are only ever touched from one thread AND the
+    batch sequence is deterministic — independent of how far ahead the
+    producer happens to be, which matters for multi-host parity (every
+    process must consume the identical sequence).
+
     ``depth`` defaults to ``bigdl.prefetch.depth`` (2); 0 disables (the
     call becomes a passthrough).  Exceptions in the producer re-raise at
     the consuming call site.
     """
 
-    def __init__(self, fetch, depth: Optional[int] = None):
+    def __init__(self, fetch, depth: Optional[int] = None,
+                 on_batch=None):
         import queue
 
         from bigdl_tpu.utils import config
         self.depth = (depth if depth is not None
                       else config.get_int("bigdl.prefetch.depth", 2))
         self._fetch = fetch
+        self._on_batch = on_batch
         if self.depth <= 0:
             return
         self._q: "queue.Queue" = queue.Queue(maxsize=self.depth)
@@ -92,10 +102,16 @@ class BatchPrefetcher:
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
+    def _fetch_once(self):
+        batch = self._fetch()
+        if self._on_batch is not None:
+            self._on_batch(batch)
+        return batch
+
     def _run(self):
         while not self._stop.is_set():
             try:
-                item = (None, self._fetch())
+                item = (None, self._fetch_once())
             except BaseException as e:  # noqa: BLE001 — re-raised at call
                 item = (e, None)
             while not self._stop.is_set():
@@ -109,15 +125,19 @@ class BatchPrefetcher:
 
     def __call__(self):
         if self.depth <= 0:
-            return self._fetch()
+            return self._fetch_once()
         err, batch = self._q.get()
         if err is not None:
             raise err
         return batch
 
     def stop(self):
+        """Stop and JOIN the producer: a retry-from-failure restart must
+        not race a still-running old producer over the same dataset
+        iterators."""
         if self.depth > 0:
             self._stop.set()
+            self._thread.join(timeout=10)
 
 
 class _EngineState:

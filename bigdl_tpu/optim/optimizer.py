@@ -128,7 +128,8 @@ class Checkpoint:
         from bigdl_tpu.utils import file_io
         nevals = []
         for f in file_io.listdir(self.path):
-            if f.startswith("model."):
+            # in-flight atomic-write temps are not snapshots
+            if f.startswith("model.") and not f.endswith(".tmp_bigdl"):
                 try:
                     nevals.append(int(f.split(".")[1]))
                 except ValueError:
@@ -370,14 +371,25 @@ class Optimizer:
             return self.end_when(state)
 
         # batch prefetch: the host->device transfer inside fetch_batch is
-        # a tunnel round-trip — run it ahead on a producer thread.  Safe
-        # across epoch rollovers: the producer alone touches the dataset
-        # iterators (single producer), the training stream is infinite,
-        # and reset_epoch only swaps the iterator reference the fetch
-        # closure reads.  bigdl.prefetch.depth=0 restores synchronous
-        # fetching.
+        # a tunnel round-trip — run it ahead on a producer thread.  The
+        # PRODUCER owns the dataset end to end: it counts records and
+        # performs the epoch rollover + reshuffle at the boundary
+        # (reference DistriOptimizer:333-344), so iterators and index
+        # arrays are single-threaded and the batch sequence is
+        # deterministic regardless of how far ahead the producer runs —
+        # the consumer below tracks epochs independently for state/
+        # logging from the same bsz stream, so the two stay in lockstep.
+        # bigdl.prefetch.depth=0 restores synchronous fetching.
         from bigdl_tpu.engine import BatchPrefetcher
-        fetch = BatchPrefetcher(fetch_batch)
+        fetched = {"records": 0}
+
+        def on_batch(batch):
+            fetched["records"] += batch[2]
+            if fetched["records"] >= epoch_size:
+                fetched["records"] = 0
+                reset_epoch()
+
+        fetch = BatchPrefetcher(fetch_batch, on_batch=on_batch)
         try:
             while not should_end():
                 t_data = time.time_ns()
@@ -399,12 +411,12 @@ class Optimizer:
 
                 state["recordsProcessedThisEpoch"] += bsz
 
-                # epoch rollover + reshuffle (reference
-                # DistriOptimizer:333-344)
+                # epoch accounting only — the rollover itself (reshuffle,
+                # iterator reset) already happened on the producer at this
+                # exact record boundary
                 if state["recordsProcessedThisEpoch"] >= epoch_size:
                     state["epoch"] += 1
                     state["recordsProcessedThisEpoch"] = 0
-                    reset_epoch()
 
                 state["neval"] += 1
                 # keep the snapshot's epoch current across the rollover so

@@ -24,13 +24,18 @@ def _is_remote(path: str) -> bool:
     return path.startswith(_REMOTE_SCHEMES)
 
 
+def _dealias(path: str) -> str:
+    """s3a/s3n are hadoop aliases for s3."""
+    for alias in ("s3a://", "s3n://"):
+        if path.startswith(alias):
+            return "s3://" + path[len(alias):]
+    return path
+
+
 def _fs(path: str):
     """(filesystem, in-fs path) for a remote scheme via fsspec."""
     import fsspec
-    for alias in ("s3a://", "s3n://"):
-        if path.startswith(alias):
-            path = "s3://" + path[len(alias):]
-    fs, fpath = fsspec.core.url_to_fs(path)
+    fs, fpath = fsspec.core.url_to_fs(_dealias(path))
     return fs, fpath
 
 
@@ -75,23 +80,33 @@ def _fsspec_open(path: str, mode: str):
         raise NotImplementedError(
             f"remote filesystem scheme in {path!r} needs fsspec "
             "(reference: utils/File.scala:106)") from e
-    # s3a/s3n are hadoop aliases for s3
-    for alias in ("s3a://", "s3n://"):
-        if path.startswith(alias):
-            path = "s3://" + path[len(alias):]
-    return fsspec.open(path, mode)
+    return fsspec.open(_dealias(path), mode)
 
 
 def save(obj: Any, path: str, overwrite: bool = True) -> None:
     """Serialize ``obj`` to ``path`` (reference ``File.save:67`` /
     ``saveToHdfs:106``).  Local writes are atomic (temp file + rename)."""
     if _is_remote(path):
-        fo = _fsspec_open(path, "wb")
-        if not overwrite and fo.fs.exists(fo.path):
+        fs, p = _fs(path)
+        if not overwrite and fs.exists(p):
             raise FileExistsError(f"{path} already exists and overwrite is "
                                   "False (reference File.scala overWrite)")
-        with fo as f:
-            pickle.dump(obj, f, protocol=pickle.HIGHEST_PROTOCOL)
+        # write-then-rename, mirroring the local atomic path: a crash
+        # mid-write must not leave a truncated snapshot that
+        # Checkpoint.latest() would pick as the newest and retry-load
+        # forever
+        tmp = p + ".tmp_bigdl"
+        try:
+            with fs.open(tmp, "wb") as f:
+                pickle.dump(obj, f, protocol=pickle.HIGHEST_PROTOCOL)
+            fs.mv(tmp, p)
+        except BaseException:
+            try:
+                if fs.exists(tmp):
+                    fs.rm(tmp)
+            except Exception:
+                pass
+            raise
         return
     if path.startswith("file://"):
         path = path[len("file://"):]

@@ -214,3 +214,22 @@ class TestRemoteCheckpointIntegration:
             file_io.save({"v": 2}, "memory://bigdl_it/obj",
                          overwrite=False)
         assert file_io.load("memory://bigdl_it/obj")["v"] == 1
+
+    def test_partial_remote_write_never_selected_as_latest(self):
+        """Atomic remote saves: a crashed in-flight temp must neither be
+        picked by latest() nor survive as a final object."""
+        import fsspec
+        from bigdl_tpu.optim.optimizer import Checkpoint
+        self._clean()
+        ckpt = Checkpoint("memory://bigdl_it/atomic", optim.every_epoch())
+        m = _mlp(4, 2)
+        ckpt.save(m, optim.SGD(learning_rate=0.1), 3)
+        # simulate a crash mid-write of snapshot 7
+        fs = fsspec.filesystem("memory")
+        with fs.open("/bigdl_it/atomic/model.7.tmp_bigdl", "wb") as f:
+            f.write(b"truncated")
+        model_path, _, n = ckpt.latest()
+        assert n == 3 and model_path.endswith("model.3")
+        reloaded = file_io.load(model_path)
+        x = np.zeros((1, 4), np.float32)
+        assert np.asarray(reloaded.forward(x)).shape == (1, 2)
