@@ -31,6 +31,41 @@ def _same_pad(in_size: int, k: int, s: int, d: int = 1) -> Tuple[int, int]:
     return pad // 2, pad - pad // 2
 
 
+# C_in * kh * kw at or below this goes through the slice-stack matmul
+# path: XLA's conv WEIGHT-gradient for tiny input channel counts compiles
+# pathologically on this backend (LeNet's 1->6 5x5 conv at batch 512:
+# >11 min for the conv alone; the same gradient via stacked shifted
+# slices + one matmul: 8.7 s, bit-identical forward).  Tiny-channel convs
+# are degenerate on the MXU anyway, so the matmul form is also the
+# faster runtime layout.
+_IM2COL_MAX_TAPS = 32
+
+
+def _conv2d_smallk(x, weight, stride, pad_hw, format):
+    """VALID-after-padding conv as stacked shifted slices + one matmul
+    (the reference's im2col+gemm, ``nn/NNPrimitive.scala:108`` — here as
+    a compile-time workaround, not a runtime buffer)."""
+    kh, kw, c_in, c_out = weight.shape
+    if format == "NCHW":
+        x = jnp.transpose(x, (0, 2, 3, 1))          # -> NHWC
+    x = jnp.pad(x, ((0, 0), pad_hw[0], pad_hw[1], (0, 0)))
+    n, h, w, _ = x.shape
+    sh, sw = stride
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    cols = jnp.stack(
+        [x[:, dy:dy + (oh - 1) * sh + 1:sh,
+           dx:dx + (ow - 1) * sw + 1:sw, :]
+         for dy in range(kh) for dx in range(kw)], axis=3)  # (N,oh,ow,taps,C)
+    cols = cols.reshape(n, oh, ow, kh * kw * c_in)
+    # taps-major (dy, dx, c) must match the kernel flatten order
+    wmat = weight.reshape(kh * kw * c_in, c_out)
+    out = cols @ wmat                                # (N, oh, ow, C_out)
+    if format == "NCHW":
+        out = jnp.transpose(out, (0, 3, 1, 2))
+    return out
+
+
 def conv2d(x: jnp.ndarray, weight: jnp.ndarray,
            bias: Optional[jnp.ndarray] = None,
            stride: Tuple[int, int] = (1, 1),
@@ -50,10 +85,15 @@ def conv2d(x: jnp.ndarray, weight: jnp.ndarray,
                _same_pad(x.shape[w_ax], weight.shape[1], stride[1], dilation[1]))
     else:
         pad = ((padding[0], padding[0]), (padding[1], padding[1]))
-    out = lax.conv_general_dilated(
-        x, weight, window_strides=stride, padding=pad,
-        rhs_dilation=dilation, dimension_numbers=dn,
-        feature_group_count=groups)
+    kh, kw, c_in_g, _ = weight.shape
+    if (groups == 1 and dilation == (1, 1) and
+            kh * kw * c_in_g <= _IM2COL_MAX_TAPS):
+        out = _conv2d_smallk(x, weight, stride, pad, format)
+    else:
+        out = lax.conv_general_dilated(
+            x, weight, window_strides=stride, padding=pad,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups)
     if bias is not None:
         bshape = (1, -1, 1, 1) if format == "NCHW" else (1, 1, 1, -1)
         out = out + jnp.reshape(bias, bshape)
