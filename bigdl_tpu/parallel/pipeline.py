@@ -331,15 +331,38 @@ class PipelineOptimizer(Optimizer):
         sharded = isinstance(self.dataset, ShardedDataSet)
         it = {"data": None}
 
+        if sharded:
+            # the dp trainers' per-process feeding: each process pulls one
+            # minibatch per OWNED partition and the global batch assembles
+            # from every process's block — multi-host-capable like
+            # DistriOptimizer (the interleaved data() stream would
+            # silently train on 1/partition_num of the batch per step)
+            from bigdl_tpu.parallel.distri_optimizer import (
+                _global_batch, local_data_partitions)
+            if self.data_axis is not None:
+                if self.dataset.partition_num != mesh.shape[self.data_axis]:
+                    raise ValueError(
+                        f"dataset has {self.dataset.partition_num} "
+                        f"partitions but the '{self.data_axis}' axis has "
+                        f"{mesh.shape[self.data_axis]} devices — they "
+                        "must match")
+                local_ids = local_data_partitions(mesh, self.data_axis)
+            else:
+                local_ids = list(range(self.dataset.partition_num))
+            missing = [p for p in local_ids
+                       if p not in self.dataset.local_partitions]
+            if missing:
+                raise ValueError(
+                    f"this process's mesh positions own data partitions "
+                    f"{missing} but the dataset does not hold them "
+                    "locally — construct ShardedDataSet(..., "
+                    f"local_partitions={local_ids}) on this process")
+
         def reset_epoch():
             self.dataset.shuffle()
             if sharded:
-                # one minibatch per partition, concatenated into the
-                # global batch (the dp trainers' semantics) — the
-                # interleaved data() stream would silently train on
-                # 1/partition_num of the requested batch per step
                 it["data"] = {p: self.dataset.shard_data(p, train=True)
-                              for p in self.dataset.local_partitions}
+                              for p in local_ids}
             else:
                 it["data"] = self.dataset.data(train=True)
 
@@ -348,17 +371,12 @@ class PipelineOptimizer(Optimizer):
 
         def fetch_batch():
             if sharded:
-                from bigdl_tpu.parallel.distri_optimizer import _cat
-                parts = [next(it["data"][p]) for p in sorted(it["data"])]
-                inputs = _cat([b.get_input() for b in parts])
-                targets = _cat([b.get_target() for b in parts])
-                bsz = sum(b.size() for b in parts)
-            else:
-                batch = next(it["data"])
-                inputs, targets = batch.get_input(), batch.get_target()
-                bsz = batch.size()
-            return (jax.tree_util.tree_map(put, inputs),
-                    jax.tree_util.tree_map(put, targets), bsz)
+                return _global_batch(it["data"], batch_sharding, mesh,
+                                     self.dataset.partition_num)
+            batch = next(it["data"])
+            return (jax.tree_util.tree_map(put, batch.get_input()),
+                    jax.tree_util.tree_map(put, batch.get_target()),
+                    batch.size())
 
         def run_step(inputs, targets, hyper, rng):
             (carry["params"], carry["slots"],
