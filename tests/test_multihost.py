@@ -364,3 +364,106 @@ def test_two_process_expert_parallel_partial_chunk_ownership():
         w_single, _ = opt.optimize().get_parameters()
         np.testing.assert_allclose(w0, np.asarray(w_single),
                                    rtol=2e-4, atol=2e-5)
+
+
+_PP_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    pid = int(sys.argv[1]); port = sys.argv[2]; outdir = sys.argv[3]
+    from bigdl_tpu.engine import Engine
+    Engine.init_distributed(f"127.0.0.1:{port}", 2, pid)
+
+    import numpy as np
+    import bigdl_tpu.nn as nn
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.dataset import Sample, SampleToMiniBatch
+    from bigdl_tpu.dataset.dataset import ShardedDataSet
+    from bigdl_tpu.parallel import PipelineOptimizer
+    from bigdl_tpu.parallel.distri_optimizer import local_data_partitions
+
+    # pp x dp across hosts: (2 data, 4 stage) — each process owns one
+    # data replica's full pipeline; stage ppermute stays intra-process,
+    # the data-gradient psum crosses processes
+    mesh = Engine.create_mesh((2, 4), ("data", "stage"))
+    local = local_data_partitions(mesh)
+    assert local == [pid], local
+
+    D = 8
+    rng = np.random.RandomState(2)
+    x = rng.normal(size=(32, D)).astype(np.float32)
+    w_true = rng.normal(size=(D, D)).astype(np.float32) * 0.4
+    y = np.tanh(x @ w_true)
+    samples = [Sample(x[i], y[i]) for i in range(32)]
+    ds = ShardedDataSet(samples, 2, local_partitions=local).transform(
+        SampleToMiniBatch(16, 2))
+    blocks = []
+    for s in range(4):
+        b = nn.Sequential().add(nn.Linear(D, D)).add(nn.Tanh())
+        b.reset(jax.random.PRNGKey(s))
+        blocks.append(b)
+    opt = PipelineOptimizer(blocks, ds, nn.MSECriterion(), mesh=mesh,
+                            n_micro=2)
+    opt.set_optim_method(optim.SGD(learning_rate=0.5))
+    opt.set_end_when(optim.max_iteration(4))
+    trained = opt.optimize()
+    w, _ = trained.get_parameters()
+    np.save(os.path.join(outdir, f"pp_w{pid}.npy"), np.asarray(w))
+    print("PP_WORKER_OK", pid)
+""")
+
+
+@pytest.mark.slow
+def test_two_process_pipeline_training_matches_single_process():
+    """pp x dp across 2 OS processes: PipelineOptimizer's per-process
+    ShardedDataSet feeding + the cross-process data psum must reproduce
+    the single-process (2, 4) run."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = _clean_env()
+    with tempfile.TemporaryDirectory() as outdir:
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", _PP_WORKER, str(pid), str(port), outdir],
+            cwd=repo_root, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True) for pid in (0, 1)]
+        outs = []
+        for p in procs:
+            out, err = p.communicate(timeout=1200)
+            outs.append((p.returncode, out, err))
+        for rc, out, err in outs:
+            assert rc == 0 and "PP_WORKER_OK" in out, (out, err[-3000:])
+        w0 = np.load(os.path.join(outdir, "pp_w0.npy"))
+        w1 = np.load(os.path.join(outdir, "pp_w1.npy"))
+        np.testing.assert_array_equal(w0, w1)
+
+        import jax
+        import bigdl_tpu.nn as nn
+        import bigdl_tpu.optim as optim
+        from bigdl_tpu.dataset import Sample, SampleToMiniBatch
+        from bigdl_tpu.dataset.dataset import ShardedDataSet
+        from bigdl_tpu.engine import Engine
+        from bigdl_tpu.parallel import PipelineOptimizer
+
+        D = 8
+        rng = np.random.RandomState(2)
+        x = rng.normal(size=(32, D)).astype(np.float32)
+        w_true = rng.normal(size=(D, D)).astype(np.float32) * 0.4
+        y = np.tanh(x @ w_true)
+        samples = [Sample(x[i], y[i]) for i in range(32)]
+        ds = ShardedDataSet(samples, 2).transform(SampleToMiniBatch(16, 2))
+        blocks = []
+        for s in range(4):
+            b = nn.Sequential().add(nn.Linear(D, D)).add(nn.Tanh())
+            b.reset(jax.random.PRNGKey(s))
+            blocks.append(b)
+        mesh = Engine.create_mesh((2, 4), ("data", "stage"))
+        opt = PipelineOptimizer(blocks, ds, nn.MSECriterion(), mesh=mesh,
+                                n_micro=2)
+        opt.set_optim_method(optim.SGD(learning_rate=0.5))
+        opt.set_end_when(optim.max_iteration(4))
+        w_single, _ = opt.optimize().get_parameters()
+        np.testing.assert_allclose(w0, np.asarray(w_single),
+                                   rtol=2e-4, atol=2e-5)
