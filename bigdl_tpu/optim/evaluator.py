@@ -28,19 +28,40 @@ from bigdl_tpu.optim.validation_method import (ValidationMethod,
                                                ValidationResult)
 
 
-def _eval_forward(model: Module):
+def _eval_forward(model: Module, mesh=None, host_params: bool = False):
     """Jitted eval-mode forward, cached on the model so repeated validation
     triggers / predict calls reuse one compilation (params/state enter as
-    arguments — value changes don't retrace)."""
-    fn = getattr(model, "_eval_jit", None)
+    arguments — value changes don't retrace).
+
+    With a ``mesh`` the outputs are pinned replicated: the batch shards
+    over the ``data`` axis, and under multi-host training the raw sharded
+    logits would span devices this process cannot address — metric code on
+    the host could not read them.  Replicated outputs (one all-gather XLA
+    schedules with the forward) are host-readable on every process, so all
+    processes compute identical validation scores (the reference reduces
+    metrics to the driver the same way, ``optim/Evaluator.scala:37-74``)."""
+    cache = getattr(model, "_eval_jit", None)
+    if cache is None:
+        cache = model._eval_jit = {}
+    fn = cache.get(id(mesh))
     if fn is None:
         def fwd(params, mstate, inputs):
             out, _ = model.apply(params, inputs, mstate, training=False,
                                  rng=None)
             return out
-        fn = jax.jit(fwd)
-        model._eval_jit = fn
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            fn = jax.jit(fwd, out_shardings=NamedSharding(mesh, P()))
+        else:
+            fn = jax.jit(fwd)
+        cache[id(mesh)] = fn
     params, mstate = model.params, model.state
+    if host_params:
+        # detach params/state from their (possibly global, multi-host)
+        # placement: host numpy re-places on this process's local devices,
+        # so the un-pinned fn never mixes local inputs with global arrays
+        params = jax.tree_util.tree_map(np.asarray, params)
+        mstate = jax.tree_util.tree_map(np.asarray, mstate)
     return lambda inputs: fn(params, mstate, inputs)
 
 
@@ -64,7 +85,22 @@ def evaluate_dataset(model: Module, dataset,
         batch_sharding = NamedSharding(mesh, P("data"))
         axis_size = mesh.shape["data"]
     try:
-        fwd = _eval_forward(model)
+        fwd = _eval_forward(model, mesh)
+        # fallback for batches not divisible by the data axis: a LOCAL
+        # forward (no mesh pinning).  The mesh-pinned fn cannot take a
+        # process-local array — under multi-host its replicated
+        # out_shardings span devices this process cannot feed — while the
+        # local fn runs the whole batch on this process's devices with
+        # host-detached params; every process holds the full batch, so
+        # scores stay identical everywhere.  Built lazily: divisible-only
+        # datasets never pay the params fetch.
+        _fallback = {}
+
+        def fwd_local(x):
+            if "fn" not in _fallback:
+                _fallback["fn"] = _eval_forward(
+                    model, host_params=jax.process_count() > 1)
+            return _fallback["fn"](x)
         totals: List[ValidationResult] = [None] * len(methods)
         it = dataset.data(train=False) if isinstance(
             dataset, AbstractDataSet) else iter(dataset)
@@ -84,9 +120,10 @@ def evaluate_dataset(model: Module, dataset,
                 inputs = jax.tree_util.tree_map(
                     lambda x: jax.device_put(np.asarray(x), batch_sharding),
                     batch.get_input())
+                out = fwd(inputs)
             else:
-                inputs = _to_device(batch.get_input())
-            pipeline.push(fwd(inputs), batch.get_target())
+                out = fwd_local(_to_device(batch.get_input()))
+            pipeline.push(out, batch.get_target())
         pipeline.flush()
         return [(m, t) for m, t in zip(methods, totals) if t is not None]
     finally:

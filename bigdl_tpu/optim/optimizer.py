@@ -38,6 +38,22 @@ from bigdl_tpu.optim.validation_method import ValidationMethod, ValidationResult
 logger = logging.getLogger("bigdl_tpu")
 
 
+def is_writer_process() -> bool:
+    """Single-writer discipline for externally-visible artifacts.
+
+    In the reference, checkpoints and TensorBoard events are written exactly
+    once, from the driver JVM (``optim/DistriOptimizer.scala:394-416`` and
+    ``:426-456`` — executor code never writes).  The multi-controller SPMD
+    rebuild runs the full driver body in EVERY process, so file-producing
+    calls (checkpoint snapshots, summary events, parameter histograms) are
+    gated here on process 0.  Trigger *decisions* stay ungated — every
+    process must reach the same publish/validation sync points or the
+    collectives inside them deadlock; only the filesystem writes are
+    single-writer.  Single-process this is always True.
+    """
+    return jax.process_index() == 0
+
+
 def cast_floats(tree, dtype):
     """Cast float leaves of a pytree (mixed-precision compute casts)."""
     def f(x):
@@ -119,6 +135,15 @@ class Checkpoint:
     def save(self, model: Module, optim: OptimMethod, neval: int) -> None:
         from bigdl_tpu.utils import file_io
         file_io.makedirs(self.path)
+        # sweep temps orphaned by a hard-killed earlier writer (their names
+        # are unique per pid, so nothing reclaims them on rewrite; with the
+        # single-writer discipline no live writer's temp can be swept here)
+        for f in file_io.listdir(self.path):
+            if ".tmp_bigdl" in f:
+                try:
+                    file_io.remove(file_io.join(self.path, f))
+                except Exception:
+                    pass
         file_io.save(model, file_io.join(self.path, f"model.{neval}"),
                      self.overwrite)
         file_io.save(optim, file_io.join(self.path, f"optimMethod.{neval}"),
@@ -128,8 +153,10 @@ class Checkpoint:
         from bigdl_tpu.utils import file_io
         nevals = []
         for f in file_io.listdir(self.path):
-            # in-flight atomic-write temps are not snapshots
-            if f.startswith("model.") and not f.endswith(".tmp_bigdl"):
+            # in-flight atomic-write temps are not snapshots (the temp
+            # suffix carries a unique pid/uuid tail — match the marker
+            # anywhere, not just at the end)
+            if f.startswith("model.") and ".tmp_bigdl" not in f:
                 try:
                     nevals.append(int(f.split(".")[1]))
                 except ValueError:
@@ -319,6 +346,7 @@ class Optimizer:
         (the reference's getModel runs only at checkpoints, ``:818``) and
         once at the end.
         """
+        self._check_symmetric_config()
         state = _initial_driver_state()
         # resume: continue the counters a restored OptimMethod carries
         # (reference Train drivers pass --stateSnapshot and the optim state's
@@ -435,9 +463,10 @@ class Optimizer:
                         self._run_validation(state)
                     if c_due:
                         self._run_checkpoint(state)
-                    if p_due:
+                    if p_due and is_writer_process():
                         # weight histograms (reference
-                        # DistriOptimizer:426-456)
+                        # DistriOptimizer:426-456); the due-decision is
+                        # shared (all processes publish), the write is not
                         self.train_summary.save_parameters(
                             self.model, state["neval"] - 1)
         finally:
@@ -447,6 +476,39 @@ class Optimizer:
         publish()
         logger.info("Training finished in %.1f s.", time.time() - wall_start)
         return state
+
+    def _check_symmetric_config(self) -> None:
+        """Multi-host guard: the publish/validation sync points contain
+        collectives, and whether they run is decided from per-process
+        configuration.  A user who configures a checkpoint, summary, or
+        validation on only SOME processes (a natural misreading of the
+        single-writer discipline — the gating happens at write time, not
+        at configuration time) would send the processes down different
+        collective sequences and hang the job with no diagnostic.  Catch
+        it up front with a host allgather of the configuration shape."""
+        if jax.process_count() <= 1:
+            return
+        from jax.experimental import multihost_utils
+        ts = self.train_summary
+        has_param_hist = (ts is not None and
+                          getattr(ts, "get_summary_trigger",
+                                  lambda n: None)("Parameters") is not None)
+        flags = np.array(
+            [self.checkpoint is not None,
+             ts is not None,
+             has_param_hist,
+             self.validation_trigger is not None,
+             self.validation_summary is not None],
+            dtype=np.int32)
+        gathered = np.asarray(multihost_utils.process_allgather(flags))
+        if not (gathered == flags[None, :]).all():
+            raise ValueError(
+                "training configuration differs across processes "
+                f"(per-process [checkpoint, train_summary, param_histograms, "
+                f"validation, validation_summary] flags:\n{gathered}) — "
+                "every process must configure the same checkpoint/summary/"
+                "validation setup; only the WRITES are limited to process 0 "
+                "(bigdl_tpu.optim.optimizer.is_writer_process)")
 
     def _publish(self, params, slots, mstate) -> None:
         """Sync the jitted-loop carries back into the stateful shell so
@@ -476,7 +538,7 @@ class Optimizer:
             logger.info("%s is %s", method.name, res)
             state["score"] = res.final_result()
             self.optim_method.state["score"] = res.final_result()
-            if self.validation_summary is not None:
+            if self.validation_summary is not None and is_writer_process():
                 self.validation_summary.add_scalar(
                     method.name, res.final_result(), state["neval"] - 1)
 
@@ -484,12 +546,21 @@ class Optimizer:
         return self.checkpoint is not None and self.checkpoint.trigger(state)
 
     def _run_checkpoint(self, state) -> None:
-        self.checkpoint.save(self.model, self.optim_method,
-                             state["neval"] - 1)
+        # every process reaches this point (the trigger decision is
+        # shared), but only the writer touches the filesystem; the
+        # barrier afterwards keeps non-writers from racing ahead into a
+        # restore (or a crash-retry) that would read a half-finished
+        # snapshot set
+        if is_writer_process():
+            self.checkpoint.save(self.model, self.optim_method,
+                                 state["neval"] - 1)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("bigdl_checkpoint")
 
     def _summarize_train(self, loss: float, throughput: float,
                          neval: int) -> None:
-        if self.train_summary is None:
+        if self.train_summary is None or not is_writer_process():
             return
         self.train_summary.add_scalar("Loss", loss, neval)
         self.train_summary.add_scalar("Throughput", throughput, neval)

@@ -48,6 +48,39 @@ except ImportError:  # pragma: no cover - older jax
 Params = Any
 
 
+def replicate_tree(mesh):
+    """One jitted identity pinned replicated over ``mesh`` — the publish-time
+    regather every trainer uses to turn sharded carries back into
+    host-readable arrays (the reference's getModel pull,
+    ``optim/DistriOptimizer.scala:818``).  All processes must call it
+    together: XLA lowers the resharding to collectives."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return jax.jit(lambda t: t, out_shardings=NamedSharding(mesh, P()))
+
+
+def gather_to_host(tree, mesh):
+    """Replicate each leaf over ``mesh`` and fetch it to host numpy ONE
+    LEAF AT A TIME.
+
+    Used for publishing optimizer slots: a whole-tree replicated gather
+    would transiently materialize the complete slot set on every device —
+    for Adam that is 2x the parameter bytes on top of the live sharded
+    carries, exactly the allocation ZeRO-1 sharding exists to avoid.
+    Per-leaf gathering bounds the transient device footprint to the
+    largest single leaf, and the result lands host-side where checkpoint
+    serialization (which converts to numpy anyway) wants it.  Collective:
+    every process must participate."""
+    import numpy as np
+    gather = replicate_tree(mesh)
+
+    def one(leaf):
+        # the replicated intermediate goes out of scope immediately after
+        # the host copy, so at most one leaf is replicated at a time
+        return np.asarray(gather(leaf))
+
+    return jax.tree_util.tree_map(one, tree)
+
+
 class AllReduceParameter:
     """Flat-vector geometry + collectives for one parameter pytree."""
 

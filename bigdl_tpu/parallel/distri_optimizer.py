@@ -349,10 +349,26 @@ class DistriOptimizer(Optimizer):
         def publish():
             # slots leave the device in the same per-parameter pytree format
             # every host-side consumer (checkpoint resume, OptimMethod.update,
-            # a later LocalOptimizer) expects
+            # a later LocalOptimizer) expects.  Single-process the unflatten
+            # runs lazily on device (serialization fetches leaves only when a
+            # checkpoint actually pickles them — no publish-time transfers on
+            # a tunneled chip).  Multi-host the ZeRO shards mostly live on
+            # devices this process cannot address, so each flat slot vector
+            # is regathered and fetched host-side one at a time
+            # (``gather_to_host`` bounds the transient device footprint to
+            # one vector); every process joins the collective, only the
+            # writer process later serializes.
             self._sharded_slots = carry["slots"]
-            unflat_slots = jax.tree_util.tree_map(arp.unflatten,
-                                                  carry["slots"])
+            if jax.process_count() > 1:
+                from bigdl_tpu.parallel.all_reduce import gather_to_host
+                host_flat = gather_to_host(carry["slots"], mesh)
+                unflat_slots = jax.tree_util.tree_map(
+                    lambda v: jax.tree_util.tree_map(
+                        np.asarray, arp.unflatten(jnp.asarray(v))),
+                    host_flat)
+            else:
+                unflat_slots = jax.tree_util.tree_map(arp.unflatten,
+                                                      carry["slots"])
             self._publish(arp.unflatten(carry["flat"]), unflat_slots,
                           carry["mstate"])
 
@@ -429,10 +445,25 @@ class DistriOptimizer(Optimizer):
                                    hyper, rng)
             return loss
 
+        from bigdl_tpu.parallel.all_reduce import (gather_to_host,
+                                                   replicate_tree)
+        gather_rep = replicate_tree(mesh)
+
         def publish():
-            # params/slots are already in the canonical per-parameter
-            # pytree format (no ARP flat vector in the GSPMD design)
-            self._publish(carry["params"], carry["slots"], carry["mstate"])
+            # single-process the published model keeps its Megatron split —
+            # params stay physically sharded over 'model' (the memory win),
+            # and host consumers can still read any shard.  Multi-host the
+            # remote shards are not addressable, so params regather to
+            # replicated on device (validation forwards read them) and
+            # slots go per-leaf to host numpy (bounds the transient device
+            # footprint; serialization wants numpy anyway).
+            if jax.process_count() > 1:
+                self._publish(gather_rep(carry["params"]),
+                              gather_to_host(carry["slots"], mesh),
+                              carry["mstate"])
+            else:
+                self._publish(carry["params"], carry["slots"],
+                              carry["mstate"])
 
         reset_epoch()
         self._drive(fetch_batch, run_step, reset_epoch, publish,

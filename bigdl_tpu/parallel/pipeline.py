@@ -384,8 +384,24 @@ class PipelineOptimizer(Optimizer):
                                    inputs, targets, hyper, rng)
             return loss
 
+        from bigdl_tpu.parallel.all_reduce import (gather_to_host,
+                                                   replicate_tree)
+        gather_rep = replicate_tree(mesh)
+
         def publish():
-            p = carry["params"]
+            # under multi-host pp x dp a remote stage's slice is not
+            # addressable from this process and checkpoint pickling needs
+            # host-complete arrays, so params regather to replicated and
+            # slots go per-leaf to host numpy (bounds the transient device
+            # footprint); all processes join the gathers, only the writer
+            # process serializes (optim.optimizer.is_writer_process).
+            # Single-process the stacked stage params unstack lazily as
+            # before — no publish-time collectives.
+            if jax.process_count() > 1:
+                p = gather_rep(carry["params"])
+                slots = gather_to_host(carry["slots"], mesh)
+            else:
+                p, slots = carry["params"], carry["slots"]
             stage_list = unstack_stage_params(p["stages"], len(self.blocks))
             model_params = []
             if self.embed is not None:
@@ -393,7 +409,7 @@ class PipelineOptimizer(Optimizer):
             model_params.extend(stage_list)
             if self.head is not None:
                 model_params.append(p["head"])
-            self._publish(model_params, carry["slots"], self.model.state)
+            self._publish(model_params, slots, self.model.state)
 
         reset_epoch()
         self._drive(fetch_batch, run_step, reset_epoch, publish,

@@ -14,6 +14,7 @@ from __future__ import annotations
 import os
 import pickle
 import tempfile
+import uuid
 from typing import Any
 
 _REMOTE_SCHEMES = ("hdfs://", "s3://", "s3a://", "s3n://", "gs://",
@@ -94,8 +95,10 @@ def save(obj: Any, path: str, overwrite: bool = True) -> None:
         # write-then-rename, mirroring the local atomic path: a crash
         # mid-write must not leave a truncated snapshot that
         # Checkpoint.latest() would pick as the newest and retry-load
-        # forever
-        tmp = p + ".tmp_bigdl"
+        # forever.  The temp name is unique per process: on a shared
+        # store two writers racing on the same destination must never
+        # mv each other's half-written temp
+        tmp = f"{p}.tmp_bigdl.{os.getpid()}.{uuid.uuid4().hex[:8]}"
         try:
             with fs.open(tmp, "wb") as f:
                 pickle.dump(obj, f, protocol=pickle.HIGHEST_PROTOCOL)
@@ -125,6 +128,20 @@ def save(obj: Any, path: str, overwrite: bool = True) -> None:
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
+
+
+def remove(path: str) -> None:
+    """Delete a local or remote object; silently absent-tolerant (used to
+    sweep orphaned atomic-write temps left by hard-killed writers)."""
+    if _is_remote(path):
+        fs, p = _fs(path)
+        if fs.exists(p):
+            fs.rm(p)
+        return
+    if path.startswith("file://"):
+        path = path[len("file://"):]
+    if os.path.exists(path):
+        os.unlink(path)
 
 
 def load(path: str) -> Any:

@@ -63,8 +63,10 @@ class EventWriter:
 
     def __init__(self, log_dir: str, flush_secs: float = 2.0):
         os.makedirs(log_dir, exist_ok=True)
+        # the pid suffix (TF2's own convention) keeps two same-host
+        # processes created in the same second from appending to one file
         fname = (f"events.out.tfevents.{int(time.time())}."
-                 f"{socket.gethostname()}")
+                 f"{socket.gethostname()}.{os.getpid()}")
         self.path = os.path.join(log_dir, fname)
         self._f = open(self.path, "ab")
         self._q: "queue.Queue[Optional[bytes]]" = queue.Queue()
@@ -119,24 +121,40 @@ class EventWriter:
 
 
 class FileWriter:
-    """User-facing writer (reference ``FileWriter.scala:30``)."""
+    """User-facing writer (reference ``FileWriter.scala:30``).
+
+    The events file is created lazily on the first write: under
+    multi-host training every process constructs the summary objects
+    (the SPMD script runs everywhere) but only the writer process emits
+    events (``optim.optimizer.is_writer_process``) — constructing a
+    FileWriter must therefore not leave an empty events file behind on
+    the N-1 silent processes."""
 
     def __init__(self, log_dir: str, flush_secs: float = 2.0):
         self.log_dir = log_dir
-        self._writer = EventWriter(log_dir, flush_secs)
+        os.makedirs(log_dir, exist_ok=True)
+        self._flush_secs = flush_secs
+        self._writer: Optional[EventWriter] = None
+
+    def _ensure_writer(self) -> EventWriter:
+        if self._writer is None:
+            self._writer = EventWriter(self.log_dir, self._flush_secs)
+        return self._writer
 
     def add_summary(self, summary: bytes, global_step: int) -> "FileWriter":
-        self._writer.add_event(
+        self._ensure_writer().add_event(
             proto.encode_event(step=global_step, summary=summary))
         return self
 
     def add_event(self, event: bytes) -> "FileWriter":
-        self._writer.add_event(event)
+        self._ensure_writer().add_event(event)
         return self
 
     def flush(self) -> "FileWriter":
-        self._writer.flush()
+        if self._writer is not None:
+            self._writer.flush()
         return self
 
     def close(self) -> None:
-        self._writer.close()
+        if self._writer is not None:
+            self._writer.close()

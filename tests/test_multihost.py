@@ -160,6 +160,327 @@ def test_dataset_missing_local_partition_rejected():
         opt.optimize()
 
 
+_CKPT_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    pid = int(sys.argv[1]); port = sys.argv[2]; outdir = sys.argv[3]
+    ckptdir = sys.argv[4]; phase = sys.argv[5]
+    from bigdl_tpu.engine import Engine
+    Engine.init_distributed(f"127.0.0.1:{port}", 2, pid)
+
+    # audit every filesystem write this process performs: the
+    # single-writer discipline says rank 1 must never touch the
+    # checkpoint or summary stores
+    from bigdl_tpu.utils import file_io
+    _saves = []
+    _orig_save = file_io.save
+    def _counting_save(obj, path, overwrite=True):
+        _saves.append(path)
+        return _orig_save(obj, path, overwrite)
+    file_io.save = _counting_save
+
+    import numpy as np
+    import bigdl_tpu.nn as nn
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.dataset import SampleToMiniBatch
+    from bigdl_tpu.dataset.dataset import ShardedDataSet
+    from bigdl_tpu.dataset.datasets import synthetic_separable
+    from bigdl_tpu.parallel import DistriOptimizer
+    from bigdl_tpu.parallel.distri_optimizer import local_data_partitions
+
+    mesh = Engine.create_mesh()
+    local = local_data_partitions(mesh)
+    # full-batch (128 records = 1 iteration per epoch): batch order is
+    # epoch-shuffle independent, so a resumed run's trajectory can be
+    # compared exactly against an uninterrupted one
+    samples = synthetic_separable(128, 4, n_classes=2, seed=3)
+    ds = ShardedDataSet(samples, 8, local_partitions=local).transform(
+        SampleToMiniBatch(128, 8))
+    model = (nn.Sequential().add(nn.Linear(4, 16)).add(nn.Tanh())
+             .add(nn.Linear(16, 2)).add(nn.LogSoftMax()))
+    model.reset(jax.random.PRNGKey(11))
+    method = optim.SGD(learning_rate=0.2, momentum=0.9)
+    if phase == "resume":
+        # 'cluster restart': a NEW process pair picks up the newest
+        # snapshot pair and continues where the killed run stopped
+        from bigdl_tpu.optim.optimizer import Checkpoint
+        latest = Checkpoint(ckptdir, optim.every_epoch()).latest()
+        assert latest is not None
+        model = file_io.load(latest[0])
+        method = optim.OptimMethod.load(latest[1])
+        # several_iteration(2) fires when the post-step counter hits 2/4,
+        # i.e. snapshots land at iterations 1 and 3 — latest is model.3
+        assert method.state["evalCounter"] == 3, method.state
+
+    opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion(), mesh=mesh)
+    opt.set_optim_method(method)
+    opt.set_end_when(optim.max_iteration(4 if phase == "train" else 8))
+    opt.set_checkpoint(ckptdir, optim.several_iteration(2))
+    trained = opt.optimize()
+    w, _ = trained.get_parameters()
+    np.save(os.path.join(outdir, f"ck_{phase}_w{pid}.npy"), np.asarray(w))
+    with open(os.path.join(outdir, f"ck_{phase}_saves{pid}.txt"), "w") as f:
+        f.write("\\n".join(_saves))
+    print("CKPT_WORKER_OK", pid)
+""")
+
+
+def _run_pair(worker, extra_args, marker):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = _clean_env()
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", worker, str(pid), str(port)] + extra_args,
+        cwd=repo_root, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True) for pid in (0, 1)]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=1200)
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0 and marker in out, (out, err[-3000:])
+
+
+@pytest.mark.slow
+def test_two_process_checkpoint_kill_resume():
+    """Single-writer checkpointing under 2 processes: rank 0 writes every
+    snapshot, rank 1 writes NOTHING; killing the pair after 4 iterations
+    and resuming a fresh pair from the snapshot store reproduces the
+    uninterrupted 8-iteration run (reference: driver-only checkpoint
+    writes, ``optim/DistriOptimizer.scala:394-416``; resume protocol as in
+    the single-process TestKillAndResume)."""
+    with tempfile.TemporaryDirectory() as outdir, \
+            tempfile.TemporaryDirectory() as ckptdir:
+        _run_pair(_CKPT_WORKER, [outdir, ckptdir, "train"], "CKPT_WORKER_OK")
+        # snapshots exist exactly once, written by rank 0 alone
+        names = sorted(os.listdir(ckptdir))
+        assert "model.1" in names and "model.3" in names, names
+        assert "optimMethod.3" in names, names
+        assert not [n for n in names if ".tmp_bigdl" in n], names
+        saves0 = open(os.path.join(outdir, "ck_train_saves0.txt")).read()
+        saves1 = open(os.path.join(outdir, "ck_train_saves1.txt")).read()
+        assert saves0.count("model.") == 2 and "optimMethod.3" in saves0
+        assert saves1.strip() == "", f"rank 1 wrote: {saves1!r}"
+
+        _run_pair(_CKPT_WORKER, [outdir, ckptdir, "resume"],
+                  "CKPT_WORKER_OK")
+        saves1r = open(os.path.join(outdir, "ck_resume_saves1.txt")).read()
+        assert saves1r.strip() == "", f"rank 1 wrote: {saves1r!r}"
+        assert "model.7" in os.listdir(ckptdir)
+        w_res0 = np.load(os.path.join(outdir, "ck_resume_w0.npy"))
+        w_res1 = np.load(os.path.join(outdir, "ck_resume_w1.npy"))
+        np.testing.assert_array_equal(w_res0, w_res1)
+
+        # oracle: uninterrupted single-process 8-iteration run
+        import jax
+        import bigdl_tpu.nn as nn
+        import bigdl_tpu.optim as optim
+        from bigdl_tpu.dataset import SampleToMiniBatch
+        from bigdl_tpu.dataset.dataset import ShardedDataSet
+        from bigdl_tpu.dataset.datasets import synthetic_separable
+        from bigdl_tpu.engine import Engine
+        from bigdl_tpu.parallel import DistriOptimizer
+
+        samples = synthetic_separable(128, 4, n_classes=2, seed=3)
+        ds = ShardedDataSet(samples, N_DEV).transform(
+            SampleToMiniBatch(128, N_DEV))
+        model = (nn.Sequential().add(nn.Linear(4, 16)).add(nn.Tanh())
+                 .add(nn.Linear(16, 2)).add(nn.LogSoftMax()))
+        model.reset(jax.random.PRNGKey(11))
+        opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion(),
+                              mesh=Engine.create_mesh())
+        opt.set_optim_method(optim.SGD(learning_rate=0.2, momentum=0.9))
+        opt.set_end_when(optim.max_iteration(8))
+        w_single, _ = opt.optimize().get_parameters()
+        np.testing.assert_allclose(w_res0, np.asarray(w_single),
+                                   rtol=2e-4, atol=2e-5)
+
+
+_VAL_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    pid = int(sys.argv[1]); port = sys.argv[2]; outdir = sys.argv[3]
+    logdir = sys.argv[4]
+    from bigdl_tpu.engine import Engine
+    Engine.init_distributed(f"127.0.0.1:{port}", 2, pid)
+
+    import numpy as np
+    import bigdl_tpu.nn as nn
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.dataset import SampleToMiniBatch
+    from bigdl_tpu.dataset.dataset import ShardedDataSet
+    from bigdl_tpu.dataset.datasets import synthetic_separable
+    from bigdl_tpu.parallel import DistriOptimizer
+    from bigdl_tpu.parallel.distri_optimizer import local_data_partitions
+    from bigdl_tpu.visualization import TrainSummary, ValidationSummary
+
+    mesh = Engine.create_mesh()
+    local = local_data_partitions(mesh)
+    samples = synthetic_separable(128, 4, n_classes=2, seed=3)
+    ds = ShardedDataSet(samples, 8, local_partitions=local).transform(
+        SampleToMiniBatch(32, 8))
+    model = (nn.Sequential().add(nn.Linear(4, 16)).add(nn.Tanh())
+             .add(nn.Linear(16, 2)).add(nn.LogSoftMax()))
+    model.reset(jax.random.PRNGKey(11))
+    opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion(), mesh=mesh)
+    opt.set_optim_method(optim.SGD(learning_rate=0.2, momentum=0.9))
+    opt.set_end_when(optim.max_iteration(4))
+    # every process evaluates (the sharded forward is collective); only
+    # rank 0 may produce event files
+    opt.set_validation(optim.several_iteration(2), list(samples),
+                       [optim.Top1Accuracy()], batch_size=32)
+    opt.set_train_summary(TrainSummary(logdir, "mh"))
+    val_summary = ValidationSummary(logdir, "mh")
+    opt.set_validation_summary(val_summary)
+    opt.optimize()
+    scores = val_summary.read_scalar("Top1Accuracy") if pid == 0 else []
+    with open(os.path.join(outdir, f"val_score{pid}.txt"), "w") as f:
+        f.write(repr((opt.optim_method.state.get("score"), scores)))
+    print("VAL_WORKER_OK", pid)
+""")
+
+
+@pytest.mark.slow
+def test_two_process_validation_single_writer_summaries():
+    """2-process training with a validation trigger: both processes run the
+    sharded evaluation (identical scores), but only rank 0 emits TensorBoard
+    events — exactly one events file per summary dir (reference: summaries
+    are driver-side, ``optim/DistriOptimizer.scala:426-456``)."""
+    with tempfile.TemporaryDirectory() as outdir, \
+            tempfile.TemporaryDirectory() as logdir:
+        _run_pair(_VAL_WORKER, [outdir, logdir], "VAL_WORKER_OK")
+        s0 = open(os.path.join(outdir, "val_score0.txt")).read()
+        s1 = open(os.path.join(outdir, "val_score1.txt")).read()
+        score0, scalars = eval(s0)
+        score1, _ = eval(s1)
+        assert score0 is not None and score0 == score1, (s0, s1)
+        # the validation summary carries both trigger firings
+        assert len(scalars) == 2 and all(v > 0 for _, v in scalars), scalars
+        for sub in ("train", "validation"):
+            d = os.path.join(logdir, "mh", sub)
+            events = [f for f in os.listdir(d)
+                      if f.startswith("events.out.tfevents")]
+            assert len(events) == 1, (sub, events)
+
+
+_RETRY_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    pid = int(sys.argv[1]); port = sys.argv[2]; outdir = sys.argv[3]
+    ckptdir = sys.argv[4]
+    from bigdl_tpu.engine import Engine
+    Engine.init_distributed(f"127.0.0.1:{port}", 2, pid)
+
+    from bigdl_tpu.utils import config, file_io
+    config.set_property("bigdl.failure.retryTimeInterval", 0.0)
+    _saves = []
+    _orig_save = file_io.save
+    def _counting_save(obj, path, overwrite=True):
+        _saves.append(path)
+        return _orig_save(obj, path, overwrite)
+    file_io.save = _counting_save
+
+    import numpy as np
+    import bigdl_tpu.nn as nn
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.dataset import SampleToMiniBatch
+    from bigdl_tpu.dataset.dataset import ShardedDataSet
+    from bigdl_tpu.dataset.datasets import synthetic_separable
+    from bigdl_tpu.dataset.transformer import Transformer
+    from bigdl_tpu.parallel import DistriOptimizer
+    from bigdl_tpu.parallel.distri_optimizer import local_data_partitions
+
+    class FailOnce(Transformer):
+        # trips on the k-th shard-batch pull of THIS process, once; both
+        # ranks trip at the same global iteration (symmetric injection —
+        # the failure surfaces at fetch time, before any collective is in
+        # flight, like a data-source loss on every node at once)
+        def __init__(self, fail_at):
+            self.fail_at = fail_at
+            self.seen = 0
+            self.tripped = False
+        def __call__(self, it):
+            for batch in it:
+                self.seen += 1
+                if self.seen == self.fail_at and not self.tripped:
+                    self.tripped = True
+                    raise RuntimeError("injected multi-host failure")
+                yield batch
+
+    mesh = Engine.create_mesh()
+    local = local_data_partitions(mesh)
+    samples = synthetic_separable(128, 4, n_classes=2, seed=3)
+    # full-batch epochs: 4 owned shards x 1 pull per iteration, so
+    # fail_at=9 trips while fetching iteration 3 — after the iteration-1
+    # snapshot (several_iteration(2) fires at post-step counter 2) is
+    # written AND barrier-synced, so both ranks restore the same snapshot
+    injector = FailOnce(fail_at=9)
+    ds = ShardedDataSet(samples, 8, local_partitions=local).transform(
+        SampleToMiniBatch(128, 8)).transform(injector)
+    model = (nn.Sequential().add(nn.Linear(4, 16)).add(nn.Tanh())
+             .add(nn.Linear(16, 2)).add(nn.LogSoftMax()))
+    model.reset(jax.random.PRNGKey(11))
+    opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion(), mesh=mesh)
+    opt.set_optim_method(optim.SGD(learning_rate=0.2, momentum=0.9))
+    opt.set_end_when(optim.max_iteration(6))
+    opt.set_checkpoint(ckptdir, optim.several_iteration(2))
+    trained = opt.optimize()
+    assert injector.tripped, "injection never fired"
+    w, _ = trained.get_parameters()
+    np.save(os.path.join(outdir, f"rt_w{pid}.npy"), np.asarray(w))
+    if pid != 0:
+        assert not _saves, f"rank 1 wrote: {_saves}"
+    print("RETRY_WORKER_OK", pid)
+""")
+
+
+@pytest.mark.slow
+def test_two_process_retry_from_snapshot():
+    """Distributed crash mid-epoch: both processes hit an injected fetch
+    failure at iteration 3, each restores the iteration-2 snapshot written
+    by rank 0 and resumes; final weights match the uninterrupted
+    single-process run (reference retry loop,
+    ``optim/DistriOptimizer.scala:750-816`` /
+    ``DistriOptimizerSpec.scala:89-99``)."""
+    with tempfile.TemporaryDirectory() as outdir, \
+            tempfile.TemporaryDirectory() as ckptdir:
+        _run_pair(_RETRY_WORKER, [outdir, ckptdir], "RETRY_WORKER_OK")
+        w0 = np.load(os.path.join(outdir, "rt_w0.npy"))
+        w1 = np.load(os.path.join(outdir, "rt_w1.npy"))
+        np.testing.assert_array_equal(w0, w1)
+
+        import jax
+        import bigdl_tpu.nn as nn
+        import bigdl_tpu.optim as optim
+        from bigdl_tpu.dataset import SampleToMiniBatch
+        from bigdl_tpu.dataset.dataset import ShardedDataSet
+        from bigdl_tpu.dataset.datasets import synthetic_separable
+        from bigdl_tpu.engine import Engine
+        from bigdl_tpu.parallel import DistriOptimizer
+
+        samples = synthetic_separable(128, 4, n_classes=2, seed=3)
+        ds = ShardedDataSet(samples, N_DEV).transform(
+            SampleToMiniBatch(128, N_DEV))
+        model = (nn.Sequential().add(nn.Linear(4, 16)).add(nn.Tanh())
+                 .add(nn.Linear(16, 2)).add(nn.LogSoftMax()))
+        model.reset(jax.random.PRNGKey(11))
+        opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion(),
+                              mesh=Engine.create_mesh())
+        opt.set_optim_method(optim.SGD(learning_rate=0.2, momentum=0.9))
+        opt.set_end_when(optim.max_iteration(6))
+        w_single, _ = opt.optimize().get_parameters()
+        np.testing.assert_allclose(w0, np.asarray(w_single),
+                                   rtol=2e-4, atol=2e-5)
+
+
 _SP_WORKER = textwrap.dedent("""
     import os, sys
     os.environ["JAX_PLATFORMS"] = "cpu"
