@@ -95,6 +95,12 @@ class BatchPrefetcher:
                       else config.get_int("bigdl.prefetch.depth", 2))
         self._fetch = fetch
         self._on_batch = on_batch
+        # the producer owns epoch rollovers (reshuffles): it must continue
+        # the CONSTRUCTING thread's RNG stream, so a user's set_seed on the
+        # main thread keeps governing epoch 2+ shuffles whether or not
+        # prefetch is enabled
+        from bigdl_tpu.utils.random_generator import RandomGenerator
+        self._rng = RandomGenerator.RNG()
         if self.depth <= 0:
             return
         self._q: "queue.Queue" = queue.Queue(maxsize=self.depth)
@@ -109,6 +115,8 @@ class BatchPrefetcher:
         return batch
 
     def _run(self):
+        from bigdl_tpu.utils.random_generator import RandomGenerator
+        RandomGenerator.adopt(self._rng)
         while not self._stop.is_set():
             try:
                 item = (None, self._fetch_once())

@@ -569,7 +569,16 @@ def _global_batch(shard_iters, batch_sharding, mesh, partition_num,
     batches = [next(shard_iters[p]) for p in sorted(shard_iters)]
     inputs = _cat([b.get_input() for b in batches])
     targets = _cat([b.get_target() for b in batches])
-    bsz = sum(b.size() for b in batches) * partition_num // len(batches)
+    sizes = {b.size() for b in batches}
+    if len(sizes) != 1:
+        # the global record count is derived as per-partition size x
+        # partition_num; uneven local minibatches would silently miscount
+        # epoch boundaries on both the producer rollover and the driver
+        raise ValueError(
+            f"locally-owned partitions yielded unequal minibatch sizes "
+            f"{sorted(sizes)} — SampleToMiniBatch(batch, partition_num) "
+            "must split evenly across partitions")
+    bsz = sizes.pop() * partition_num
     if check is not None:
         inputs = jax.tree_util.tree_map(check, inputs)
         targets = jax.tree_util.tree_map(check, targets)

@@ -310,7 +310,10 @@ class PipelineOptimizer(Optimizer):
         model.training()
         for b in self.blocks:
             b._ensure_init()
-        _check_block(self.blocks[0])
+            # every stage must pass the statelessness guard, not just the
+            # first: a BatchNorm at stage 3 would silently lose its state
+            # updates in the scanned schedule just as surely as at stage 0
+            _check_block(b)
 
         params = {"stages": pipeline_shard_params(
             stack_stage_params([b.params for b in self.blocks]), mesh)}

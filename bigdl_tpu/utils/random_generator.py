@@ -36,6 +36,18 @@ class RandomGenerator:
             cls._tls.inst = inst
         return inst
 
+    @classmethod
+    def adopt(cls, inst: "RandomGenerator") -> None:
+        """Install ``inst`` as THIS thread's generator.
+
+        Used by single-producer worker threads (``Engine.BatchPrefetcher``)
+        that take over a stream the constructing thread started: epoch
+        reshuffles must continue the SAME RandomState the user seeded via
+        ``set_seed`` on the main thread, not a fresh default-seeded
+        thread-local — otherwise reproducibility silently depends on which
+        thread performs the rollover (prefetch depth 0 vs >0)."""
+        cls._tls.inst = inst
+
     def set_seed(self, seed: int) -> "RandomGenerator":
         self._seed = seed
         self._rng = np.random.RandomState(seed)

@@ -525,11 +525,19 @@ class TensorflowLoader:
                                           .reshape(-1)))
 
     def _op_lrn(self, node):
+        # defaults apply only when the attr is ABSENT: an explicit 0 (a
+        # legal, if degenerate, LRN setting) must import as written, not be
+        # truthiness-coerced to the TF default
+        def attr_or(name, field, default):
+            if name in node.attr:
+                return getattr(node.attr[name], field)
+            return default
+
         return self._unary(node, _LRNLastAxis(
-            node.attr["depth_radius"].i or 5,
-            node.attr["bias"].f if node.attr["bias"].f else 1.0,
-            node.attr["alpha"].f if node.attr["alpha"].f else 1.0,
-            node.attr["beta"].f if node.attr["beta"].f else 0.5))
+            attr_or("depth_radius", "i", 5),
+            attr_or("bias", "f", 1.0),
+            attr_or("alpha", "f", 1.0),
+            attr_or("beta", "f", 0.5)))
 
     def _op_fill(self, node):
         """Fill(dims, value): folded to a Const when both are static (the

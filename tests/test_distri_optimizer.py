@@ -110,6 +110,29 @@ class TestDistriOptimizer:
         w_distri = run(True)
         np.testing.assert_allclose(w_distri, w_local, rtol=2e-4, atol=2e-5)
 
+    def test_unequal_local_minibatches_rejected(self):
+        """_global_batch derives the global record count as per-partition
+        size x partition_num; uneven local minibatches would silently
+        miscount epoch boundaries, so they must raise (advisor r3)."""
+        import pytest
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from bigdl_tpu.dataset.sample import MiniBatch
+        from bigdl_tpu.engine import Engine
+        from bigdl_tpu.parallel.distri_optimizer import _global_batch
+
+        mesh = Engine.create_mesh()
+        sharding = NamedSharding(mesh, P("data"))
+
+        def it(n):
+            while True:
+                yield MiniBatch(np.zeros((n, 4), np.float32),
+                                np.ones((n,), np.float32))
+
+        iters = {i: it(4) for i in range(N_DEV - 1)}
+        iters[N_DEV - 1] = it(5)
+        with pytest.raises(ValueError, match="unequal"):
+            _global_batch(iters, sharding, mesh, N_DEV)
+
     def test_adam_sharded_slots(self):
         """ZeRO-1: Adam's m/v slots live sharded over the data axis."""
         samples = synthetic_separable(128, 4, n_classes=2, seed=3)

@@ -526,6 +526,19 @@ class TestTensorflowPatternParity:
         np.testing.assert_allclose(np.asarray(back.evaluate().forward(x)),
                                    ours, rtol=1e-4, atol=1e-4)
 
+    def test_lrn_explicit_zero_attr_parity(self):
+        """depth_radius=0 is a legal (degenerate) LRN — each channel
+        normalized by itself alone.  The importer must read the explicit 0,
+        not truthiness-coerce it to the TF default of 5 (advisor r3)."""
+        def build(tf):
+            x = tf.compat.v1.placeholder(tf.float32, [None, 4, 4, 8],
+                                         name="input")
+            tf.nn.lrn(x, depth_radius=0, bias=1.0, alpha=1.0, beta=0.5,
+                      name="output")
+        x = np.random.RandomState(5).normal(
+            size=(2, 4, 4, 8)).astype(np.float32)
+        self._golden(build, x, rtol=1e-4, atol=1e-4)
+
     def test_lrn_export_roundtrip_and_tf_parity(self, tmp_path):
         from bigdl_tpu.utils.tf import TensorflowLoader, saver
         model = (nn.Sequential()
