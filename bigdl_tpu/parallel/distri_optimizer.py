@@ -408,7 +408,8 @@ class DistriOptimizer(Optimizer):
         weight are split the same way — the memory win tensor parallelism
         exists for."""
         from bigdl_tpu.parallel.tensor_parallel import (tp_shard_params,
-                                                        tp_specs)
+                                                        tp_specs,
+                                                        zero1_slot_specs)
 
         model, mesh = self.model, self.mesh
         specs = tp_specs(model, axis="model", mesh=mesh)
@@ -417,13 +418,33 @@ class DistriOptimizer(Optimizer):
             "params": tp_shard_params(model.params, mesh, specs),
             "mstate": jax.device_put(model.state, rep),
         }
-        # fresh slots inherit param shardings via zeros_like; resumed
-        # slots (canonical pytree from a snapshot) re-place on first use
-        carry["slots"] = self.optim_method.slots(carry["params"])
+        # slots shard over BOTH axes: the tp split from the parameter spec
+        # plus ZeRO-1 over 'data' (a dp x tp run must not pay dp-fold
+        # optimizer-state memory); fresh zeros and resumed host snapshots
+        # alike are placed onto the slot specs
+        slot_specs = zero1_slot_specs(carry["params"], specs,
+                                      mesh.shape["data"])
+        slots0 = (self.optim_method._slots
+                  if self.optim_method._slots is not None
+                  else self.optim_method.init_slots(carry["params"]))
+        carry["slots"] = self._map_over_slots(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            slots0, slot_specs)
+        self.optim_method.set_slots(carry["slots"])
         self.optim_method.state.setdefault("epoch", 1)
 
         if self._step_fn is None:
-            self._step_fn = self._build_gspmd_step()
+            # pin the step's output shardings: params come back in their tp
+            # placement (replicated over 'data' — XLA schedules the ZeRO
+            # all-gather after the update), slots stay data x model sharded
+            param_sh = jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), specs,
+                is_leaf=lambda s: isinstance(s, P))
+            slot_sh = self._map_over_slots(
+                lambda x, s: NamedSharding(mesh, s), carry["slots"],
+                slot_specs)
+            self._step_fn = self._build_gspmd_step(
+                out_shardings=(param_sh, slot_sh, rep, rep))
 
         batch_sharding = NamedSharding(mesh, P("data"))
         local_ids = local_data_partitions(mesh)
@@ -470,7 +491,20 @@ class DistriOptimizer(Optimizer):
                     epoch_size=self.dataset.size())
         return model
 
-    def _build_gspmd_step(self):
+    def _map_over_slots(self, fn, slots, per_param_tree):
+        """Apply ``fn(slot_leaf_tree_element, per_param_element)`` across
+        every slot family (Adam's m/v, momentum's v, …): slot pytrees are
+        {family: params-shaped tree}, so the per-parameter spec tree is
+        zipped against each family's subtree."""
+        outer = jax.tree_util.tree_structure(
+            self.optim_method.init_slots(jnp.zeros(())))
+        subtrees = outer.flatten_up_to(slots)
+        return jax.tree_util.tree_unflatten(
+            outer,
+            [jax.tree_util.tree_map(fn, st, per_param_tree)
+             for st in subtrees])
+
+    def _build_gspmd_step(self, out_shardings=None):
         model, criterion = self.model, self.criterion
         optim = self.optim_method
         precision = self.precision
@@ -491,7 +525,8 @@ class DistriOptimizer(Optimizer):
                                                       hyper)
             return new_params, new_slots, new_mstate, loss
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return jax.jit(step, donate_argnums=(0, 1, 2),
+                       out_shardings=out_shardings)
 
     def _wire_sequence_parallel(self, module) -> None:
         """Point every MultiHeadAttention at the mesh's seq axis.  The ring

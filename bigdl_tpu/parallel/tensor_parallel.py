@@ -121,6 +121,36 @@ def row_parallel(linear: Linear) -> Linear:
     return linear
 
 
+def zero1_slot_spec(shape, spec: P, dp: int, axis: str = "data") -> P:
+    """Optimizer-slot spec for a parameter with tensor-parallel ``spec``:
+    additionally sharded over the data axis (ZeRO-1).
+
+    The tp split already divides a weight ``1/tp`` over ``model``; its
+    Adam/momentum slots can further split ``1/dp`` over ``data`` because
+    the optimizer update is elementwise — each data replica only needs the
+    slot slice for the parameter shard it updates, and XLA's partitioner
+    derives the reduce-scatter/all-gather around the update from the
+    sharding annotations alone (the same ZeRO-1 the shard_map dp step
+    implements explicitly with psum_scatter).  The first dimension that is
+    unsharded in ``spec`` and divisible by ``dp`` carries the data axis;
+    a parameter with no such dimension (tiny biases) keeps ``spec`` —
+    replicating a vector costs nothing worth a ragged-shard lowering."""
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    for i, (dim, e) in enumerate(zip(shape, entries)):
+        if e is None and dim % dp == 0 and dim >= dp:
+            entries[i] = axis
+            return P(*entries)
+    return spec
+
+
+def zero1_slot_specs(params, specs, dp: int, axis: str = "data"):
+    """Per-parameter slot specs (:func:`zero1_slot_spec` over the tree)."""
+    if dp <= 1:
+        return specs
+    return jax.tree_util.tree_map(
+        lambda x, s: zero1_slot_spec(x.shape, s, dp, axis), params, specs)
+
+
 def tp_shard_params(params, mesh: Mesh, specs):
     """Place a params pytree on the mesh with the given spec pytree —
     weights are physically split 1/n per device along the model axis."""

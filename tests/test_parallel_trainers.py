@@ -73,8 +73,10 @@ class TestTensorParallelTrainer:
                                    rtol=2e-4, atol=2e-5)
 
     def test_tp_params_and_slots_physically_split(self):
-        """Column weight and its Adam slots live 1/n per device along the
-        model axis — the memory win tp exists for."""
+        """Column weight lives 1/tp per device along the model axis; its
+        Adam slots additionally split 1/dp over the data axis (ZeRO-1 in
+        the GSPMD step) — a dp x tp run must not pay dp-fold
+        optimizer-state memory."""
         samples = synthetic_separable(64, 4, n_classes=2, seed=3)
         mesh = Engine.create_mesh((2, 4), ("data", "model"))
         m = _tp_model(tp=True)
@@ -86,7 +88,11 @@ class TestTensorParallelTrainer:
         col_w = m.children[0].params["weight"]          # (4, 16) column
         assert {s.data.shape for s in col_w.addressable_shards} == {(4, 4)}
         slot = o.optim_method._slots["s"][0]["weight"]  # Adam m for it
-        assert {s.data.shape for s in slot.addressable_shards} == {(4, 4)}
+        # (4, 16) -> P("data", "model"): 1/(dp*tp) = 1/8 per device
+        assert {s.data.shape for s in slot.addressable_shards} == {(2, 4)}
+        per_dev = sum(s.data.nbytes for s in slot.addressable_shards
+                      if s.device == slot.addressable_shards[0].device)
+        assert per_dev * 8 == slot.nbytes
 
     def test_model_axis_rejects_seq_combo(self):
         samples = synthetic_separable(64, 4, n_classes=2, seed=3)
