@@ -145,19 +145,23 @@ def transformer_block(d_model: int, n_head: int, ff_mult: int = 4,
 
 def transformer_lm(vocab_size: int, d_model: int = 128, n_head: int = 4,
                    n_layers: int = 2, max_len: int = 4096,
-                   tp: bool = False, moe_experts: int = 0) -> nn.Sequential:
+                   tp: bool = False, moe_experts: int = 0,
+                   moe_top_k: int = 1) -> nn.Sequential:
     """Token ids (B, T), 1-based -> log-probs (B, T, vocab).
 
-    ``moe_experts=E`` makes every block's FFN a Switch MoE (train on a
+    ``moe_experts=E`` makes every block's FFN a MoE (train on a
     ``("data", "expert")`` mesh for expert parallelism — the driver's
-    ``--expert-parallel``); ``tp=True`` tags Megatron splits (train on a
-    ``("data", "model")`` mesh — ``--tensor-parallel``)."""
+    ``--expert-parallel``); ``moe_top_k`` selects the routing: 1 = Switch,
+    2 = the GShard configuration (driver ``--moe-top-k``).  ``tp=True``
+    tags Megatron splits (train on a ``("data", "model")`` mesh —
+    ``--tensor-parallel``)."""
     m = (nn.Sequential()
          .add(nn.LookupTable(vocab_size, d_model))
          .add(PositionalEncoding(d_model, max_len)))
     for _ in range(n_layers):
         m.add(transformer_block(d_model, n_head, tp=tp,
-                                moe_experts=moe_experts))
+                                moe_experts=moe_experts,
+                                moe_top_k=moe_top_k))
     m.add(LayerNorm(d_model))
     m.add(nn.Linear(d_model, vocab_size))
     m.add(nn.LogSoftMax())
@@ -166,7 +170,8 @@ def transformer_lm(vocab_size: int, d_model: int = 128, n_head: int = 4,
 
 def transformer_lm_pipeline(vocab_size: int, d_model: int = 128,
                             n_head: int = 4, n_layers: int = 2,
-                            max_len: int = 4096, moe_experts: int = 0):
+                            max_len: int = 4096, moe_experts: int = 0,
+                            moe_top_k: int = 1):
     """``(embed, blocks, head)`` for
     :class:`~bigdl_tpu.parallel.pipeline.PipelineOptimizer`: the embedding
     and LM head run replicated, the ``n_layers`` homogeneous decoder
@@ -177,7 +182,8 @@ def transformer_lm_pipeline(vocab_size: int, d_model: int = 128,
     embed = (nn.Sequential()
              .add(nn.LookupTable(vocab_size, d_model))
              .add(PositionalEncoding(d_model, max_len)))
-    blocks = [transformer_block(d_model, n_head, moe_experts=moe_experts)
+    blocks = [transformer_block(d_model, n_head, moe_experts=moe_experts,
+                                moe_top_k=moe_top_k)
               for _ in range(n_layers)]
     head = (nn.Sequential()
             .add(LayerNorm(d_model))

@@ -84,6 +84,9 @@ def main(argv=None):
     p.add_argument("--expert-parallel", type=int, default=0,
                    help="N>1: all_to_all MoE dispatch over a "
                         "('data','expert') mesh (needs --moe-experts)")
+    p.add_argument("--moe-top-k", type=int, default=1,
+                   help="experts per token: 1 = Switch (default), "
+                        "2 = the GShard configuration (needs --moe-experts)")
     p.add_argument("--pipeline", type=int, default=0,
                    help="S>1: GPipe the S decoder blocks over a 'stage' "
                         "mesh axis (sets --layers S)")
@@ -100,6 +103,8 @@ def main(argv=None):
         raise SystemExit(f"pick one parallelism mode, got {modes}")
     if args.expert_parallel > 1 and not args.moe_experts:
         raise SystemExit("--expert-parallel needs --moe-experts")
+    if args.moe_top_k != 1 and not args.moe_experts:
+        raise SystemExit("--moe-top-k needs --moe-experts")
 
     if args.synthetic:
         records = _synthetic(args.synthetic, args.seq_len)
@@ -122,7 +127,8 @@ def main(argv=None):
                              "snapshot resume yet")
         embed, blocks, head = transformer_lm_pipeline(
             VOCAB, args.d_model, args.heads, n_layers=args.pipeline,
-            max_len=max(4096, args.seq_len), moe_experts=args.moe_experts)
+            max_len=max(4096, args.seq_len), moe_experts=args.moe_experts,
+            moe_top_k=args.moe_top_k)
         shape = (dp, args.pipeline) if dp > 1 else (args.pipeline,)
         names = ("data", "stage") if dp > 1 else ("stage",)
         mesh = _partial_mesh(Engine, shape, names)
@@ -138,7 +144,8 @@ def main(argv=None):
                                          args.layers,
                                          max_len=max(4096, args.seq_len),
                                          tp=args.tensor_parallel > 1,
-                                         moe_experts=args.moe_experts),
+                                         moe_experts=args.moe_experts,
+                                         moe_top_k=args.moe_top_k),
             lambda: optim.Adam(learning_rate=lr))
         if args.seq_parallel > 1:
             mesh = _partial_mesh(Engine, (dp, args.seq_parallel),

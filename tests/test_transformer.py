@@ -125,6 +125,20 @@ class TestTransformerLM:
         acc = self._drive(capsys, ["--pipeline", "2", "--partitions", "2"])
         assert 0.0 <= acc <= 1.0
 
+    def test_driver_moe_top_k_flag(self, capsys):
+        """--moe-top-k 2 builds the GShard configuration end-to-end (every
+        MoE layer routes top-2) through the dp x ep mesh."""
+        from bigdl_tpu.models.transformer import train as drv
+        trained = drv.main(["--synthetic", "48", "--seq-len", "8",
+                            "--max-epoch", "2", "--batch-size", "16",
+                            "--d-model", "16", "--heads", "2",
+                            "--moe-experts", "4", "--moe-top-k", "2",
+                            "--partitions", "2", "--expert-parallel", "4"])
+        capsys.readouterr()
+        from bigdl_tpu.nn.moe import MixtureOfExperts
+        moes = trained.find_modules(MixtureOfExperts)
+        assert moes and all(m.top_k == 2 for m in moes)
+
     def test_driver_rejects_mode_combo_and_missing_moe(self):
         from bigdl_tpu.models.transformer import train as drv
         with pytest.raises(SystemExit, match="one parallelism"):
@@ -132,6 +146,8 @@ class TestTransformerLM:
                       "--tensor-parallel", "2"])
         with pytest.raises(SystemExit, match="moe-experts"):
             drv.main(["--synthetic", "8", "--expert-parallel", "2"])
+        with pytest.raises(SystemExit, match="moe-experts"):
+            drv.main(["--synthetic", "8", "--moe-top-k", "2"])
 
 
 def test_odd_d_model_positional_encoding():
