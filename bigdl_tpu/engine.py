@@ -112,6 +112,14 @@ class BatchPrefetcher:
         batch = self._fetch()
         if self._on_batch is not None:
             self._on_batch(batch)
+        # a batch handed to the consumer is DEVICE-RESIDENT: dispatching a
+        # step against an in-flight host->device transfer costs ~10x the
+        # step latency on the tunneled backend (measured: 1.9 s vs 0.16 s
+        # for a ResNet-50 b128 batch) — the producer absorbs the transfer
+        # wait here, overlapped with the consumer's dispatches
+        for leaf in jax.tree_util.tree_leaves(batch):
+            if hasattr(leaf, "block_until_ready"):
+                leaf.block_until_ready()
         return batch
 
     def _run(self):

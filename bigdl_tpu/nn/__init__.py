@@ -49,7 +49,8 @@ from bigdl_tpu.nn.structural import (Identity, Echo, Contiguous, Reshape,
                                      Replicate, Padding, SpatialZeroPadding,
                                      GradientReversal, Scale, Bottle, MM, MV,
                                      DotProduct, Pack, Reverse,
-                                     MulConstant, AddConstant)
+                                     MulConstant, AddConstant,
+                                     ChannelNormalize)
 from bigdl_tpu.nn.table import (Concat, ConcatTable, ParallelTable, MapTable,
                                 JoinTable, SplitTable, SelectTable,
                                 NarrowTable, FlattenTable, MixtureTable,

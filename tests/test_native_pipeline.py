@@ -88,6 +88,87 @@ class TestAssembleBatch:
         assert out.shape == (4, 1, 28, 28)
 
 
+class TestMTLabeledBGRImgToBatch:
+    """The MT ingest stage must reproduce the single-threaded reference
+    chain (BytesToBGRImg → CenterCrop → BGRImgNormalizer → BGRImgToSample
+    → SampleToMiniBatch) exactly when crop is deterministic and flips off
+    — multi-threading is an implementation detail, not a semantics
+    change."""
+
+    def _jpeg_records(self, n=12, hw=(40, 48)):
+        import io
+        from PIL import Image
+        from bigdl_tpu.dataset.image import LabeledImageBytes
+        rng = np.random.RandomState(3)
+        recs = []
+        for i in range(n):
+            img = rng.randint(0, 256, size=hw + (3,)).astype(np.uint8)
+            buf = io.BytesIO()
+            Image.fromarray(img).save(buf, "PNG")   # lossless: exact parity
+            recs.append(LabeledImageBytes(f"r{i}", float(i % 5 + 1),
+                                          buf.getvalue()))
+        return recs
+
+    def test_matches_single_threaded_chain(self):
+        from bigdl_tpu.dataset.image import (BGRImgNormalizer, BGRImgToSample,
+                                             BytesToBGRImg, CenterCrop)
+        from bigdl_tpu.dataset.mt_batch import MTLabeledBGRImgToBatch
+        from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+
+        recs = self._jpeg_records()
+        mean, std = (104.0, 117.0, 123.0), (57.0, 58.0, 59.0)
+
+        mt = MTLabeledBGRImgToBatch(4, crop=(32, 32), mean=mean, std=std,
+                                    random_crop=False, hflip=False,
+                                    n_threads=3)
+        got = list(mt(iter(recs)))
+
+        chain = BytesToBGRImg()(iter(recs))
+        chain = CenterCrop(32, 32)(chain)
+        chain = BGRImgNormalizer(mean, std)(chain)
+        chain = BGRImgToSample()(chain)
+        want = list(SampleToMiniBatch(4)(chain))
+
+        assert len(got) == len(want) == 3
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(g.get_input(), w.get_input(),
+                                       rtol=1e-5, atol=1e-5)
+            np.testing.assert_array_equal(g.get_target(), w.get_target())
+
+    def test_device_normalize_matches_host_normalize(self):
+        """uint8 ingest + nn.ChannelNormalize on device == host-side
+        normalized float batches (the TPU-first byte-reduced layout is a
+        layout change, not a numerics change)."""
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.dataset.mt_batch import MTLabeledBGRImgToBatch
+
+        recs = self._jpeg_records()
+        mean, std = (104.0, 117.0, 123.0), (57.0, 58.0, 59.0)
+        host = list(MTLabeledBGRImgToBatch(
+            4, crop=(32, 32), mean=mean, std=std, random_crop=False,
+            hflip=False)(iter(recs)))
+        raw = list(MTLabeledBGRImgToBatch(
+            4, crop=(32, 32), mean=mean, std=std, random_crop=False,
+            hflip=False, device_normalize=True)(iter(recs)))
+        norm = nn.ChannelNormalize(mean, std)
+        for h, r in zip(host, raw):
+            assert r.get_input().dtype == np.uint8
+            out = np.asarray(norm.forward(r.get_input()))
+            np.testing.assert_allclose(out, h.get_input(),
+                                       rtol=1e-5, atol=1e-4)
+            np.testing.assert_array_equal(h.get_target(), r.get_target())
+
+    def test_batches_and_shapes(self):
+        from bigdl_tpu.dataset.mt_batch import MTLabeledBGRImgToBatch
+        recs = self._jpeg_records(n=10)
+        mt = MTLabeledBGRImgToBatch(4, crop=(32, 32), random_crop=True,
+                                    hflip=True, n_threads=2)
+        batches = list(mt(iter(recs)))
+        # trailing partial batch included, like SampleToMiniBatch
+        assert [b.size() for b in batches] == [4, 4, 2]
+        assert batches[0].get_input().shape == (4, 3, 32, 32)
+
+
 class TestPrefetch:
     def test_order_preserved(self):
         pf = Prefetch(depth=2)
