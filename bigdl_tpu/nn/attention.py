@@ -57,14 +57,17 @@ class MultiHeadAttention(Module):
     """Self-attention over (B, T, D) input; table input (q_src, kv_src)
     gives cross-attention.
 
-    ``flash``: opt-in TPU pallas flash-attention kernel.  Measured on v5e:
-    the ISOLATED kernel beats a naive fp32 masked-softmax by ~30x for
-    causal T=1024-2048, but embedded in the full jitted layer XLA's fused
-    bf16 reference path wins decisively (6.3 ms vs 144 ms per forward at
-    B2/T1024/D512/H4) — so the default (False) is the reference path;
-    pass ``True`` to require the kernel (raises when the backend/shape
-    constraints aren't met; self-attention only — the kernel's causal mask
-    is top-left aligned, which diverges from the reference's
+    ``flash``: opt-in TPU pallas flash-attention kernel.  Measured on v5e
+    across the full shape range (bench_longctx.json): XLA's fused bf16
+    path wins at every shape it compiles — flash is 0.68x at T2048 and
+    0.58x at T8192 in the full jitted train step — but at T16384 the
+    standard path's O(T^2) program fails to compile on this backend
+    while flash runs (13.9k tokens/s at d1024/L8/B1), so flash is the
+    single-chip path beyond ~T8192 (multi-chip: ring attention over a
+    ``seq`` axis).  Default (False) is the standard path; pass ``True``
+    to require the kernel (raises when the backend/shape constraints
+    aren't met; self-attention only — the kernel's causal mask is
+    top-left aligned, which diverges from the reference's
     bottom-right-aligned mask when Tq != Tkv).  Revisit per hardware
     generation."""
 
