@@ -90,6 +90,7 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(ring), np.asarray(full),
                                    rtol=2e-5, atol=2e-6)
 
+    @pytest.mark.slow
     def test_gradients_flow_through_ring(self):
         """Training viability: grads of the ring path are finite and close
         to the full-attention grads."""

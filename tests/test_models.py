@@ -33,6 +33,7 @@ def test_autoencoder():
     _check(out, (4, 784))
 
 
+@pytest.mark.slow
 def test_vgg_for_cifar10():
     m = models.vgg_for_cifar10(10).evaluate()
     out = m.forward(jnp.ones((2, 3, 32, 32)))
@@ -46,6 +47,7 @@ def test_vgg16_imagenet():
     _check(out, (1, 1000))
 
 
+@pytest.mark.slow
 def test_resnet_cifar_depth20():
     m = models.resnet(10, depth=20, dataset=models.DatasetType.CIFAR10)
     models.model_init(m)
@@ -97,6 +99,7 @@ def test_inception_v2_no_aux():
     _check(out, (1, 1000))
 
 
+@pytest.mark.slow
 def test_alexnet_owt():
     m = models.alexnet_owt(1000).evaluate()
     out = m.forward(jnp.ones((1, 3, 224, 224)))

@@ -68,6 +68,7 @@ class TestMixtureOfExperts:
 
 
 class TestExpertParallel:
+    @pytest.mark.slow
     def test_matches_dense_when_nothing_drops(self):
         mesh = Engine.create_mesh((N_DEV,), ("expert",),
                                   devices=jax.devices()[:N_DEV])
@@ -183,6 +184,7 @@ class TestTopK:
         with pytest.raises(ValueError, match="top_k"):
             MixtureOfExperts(D, nn.Linear(D, D), E, top_k=E + 1)
 
+    @pytest.mark.slow
     def test_ep_parity_with_top2(self):
         mesh = Engine.create_mesh((N_DEV,), ("expert",),
                                   devices=jax.devices()[:N_DEV])
@@ -210,6 +212,7 @@ def test_capacity_scales_with_top_k():
     assert per_token.mean() > 1.9, "top-2 assignments dropped at default cf"
 
 
+@pytest.mark.slow
 def test_ep_returns_pmeant_aux():
     mesh = Engine.create_mesh((N_DEV,), ("expert",),
                               devices=jax.devices()[:N_DEV])

@@ -275,6 +275,7 @@ class TestPipelineOptimizer:
         base = float(np.mean(y ** 2))
         assert mse < base * 0.6, (mse, base)
 
+    @pytest.mark.slow
     def test_embed_head_lm_shape(self):
         """A full LM: embed -> pipelined blocks -> head, trained through
         the public API on a stage mesh."""
@@ -356,6 +357,7 @@ class TestPipelineOptimizer:
 
 
 class TestPipelineMoeAndSharded:
+    @pytest.mark.slow
     def test_pipeline_apply_returns_moe_aux(self):
         """return_aux collects the blocks' declared MoE diagnostics over
         real (non-drain) microbatch executions; a router at uniform

@@ -182,6 +182,7 @@ class TestPipeline:
             pipeline_apply(block, stacked, jnp.zeros((7, D)), 1, mesh,
                            data_axis="data")
 
+    @pytest.mark.slow
     def test_moe_block_composes_with_pipeline(self):
         """aux_loss is a per-forward diagnostic, not threaded state — it
         must not trip the statelessness guard.  MoE capacity-drop is a
@@ -215,6 +216,7 @@ class TestPipeline:
         np.testing.assert_allclose(np.asarray(out), want,
                                    rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.slow
     def test_moe_dropfree_pipeline_matches_full_batch(self):
         """With capacity_factor >= E/top_k no token can ever drop, routing
         is batch-split-invariant, and the pipeline DOES equal the

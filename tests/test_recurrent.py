@@ -145,6 +145,7 @@ class TestCellStandalone:
 
 
 class TestRecurrentTraining:
+    @pytest.mark.slow
     def test_char_lm_loss_decreases(self):
         """Tiny SimpleRNN-style LM learns a repeating pattern
         (reference ``models/rnn`` config)."""

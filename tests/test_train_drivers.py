@@ -24,6 +24,7 @@ class TestTrainDrivers:
         w, _ = model.get_parameters()
         assert np.all(np.isfinite(np.asarray(w)))
 
+    @pytest.mark.slow
     def test_lenet_checkpoint_resume_flags(self, tmp_path):
         ckpt = str(tmp_path / "ckpt")
         lenet_train.main(["--synthetic", "128", "-b", "64", "-e", "2",
@@ -38,41 +39,50 @@ class TestTrainDrivers:
         w, _ = model.get_parameters()
         assert np.all(np.isfinite(np.asarray(w)))
 
+    @pytest.mark.slow
     def test_vgg_synthetic_smoke(self):
         vgg_train.main(["--synthetic", "64", "-b", "16",
                         "--max-iteration", "3"])
 
+    @pytest.mark.slow
     def test_vgg_distributed_partitions(self):
         vgg_train.main(["--synthetic", "128", "-b", "32",
                         "--max-iteration", "3", "--partitions", "8"])
 
+    @pytest.mark.slow
     def test_resnet_cifar_synthetic_smoke(self):
         resnet_train.main(["--synthetic", "64", "-b", "16", "--depth", "20",
                            "--max-iteration", "3"])
 
+    @pytest.mark.slow
     def test_rnn_lm_synthetic(self):
         rnn_train.main(["--synthetic", "128", "-b", "32", "-e", "2",
                         "--cell", "rnn"])
 
+    @pytest.mark.slow
     def test_lstm_lm_synthetic(self):
         rnn_train.main(["--synthetic", "64", "-b", "16",
                         "--max-iteration", "4", "--cell", "lstm"])
 
+    @pytest.mark.slow
     def test_textclassifier_synthetic_smoke(self):
         tc_train.main(["--synthetic", "32", "-b", "8",
                        "--max-iteration", "2"])
 
+    @pytest.mark.slow
     def test_autoencoder_synthetic(self):
         from bigdl_tpu.models.autoencoder import train as ae_train
         model = ae_train.main(["--synthetic", "256", "-b", "64", "-e", "3"])
         w, _ = model.get_parameters()
         assert np.all(np.isfinite(np.asarray(w)))
 
+    @pytest.mark.slow
     def test_inception_synthetic_smoke(self):
         from bigdl_tpu.models.inception import train as inc_train
         inc_train.main(["--synthetic", "16", "-b", "8", "--classes", "4",
                         "--max-iteration", "2"])
 
+    @pytest.mark.slow
     def test_lenet_eval_only_driver(self, tmp_path):
         from bigdl_tpu.models.lenet import test as lenet_test
         ckpt = str(tmp_path / "ckpt")
@@ -83,6 +93,7 @@ class TestTrainDrivers:
                                    "--model", os.path.join(ckpt, snaps[-1])])
         assert results[0][0].name == "Top1Accuracy"
 
+    @pytest.mark.slow
     def test_treelstm_sentiment_synthetic(self):
         from bigdl_tpu.models.treelstm import train as tree_train
         model = tree_train.main(["--synthetic", "128", "-b", "32",

@@ -116,11 +116,13 @@ class TestTransformerLM:
                                    "--tensor-parallel", "2"])
         assert 0.0 <= acc <= 1.0
 
+    @pytest.mark.slow
     def test_driver_expert_parallel_flag(self, capsys):
         acc = self._drive(capsys, ["--moe-experts", "4", "--partitions", "2",
                                    "--expert-parallel", "4"])
         assert 0.0 <= acc <= 1.0
 
+    @pytest.mark.slow
     def test_driver_pipeline_flag(self, capsys):
         acc = self._drive(capsys, ["--pipeline", "2", "--partitions", "2"])
         assert 0.0 <= acc <= 1.0
