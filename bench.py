@@ -293,7 +293,9 @@ def bench_realdata(batch: int = 128, steps: int = 20, warmup: int = 4):
     from bigdl_tpu.parallel import DistriOptimizer
 
     n_images = batch * 10
-    root = "/tmp/bigdl_bench_seq_v1"
+    # per-size root: one shared dir with per-count .done markers would go
+    # stale when a different --batch overwrites the part files
+    root = f"/tmp/bigdl_bench_seq_v1_{n_images}"
     _make_bench_seqfiles(root, n_images)
 
     # stage 1: native seqfile record read (bytes only)
@@ -464,6 +466,12 @@ def main():
     result = {"metric": "resnet50_train_images_per_sec",
               "value": round(value, 1), "unit": "images/sec",
               "vs_baseline": round(vs, 3)}
+    # emit the headline IMMEDIATELY: the experimental legs below run for
+    # minutes and one (longctx T16384 standard) is expected to crash the
+    # remote compile helper — a hard abort there must not lose the
+    # already-measured number.  The enriched record is re-printed at the
+    # end; consumers parse the LAST JSON line.
+    print(json.dumps(result), flush=True)
 
     # LM flagship legs: two REALISTIC shapes through the same fused step.
     # - base: 134M params, d1024/L8/T2048/B8 (head_dim 128) — r3's point,

@@ -76,6 +76,51 @@ def assemble_batch(images: Sequence[np.ndarray],
     return out
 
 
+def assemble_batch_u8(images: Sequence[np.ndarray],
+                      crop: Tuple[int, int],
+                      offsets: np.ndarray,
+                      flips: np.ndarray,
+                      n_threads: int = 4) -> np.ndarray:
+    """Raw-uint8 sibling of :func:`assemble_batch`: crop + flip + HWC→CHW
+    pack WITHOUT normalization — the device-normalize ingest layout (pair
+    with ``nn.ChannelNormalize`` on device).  Native std::thread path when
+    built; numpy fallback."""
+    n = len(images)
+    ch, cw = crop
+    channels = images[0].shape[2] if images[0].ndim == 3 else 1
+    imgs = [np.ascontiguousarray(
+        im if im.ndim == 3 else im[:, :, None], dtype=np.uint8)
+        for im in images]
+    offsets = np.ascontiguousarray(offsets, dtype=np.int32)
+    flips = np.ascontiguousarray(flips, dtype=np.uint8)
+    out = np.empty((n, channels, ch, cw), np.uint8)
+
+    lib = load_native()
+    if lib is not None and hasattr(lib, "assemble_batch_u8"):
+        ptrs = (ctypes.c_void_p * n)(
+            *[im.ctypes.data_as(ctypes.c_void_p) for im in imgs])
+        heights = np.asarray([im.shape[0] for im in imgs], np.int32)
+        widths = np.asarray([im.shape[1] for im in imgs], np.int32)
+        lib.assemble_batch_u8(
+            ptrs,
+            heights.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+            widths.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+            n, channels, ch, cw,
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+            flips.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+            int(n_threads))
+        return out
+
+    for i, im in enumerate(imgs):
+        oy, ox = int(offsets[i, 0]), int(offsets[i, 1])
+        patch = im[oy:oy + ch, ox:ox + cw]
+        if flips[i]:
+            patch = patch[:, ::-1]
+        out[i] = patch.transpose(2, 0, 1)
+    return out
+
+
 class MTLabeledBGRImgToBatch(Transformer):
     """Compressed byte records → training MiniBatches, multi-threaded.
 
@@ -163,16 +208,8 @@ class MTLabeledBGRImgToBatch(Transformer):
                     if self.hflip:
                         flips[i] = rng.uniform() < 0.5
                 if self.device_normalize:
-                    x = np.empty((n, images[0].shape[2] if images[0].ndim == 3
-                                  else 1, ch, cw), np.uint8)
-                    for i, im in enumerate(images):
-                        oy, ox = int(offsets[i, 0]), int(offsets[i, 1])
-                        patch = im[oy:oy + ch, ox:ox + cw]
-                        if patch.ndim == 2:
-                            patch = patch[:, :, None]
-                        if flips[i]:
-                            patch = patch[:, ::-1]
-                        x[i] = patch.transpose(2, 0, 1)
+                    x = assemble_batch_u8(images, self.crop, offsets, flips,
+                                          n_threads=self.n_threads)
                 else:
                     x = assemble_batch(images, self.crop, offsets, flips,
                                        self.mean, self.std,
@@ -191,9 +228,17 @@ class Prefetch(Transformer):
         self.depth = depth
 
     def __call__(self, it: Iterator) -> Iterator:
+        from bigdl_tpu.utils.random_generator import RandomGenerator
+
         q: "queue.Queue" = queue.Queue(maxsize=self.depth)
         stop = threading.Event()
         _END = object()
+        # the upstream iterator (and any randomness it draws — MT crop/flip
+        # offsets) executes on the producer thread: it must continue the
+        # CONSUMING thread's RandomGenerator stream, same contract as
+        # Engine.BatchPrefetcher, or a user's set_seed silently stops
+        # governing augmentation whenever Prefetch is in the chain
+        rng = RandomGenerator.RNG()
 
         def put(item) -> bool:
             """Bounded put that gives up when the consumer is gone."""
@@ -206,6 +251,7 @@ class Prefetch(Transformer):
             return False
 
         def producer():
+            RandomGenerator.adopt(rng)
             try:
                 for item in it:
                     if not put(item):

@@ -74,6 +74,18 @@ def load_native() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_float),            # std
             ctypes.POINTER(ctypes.c_float),            # out
             ctypes.c_int]                              # n_threads
+        # raw-uint8 crop/flip/pack (device-normalize ingest layout);
+        # guarded: a stale pre-r4 .so may lack the symbol
+        if hasattr(lib, "assemble_batch_u8"):
+            lib.assemble_batch_u8.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p),       # images
+                ctypes.POINTER(ctypes.c_int),          # heights
+                ctypes.POINTER(ctypes.c_int),          # widths
+                ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int),          # offsets
+                ctypes.POINTER(ctypes.c_ubyte),        # flips
+                ctypes.POINTER(ctypes.c_ubyte),        # out
+                ctypes.c_int]                          # n_threads
         _lib = lib
         return _lib
 

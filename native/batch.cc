@@ -39,9 +39,61 @@ void assemble_range(const uint8_t* const* images, const int* heights,
   }
 }
 
+void assemble_range_u8(const uint8_t* const* images, const int* widths,
+                       int channels, int crop_h, int crop_w,
+                       const int* offsets_hw, const uint8_t* flips,
+                       uint8_t* out, int begin, int end) {
+  const long plane = (long)crop_h * crop_w;
+  for (int i = begin; i < end; i++) {
+    const uint8_t* img = images[i];
+    const int w = widths[i];
+    const int oy = offsets_hw[2 * i];
+    const int ox = offsets_hw[2 * i + 1];
+    const bool flip = flips[i] != 0;
+    uint8_t* dst = out + (long)i * channels * plane;
+    for (int y = 0; y < crop_h; y++) {
+      const uint8_t* row = img + ((long)(y + oy) * w + ox) * channels;
+      for (int x = 0; x < crop_w; x++) {
+        const int sx = flip ? (crop_w - 1 - x) : x;
+        const uint8_t* px = row + (long)sx * channels;
+        for (int c = 0; c < channels; c++) {
+          dst[(long)c * plane + (long)y * crop_w + x] = px[c];
+        }
+      }
+    }
+  }
+}
+
 }  // namespace
 
 extern "C" {
+
+// Raw-uint8 variant of assemble_batch: crop/flip/pack WITHOUT
+// normalization — the device-normalize ingest layout ships uint8 pixels
+// and leaves (x - mean)/std to an on-device module (4x fewer
+// host->device bytes); out: (n, channels, crop_h, crop_w) uint8.
+void assemble_batch_u8(const uint8_t* const* images, const int* heights,
+                       const int* widths, int n, int channels, int crop_h,
+                       int crop_w, const int* offsets_hw,
+                       const uint8_t* flips, uint8_t* out, int n_threads) {
+  (void)heights;
+  if (n_threads <= 1 || n <= 1) {
+    assemble_range_u8(images, widths, channels, crop_h, crop_w, offsets_hw,
+                      flips, out, 0, n);
+    return;
+  }
+  if (n_threads > n) n_threads = n;
+  std::vector<std::thread> threads;
+  const int per = (n + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; t++) {
+    const int begin = t * per;
+    const int end = begin + per < n ? begin + per : n;
+    if (begin >= end) break;
+    threads.emplace_back(assemble_range_u8, images, widths, channels, crop_h,
+                         crop_w, offsets_hw, flips, out, begin, end);
+  }
+  for (auto& th : threads) th.join();
+}
 
 // images: n pointers to HWC uint8 buffers; out: (n, channels, crop_h,
 // crop_w) float32, caller-allocated.

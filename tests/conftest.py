@@ -68,10 +68,6 @@ def pytest_addoption(parser):
              "builds); deselected by default so `pytest -q` stays fast")
 
 
-def pytest_configure(config):
-    config.addinivalue_line("markers", "slow: long-running model builds")
-
-
 def pytest_collection_modifyitems(config, items):
     if config.getoption("--runslow"):
         return

@@ -77,6 +77,23 @@ class TestAssembleBatch:
         ref = self._ref(images, (32, 32), offsets, flips, mean, std)
         np.testing.assert_allclose(got, ref, rtol=1e-6)
 
+    def test_u8_variant_matches_float_path(self):
+        """assemble_batch_u8 (raw crop/flip/pack, native threads) must
+        equal the float path at mean 0 / std 1, cast back to uint8."""
+        from bigdl_tpu.dataset.mt_batch import assemble_batch_u8
+        rng = np.random.RandomState(3)
+        images = [rng.randint(0, 256, size=(40 + i % 3, 44 + i % 5, 3))
+                  .astype(np.uint8) for i in range(16)]
+        offsets = np.stack([rng.randint(0, 8, size=16),
+                            rng.randint(0, 8, size=16)], axis=1)
+        flips = rng.randint(0, 2, size=16).astype(np.uint8)
+        got = assemble_batch_u8(images, (32, 32), offsets, flips,
+                                n_threads=4)
+        ref = assemble_batch(images, (32, 32), offsets, flips,
+                             (0.0, 0.0, 0.0), (1.0, 1.0, 1.0), n_threads=1)
+        assert got.dtype == np.uint8
+        np.testing.assert_array_equal(got, ref.astype(np.uint8))
+
     def test_grey_single_channel(self):
         rng = np.random.RandomState(2)
         images = [rng.randint(0, 256, size=(28, 28)).astype(np.uint8)
@@ -157,6 +174,26 @@ class TestMTLabeledBGRImgToBatch:
             np.testing.assert_allclose(out, h.get_input(),
                                        rtol=1e-5, atol=1e-4)
             np.testing.assert_array_equal(h.get_target(), r.get_target())
+
+    def test_prefetch_chain_continues_caller_rng_stream(self):
+        """Random crops/flips drawn inside a Prefetch-wrapped chain must
+        continue the CALLER's seeded RandomGenerator stream (the producer
+        thread adopts it) — wrapping in Prefetch is a latency detail, not
+        a seeding change."""
+        from bigdl_tpu.dataset.mt_batch import (MTLabeledBGRImgToBatch,
+                                                Prefetch)
+        from bigdl_tpu.utils.random_generator import RandomGenerator
+
+        recs = self._jpeg_records(n=8)
+        RandomGenerator.RNG().set_seed(777)
+        direct = [b.get_input() for b in
+                  MTLabeledBGRImgToBatch(4, crop=(32, 32))(iter(recs))]
+        RandomGenerator.RNG().set_seed(777)
+        chained = [b.get_input() for b in Prefetch(2)(
+            MTLabeledBGRImgToBatch(4, crop=(32, 32))(iter(recs)))]
+        assert len(direct) == len(chained) == 2
+        for a, b in zip(direct, chained):
+            np.testing.assert_array_equal(a, b)
 
     def test_batches_and_shapes(self):
         from bigdl_tpu.dataset.mt_batch import MTLabeledBGRImgToBatch
