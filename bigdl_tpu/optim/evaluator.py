@@ -76,16 +76,30 @@ def evaluate_dataset(model: Module, dataset,
     runs data-parallel across devices (the reference evaluates inside the
     cluster, ``optim/Evaluator.scala:37-74``; here XLA's SPMD partitioner
     owns the split).  Batches not divisible by the axis size fall back to
-    single-device execution."""
+    single-device execution.
+
+    Distributed evaluation (the reference's ``DistriValidator.scala:35``):
+    a multi-host :class:`ShardedDataSet` holds only this process's
+    partitions, so each process evaluates its LOCAL records with a local
+    forward and the mergeable partial results are summed across processes
+    — every process returns the identical global metrics.  (The
+    mesh-sharded path must NOT be used there: it assumes every process
+    feeds the same global batch, which is false for per-process shards.)"""
+    from bigdl_tpu.dataset.dataset import ShardedDataSet
     was_training = model.train_mode
     model.evaluate()
+    distributed_partials = (isinstance(dataset, ShardedDataSet) and
+                            jax.process_count() > 1)
+    if distributed_partials:
+        mesh = None
     batch_sharding = None
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
         batch_sharding = NamedSharding(mesh, P("data"))
         axis_size = mesh.shape["data"]
     try:
-        fwd = _eval_forward(model, mesh)
+        fwd = _eval_forward(model, mesh,
+                            host_params=distributed_partials)
         # fallback for batches not divisible by the data axis: a LOCAL
         # forward (no mesh pinning).  The mesh-pinned fn cannot take a
         # process-local array — under multi-host its replicated
@@ -125,10 +139,33 @@ def evaluate_dataset(model: Module, dataset,
                 out = fwd_local(_to_device(batch.get_input()))
             pipeline.push(out, batch.get_target())
         pipeline.flush()
+        if distributed_partials:
+            totals = _merge_partials_across_processes(methods, totals)
         return [(m, t) for m, t in zip(methods, totals) if t is not None]
     finally:
         if was_training:
             model.training()
+
+
+def _merge_partials_across_processes(methods, totals):
+    """Sum per-process partial ValidationResults into the global metrics
+    (the reference's ``.reduce(metric +)`` across executors).  Collective:
+    every process must call with the same method list — the trainers'
+    config-symmetry guard enforces that for the validation trigger path."""
+    from jax.experimental import multihost_utils
+
+    local = np.asarray([[t.result, t.count] if t is not None else [0.0, 0.0]
+                        for t in totals], dtype=np.float64)
+    gathered = np.asarray(multihost_utils.process_allgather(local))
+    summed = gathered.sum(axis=0)
+    merged = []
+    for m, t, (r, c) in zip(methods, totals, summed):
+        if c == 0:
+            merged.append(None)
+            continue
+        proto = t if t is not None else ValidationResult(0.0, 0, m.name)
+        merged.append(ValidationResult(r, int(c), proto.name))
+    return merged
 
 
 class Evaluator:

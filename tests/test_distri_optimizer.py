@@ -110,6 +110,21 @@ class TestDistriOptimizer:
         w_distri = run(True)
         np.testing.assert_allclose(w_distri, w_local, rtol=2e-4, atol=2e-5)
 
+    def test_sharded_validation_matches_full_set(self):
+        """Evaluating a ShardedDataSet must produce exactly the full-set
+        metrics (single-process: all partitions local; the multi-host
+        partial-merge path is proven in test_multihost.py)."""
+        from bigdl_tpu.optim.evaluator import evaluate_dataset
+        samples = synthetic_separable(128, 4, n_classes=2, seed=3)
+        model = _mlp(4, 2)
+        model._ensure_init()
+        full = Evaluator(model).test(samples, [optim.Top1Accuracy()],
+                                     32)[0][1].final_result()
+        sharded = ShardedDataSet(samples, N_DEV).transform(
+            SampleToMiniBatch(32, N_DEV))
+        res = evaluate_dataset(model, sharded, [optim.Top1Accuracy()])
+        assert res[0][1].final_result() == full
+
     def test_unequal_local_minibatches_rejected(self):
         """_global_batch derives the global record count as per-partition
         size x partition_num; uneven local minibatches would silently

@@ -330,36 +330,59 @@ _VAL_WORKER = textwrap.dedent("""
     model.reset(jax.random.PRNGKey(11))
     opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion(), mesh=mesh)
     opt.set_optim_method(optim.SGD(learning_rate=0.2, momentum=0.9))
-    opt.set_end_when(optim.max_iteration(4))
-    # every process evaluates (the sharded forward is collective); only
-    # rank 0 may produce event files
-    opt.set_validation(optim.several_iteration(2), list(samples),
-                       [optim.Top1Accuracy()], batch_size=32)
+    # end at 3 iterations: several_iteration(2) fires at post-step counter
+    # 2 and 4, so the LAST validation sees the after-iteration-3 weights —
+    # which are also the final weights, making the full-set oracle exact
+    opt.set_end_when(optim.max_iteration(3))
+    # DISTRIBUTED validation: each process holds only its half of the
+    # validation partitions; partial metrics merge across processes
+    # (reference DistriValidator), so both ranks must report the same
+    # GLOBAL score.  Only rank 0 may produce event files.
+    val_ds = ShardedDataSet(list(samples), 8,
+                            local_partitions=local).transform(
+        SampleToMiniBatch(32, 8))
+    opt.set_validation(optim.several_iteration(2), val_ds,
+                       [optim.Top1Accuracy()])
     opt.set_train_summary(TrainSummary(logdir, "mh"))
     val_summary = ValidationSummary(logdir, "mh")
     opt.set_validation_summary(val_summary)
-    opt.optimize()
+    trained = opt.optimize()
+    # oracle: the full-set score of the FINAL weights, computed locally on
+    # this process (every process holds all records in `samples`) — the
+    # last validation fired at the final iteration, so the merged sharded
+    # score must equal this exactly
+    from bigdl_tpu.optim.evaluator import Evaluator
+    full = Evaluator(trained).test(list(samples), [optim.Top1Accuracy()],
+                                   32)[0][1].final_result()
     scores = val_summary.read_scalar("Top1Accuracy") if pid == 0 else []
     with open(os.path.join(outdir, f"val_score{pid}.txt"), "w") as f:
-        f.write(repr((opt.optim_method.state.get("score"), scores)))
+        f.write(repr((opt.optim_method.state.get("score"), full, scores)))
     print("VAL_WORKER_OK", pid)
 """)
 
 
 @pytest.mark.slow
 def test_two_process_validation_single_writer_summaries():
-    """2-process training with a validation trigger: both processes run the
-    sharded evaluation (identical scores), but only rank 0 emits TensorBoard
-    events — exactly one events file per summary dir (reference: summaries
-    are driver-side, ``optim/DistriOptimizer.scala:426-456``)."""
+    """2-process training with DISTRIBUTED validation: each rank evaluates
+    only its half of a sharded validation set, the partial metrics merge
+    across processes (reference ``DistriValidator``), and the merged score
+    equals a full-set evaluation of the final weights; only rank 0 emits
+    TensorBoard events — exactly one events file per summary dir
+    (reference: summaries are driver-side,
+    ``optim/DistriOptimizer.scala:426-456``)."""
     with tempfile.TemporaryDirectory() as outdir, \
             tempfile.TemporaryDirectory() as logdir:
         _run_pair(_VAL_WORKER, [outdir, logdir], "VAL_WORKER_OK")
         s0 = open(os.path.join(outdir, "val_score0.txt")).read()
         s1 = open(os.path.join(outdir, "val_score1.txt")).read()
-        score0, scalars = eval(s0)
-        score1, _ = eval(s1)
+        score0, full0, scalars = eval(s0)
+        score1, full1, _ = eval(s1)
+        # identical GLOBAL scores on both ranks (each only saw half the
+        # records locally — equality proves the cross-process merge)
         assert score0 is not None and score0 == score1, (s0, s1)
+        # ...and the merged score IS the full-set score of the final
+        # weights, not a local partial
+        assert score0 == full0 == full1, (score0, full0, full1)
         # the validation summary carries both trigger firings
         assert len(scalars) == 2 and all(v > 0 for _, v in scalars), scalars
         for sub in ("train", "validation"):
