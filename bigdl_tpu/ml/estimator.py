@@ -99,11 +99,16 @@ class DLModel:
         return self
 
     def _forward(self, X) -> np.ndarray:
+        import jax
         import jax.numpy as jnp
         from bigdl_tpu.optim.evaluator import _eval_forward
 
         self.model.evaluate()
-        fwd = _eval_forward(self.model)
+        # host-detached params under multi-host: the transform input is
+        # process-local, and a globally-placed replicated param tree
+        # cannot join it in one local computation
+        fwd = _eval_forward(self.model,
+                            host_params=jax.process_count() > 1)
         feats = np.stack([np.asarray(x, np.float32)
                           .reshape(self.feature_size) for x in X])
         outs = []

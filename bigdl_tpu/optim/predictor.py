@@ -37,11 +37,20 @@ class Predictor:
             yield from it
 
     def predict(self, dataset, batch_size: int = 32) -> np.ndarray:
-        """Per-sample model outputs (reference ``predict``)."""
+        """Per-sample model outputs (reference ``predict``).
+
+        Multi-host: a :class:`ShardedDataSet` holds only this process's
+        partitions, so each process predicts its LOCAL records and keeps
+        its local results — the reference's ``RDD[Sample] -> RDD[output]``
+        shape, where distributed predictions stay distributed.  Params are
+        host-detached for the local forward (a globally-placed replicated
+        tree cannot mix with process-local batches in one computation)."""
+        import jax
         was_training = self.model.train_mode
         self.model.evaluate()
         try:
-            fwd = _eval_forward(self.model)
+            fwd = _eval_forward(self.model,
+                                host_params=jax.process_count() > 1)
             # pipelined like evaluate_dataset: bounded in-flight batches
             # (unbounded dispatch would pin every output in device memory)
             outs: List[np.ndarray] = []

@@ -354,6 +354,11 @@ _VAL_WORKER = textwrap.dedent("""
     from bigdl_tpu.optim.evaluator import Evaluator
     full = Evaluator(trained).test(list(samples), [optim.Top1Accuracy()],
                                    32)[0][1].final_result()
+    # distributed prediction: each process predicts its LOCAL shard
+    # records and keeps its local results (the reference's RDD shape)
+    from bigdl_tpu.optim.predictor import Predictor
+    preds = Predictor(trained).predict(val_ds)
+    assert preds.shape == (64, 2), preds.shape
     scores = val_summary.read_scalar("Top1Accuracy") if pid == 0 else []
     with open(os.path.join(outdir, f"val_score{pid}.txt"), "w") as f:
         f.write(repr((opt.optim_method.state.get("score"), full, scores)))
