@@ -45,6 +45,30 @@ class Metrics:
                 return list(self._lists[name])
             raise KeyError(name)
 
+    def aggregated(self, name: str) -> float:
+        """Cross-process aggregate of a scalar counter — the reference's
+        *distributed* accumulator kind (``optim/Metrics.scala:31``: Spark
+        accumulators summed over executors).  Sums (value, parallelism)
+        over every process and returns the global mean; single-process
+        this equals :meth:`get`.  COLLECTIVE under multi-host: every
+        process must call it with the same name."""
+        import jax
+
+        with self._lock:
+            v, p = self._scalar.get(name, (0.0, 0))
+        if jax.process_count() <= 1:
+            if p == 0:
+                raise KeyError(name)
+            return v / p
+        import numpy as np
+        from jax.experimental import multihost_utils
+        gathered = np.asarray(multihost_utils.process_allgather(
+            np.asarray([v, float(p)], np.float64)))
+        total_v, total_p = gathered.sum(axis=0)
+        if total_p == 0:
+            raise KeyError(name)
+        return float(total_v / total_p)
+
     def summary(self, unit: str = "s", scale: float = 1e9) -> str:
         with self._lock:
             parts = [f"{k}: {v / p / scale} {unit}"

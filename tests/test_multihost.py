@@ -219,6 +219,12 @@ _CKPT_WORKER = textwrap.dedent("""
     opt.set_end_when(optim.max_iteration(4 if phase == "train" else 8))
     opt.set_checkpoint(ckptdir, optim.several_iteration(2))
     trained = opt.optimize()
+    # the distributed-accumulator metric kind: both ranks must agree on
+    # the cross-process aggregate even though their local timings differ
+    agg = opt.metrics.aggregated("computing time for each node")
+    assert agg > 0
+    with open(os.path.join(outdir, f"ck_{phase}_agg{pid}.txt"), "w") as f:
+        f.write(repr(agg))
     w, _ = trained.get_parameters()
     np.save(os.path.join(outdir, f"ck_{phase}_w{pid}.npy"), np.asarray(w))
     with open(os.path.join(outdir, f"ck_{phase}_saves{pid}.txt"), "w") as f:
@@ -265,6 +271,10 @@ def test_two_process_checkpoint_kill_resume():
         saves1 = open(os.path.join(outdir, "ck_train_saves1.txt")).read()
         assert saves0.count("model.") == 2 and "optimMethod.3" in saves0
         assert saves1.strip() == "", f"rank 1 wrote: {saves1!r}"
+        # distributed accumulator: identical global aggregate on both ranks
+        agg0 = eval(open(os.path.join(outdir, "ck_train_agg0.txt")).read())
+        agg1 = eval(open(os.path.join(outdir, "ck_train_agg1.txt")).read())
+        assert agg0 == agg1 > 0, (agg0, agg1)
 
         _run_pair(_CKPT_WORKER, [outdir, ckptdir, "resume"],
                   "CKPT_WORKER_OK")

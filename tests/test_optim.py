@@ -269,3 +269,27 @@ class TestLocalOptimizerE2E:
                                      nn.ClassNLLCriterion(), batch_size=16)
         opt.set_end_when(optim.max_iteration(5))
         opt.optimize()          # runs without error
+
+
+class TestMetrics:
+    def test_scalar_list_and_aggregate(self):
+        """set/add/get surface (reference optim/Metrics.scala:31) and the
+        distributed-accumulator kind: single-process, aggregated() equals
+        the local mean (the multi-host sum is proven in
+        tests/test_multihost.py's checkpoint leg)."""
+        from bigdl_tpu.optim.metrics import Metrics
+        import pytest
+
+        m = Metrics()
+        m.set("phase", 10.0, parallelism=2)
+        m.add("phase", 6.0)
+        assert m.get("phase") == 8.0           # (10 + 6) / 2
+        assert m.aggregated("phase") == 8.0
+        m.set("per-node", [1.0, 2.0])
+        m.add("per-node", 3.0)
+        assert m.get("per-node") == [1.0, 2.0, 3.0]
+        with pytest.raises(KeyError):
+            m.get("absent")
+        with pytest.raises(KeyError):
+            m.aggregated("absent")
+        assert "phase" in m.summary()
