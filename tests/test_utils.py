@@ -180,3 +180,30 @@ class TestDispatchPipeline:
         p.flush()
         assert [d[0] for d in drained] == list("abcde")
         assert drained[-1] == ("e", None)
+
+
+def test_batch_prefetcher_blocks_only_large_batches():
+    """The ready-before-handoff guard is SIZE-GATED: bulk batches are
+    blocked device-resident (dispatching against an in-flight bulk
+    transfer costs ~10x step latency on the tunneled backend), while
+    small batches stay async — blocking them costs a full round-trip per
+    iteration, a measured ~20x small-model driver regression."""
+    from bigdl_tpu.engine import BatchPrefetcher
+
+    calls = []
+
+    class FakeLeaf:
+        def __init__(self, nbytes):
+            self.nbytes = nbytes
+
+        def block_until_ready(self):
+            calls.append(self.nbytes)
+
+    small = (FakeLeaf(1024), FakeLeaf(2048), 64)
+    big = (FakeLeaf(8 << 20), FakeLeaf(1024), 64)
+    batches = iter([small, big])
+    pf = BatchPrefetcher(lambda: next(batches), depth=0)
+    pf()
+    assert calls == [], "small batch must not be blocked"
+    pf()
+    assert sorted(calls) == [1024, 8 << 20], "large batch blocks all leaves"
