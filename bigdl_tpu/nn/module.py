@@ -486,6 +486,16 @@ class Container(Module):
 
     def add(self, module: Module) -> "Container":
         self.children.append(module)
+        if self._params is not None:
+            # adding to an ALREADY-INITIALIZED container (Torch allows
+            # add() at any time): bring the new child's params in now — a
+            # params list shorter than children would IndexError at the
+            # next apply
+            module._ensure_init()
+            self._params.append(module._params)
+            self._state.append(module._state)
+            if self._grads is not None:
+                self._grads.append(module._grads)
         self._jit_apply = None
         self.__dict__.pop("_eval_jit", None)
         return self

@@ -173,3 +173,23 @@ class TestGraph:
         g = nn.Graph(inp, [a, b])
         out = g.forward(rand(2, 4))
         assert out[0].shape == (2, 3) and out[1].shape == (2, 5)
+
+
+def test_add_after_init_extends_params():
+    """Torch allows Container.add at any time; adding to an
+    already-initialized Sequential must extend the params/state lists
+    (a stale shorter list IndexErrors at the next apply — hit by the
+    model-zoo pattern `model_init(resnet(...)).add(LogSoftMax())`)."""
+    import jax
+    m = nn.Sequential().add(nn.Linear(4, 8)).add(nn.ReLU())
+    m.reset(jax.random.PRNGKey(0))
+    x = np.random.RandomState(0).normal(size=(2, 4)).astype(np.float32)
+    mid = np.asarray(m.forward(x))
+    m.add(nn.Linear(8, 3)).add(nn.LogSoftMax())
+    out = np.asarray(m.forward(x))
+    assert out.shape == (2, 3)
+    np.testing.assert_allclose(np.exp(out).sum(-1), 1.0, rtol=1e-5)
+    # the earlier children kept their initialized weights
+    np.testing.assert_allclose(np.asarray(m.forward(x)), out, rtol=1e-6)
+    assert len(m.params) == 4 and len(m.state) == 4
+    del mid
