@@ -110,6 +110,27 @@ class TestDistriOptimizer:
         w_distri = run(True)
         np.testing.assert_allclose(w_distri, w_local, rtol=2e-4, atol=2e-5)
 
+    def test_mesh_eval_indivisible_batch_fallback(self):
+        """A batch not divisible by the data axis falls back to the LOCAL
+        forward; metrics must match the no-mesh evaluation exactly (100
+        samples at batch 32 leaves a final batch of 4 on an 8-axis)."""
+        from bigdl_tpu.dataset.transformer import SampleToMiniBatch as S2M
+        from bigdl_tpu.engine import Engine
+        from bigdl_tpu.optim.evaluator import evaluate_dataset
+        samples = synthetic_separable(128, 4, n_classes=2, seed=3)[:100]
+        model = _mlp(4, 2)
+        model._ensure_init()
+        batches = list(S2M(32)(iter(samples)))
+        assert [b.size() for b in batches] == [32, 32, 32, 4]
+        plain = evaluate_dataset(model, list(batches),
+                                 [optim.Top1Accuracy()])
+        meshed = evaluate_dataset(model, list(batches),
+                                  [optim.Top1Accuracy()],
+                                  mesh=Engine.create_mesh())
+        assert (meshed[0][1].final_result() ==
+                plain[0][1].final_result())
+        assert meshed[0][1].count == 100
+
     def test_sharded_validation_matches_full_set(self):
         """Evaluating a ShardedDataSet must produce exactly the full-set
         metrics (single-process: all partitions local; the multi-host
