@@ -283,6 +283,12 @@ class TensorflowLoader:
     def _op_softmax(self, node):
         return self._unary(node, nn.SoftMax())
 
+    def _op_logsoftmax(self, node):
+        # beyond the reference registry (TensorflowToBigDL has Softmax
+        # only): the import->train journey for classifier graphs ends in
+        # tf.nn.log_softmax, and ClassNLLCriterion consumes log-probs
+        return self._unary(node, nn.LogSoftMax())
+
     def _op_squeeze(self, node):
         dims = [int(d) for d in node.attr["squeeze_dims"].list.i]
         if dims:

@@ -526,6 +526,45 @@ class TestTensorflowPatternParity:
         np.testing.assert_allclose(np.asarray(back.evaluate().forward(x)),
                                    ours, rtol=1e-4, atol=1e-4)
 
+    def test_log_softmax_parity_and_import_train(self):
+        """tf.nn.log_softmax imports (beyond the reference registry) and
+        the imported classifier TRAINS through the public Optimizer —
+        the import->fine-tune journey, not just a forward check."""
+        def build(tf):
+            x = tf.compat.v1.placeholder(tf.float32, [None, 6],
+                                         name="input")
+            w = tf.constant(np.random.RandomState(8)
+                            .normal(size=(6, 3)).astype(np.float32))
+            tf.nn.log_softmax(tf.matmul(x, w), name="output")
+        x = np.random.RandomState(7).normal(size=(4, 6)).astype(np.float32)
+        self._golden(build, x)
+
+        import bigdl_tpu.optim as optim
+        from bigdl_tpu.dataset import LocalDataSet, SampleToMiniBatch
+        from bigdl_tpu.dataset.datasets import synthetic_separable
+        from bigdl_tpu.utils.tf import TensorflowLoader
+        g = tf.Graph()
+        with g.as_default():
+            # frozen-graph form (Const weights), like the reference's
+            # loader expects; the imported Linear is trainable HERE
+            xx = tf.compat.v1.placeholder(tf.float32, [None, 4],
+                                          name="input")
+            w = tf.constant(np.random.RandomState(4)
+                            .normal(size=(4, 2)).astype(np.float32))
+            b = tf.constant(np.zeros(2, np.float32))
+            tf.nn.log_softmax(tf.matmul(xx, w) + b, name="output")
+        model = TensorflowLoader.load(g.as_graph_def(), ["input"],
+                                      ["output"])
+        samples = synthetic_separable(64, 4, n_classes=2, seed=5)
+        ds = LocalDataSet(samples).transform(SampleToMiniBatch(32))
+        o = optim.Optimizer.create(model, ds, nn.ClassNLLCriterion())
+        o.set_optim_method(optim.SGD(learning_rate=0.5))
+        o.set_end_when(optim.max_epoch(4))
+        o.optimize()
+        acc = optim.Evaluator(model).test(
+            samples, [optim.Top1Accuracy()], 32)[0][1].final_result()
+        assert acc > 0.8, acc
+
     def test_lrn_explicit_zero_attr_parity(self):
         """depth_radius=0 is a legal (degenerate) LRN — each channel
         normalized by itself alone.  The importer must read the explicit 0,
