@@ -239,8 +239,15 @@ class HFlip(Transformer):
 # ---------------------------------------------------------------------------
 
 class ChannelNormalize(Transformer):
-    """Per-channel (x - mean) / std (reference ``BGRImgNormalizer``).
-    Means/stds are in the image's channel order (BGR for BGR images)."""
+    """Per-channel (x - mean) / std ON THE HOST (reference
+    ``BGRImgNormalizer``).  Means/stds are in the image's channel order
+    (BGR for BGR images).
+
+    Namespace note: ``bigdl_tpu.nn.ChannelNormalize`` is the DEVICE-side
+    sibling (a Module placed first in the model) — pair it with the
+    uint8 ingest layout (``MTLabeledBGRImgToBatch(device_normalize=
+    True)``) to ship 4x fewer bytes over the host→device link instead
+    of normalizing here."""
 
     def __init__(self, means: Sequence[float], stds: Sequence[float]):
         self.means = np.asarray(means, dtype=np.float32)
