@@ -126,8 +126,10 @@ class ShardedDataSet(AbstractDataSet):
                 f"local_partitions {self.local_partitions} must be a "
                 f"non-empty subset of range({partition_num})")
         # round-robin assignment keeps shard sizes within 1 of each other,
-        # then truncate to equal size (static shapes for XLA)
+        # then truncate to equal size (static shapes for XLA); the
+        # remainder count is recorded so evaluation paths can surface it
         self._per = n // partition_num
+        self.dropped_records = n - self._per * partition_num
         self._shuffle_round = [0]      # shared across transform() views
         self.shards: dict = {}
         for p in self.local_partitions:
@@ -158,6 +160,7 @@ class ShardedDataSet(AbstractDataSet):
         ds.partition_num = self.partition_num
         ds.local_partitions = self.local_partitions
         ds._per = self._per
+        ds.dropped_records = self.dropped_records
         ds._shuffle_round = self._shuffle_round
         ds.shards = {p: s.transform(transformer)
                      for p, s in self.shards.items()}

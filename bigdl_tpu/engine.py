@@ -324,6 +324,21 @@ class Engine:
             Engine.init()
 
 
+def allgather_sum(rows) -> np.ndarray:
+    """Sum a small per-process float array across every process (host
+    collective; identity single-process).
+
+    The multi-host reduction shared by the distributed metric kinds —
+    validation partials (``optim.evaluator``) and aggregated counters
+    (``optim.metrics``).  COLLECTIVE: under multi-host every process must
+    call it with an array of the same shape."""
+    rows = np.asarray(rows, np.float64)
+    if jax.process_count() <= 1:
+        return rows
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(rows)).sum(axis=0)
+
+
 def to_device(x):
     """Recursively move a nested list/tuple/dict of arrays onto the device
     (the single host→device crossing point of the data pipeline)."""

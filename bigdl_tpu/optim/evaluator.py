@@ -88,6 +88,17 @@ def evaluate_dataset(model: Module, dataset,
     from bigdl_tpu.dataset.dataset import ShardedDataSet
     was_training = model.train_mode
     model.evaluate()
+    if (isinstance(dataset, ShardedDataSet) and
+            getattr(dataset, "dropped_records", 0)):
+        # equal-size sharding (static shapes for XLA) truncated the tail;
+        # fine for training epochs, but an EVALUATION silently scoring
+        # fewer records than the user handed in deserves a warning —
+        # a record count divisible by partition_num evaluates everything
+        import logging
+        logging.getLogger("bigdl_tpu").warning(
+            "evaluating a ShardedDataSet that dropped %d tail record(s) "
+            "to equalize %d partitions — metrics cover %d records",
+            dataset.dropped_records, dataset.partition_num, dataset.size())
     distributed_partials = (isinstance(dataset, ShardedDataSet) and
                             jax.process_count() > 1)
     if distributed_partials:
@@ -98,16 +109,14 @@ def evaluate_dataset(model: Module, dataset,
         batch_sharding = NamedSharding(mesh, P("data"))
         axis_size = mesh.shape["data"]
     try:
-        fwd = _eval_forward(model, mesh,
-                            host_params=distributed_partials)
-        # fallback for batches not divisible by the data axis: a LOCAL
-        # forward (no mesh pinning).  The mesh-pinned fn cannot take a
-        # process-local array — under multi-host its replicated
-        # out_shardings span devices this process cannot feed — while the
-        # local fn runs the whole batch on this process's devices with
-        # host-detached params; every process holds the full batch, so
-        # scores stay identical everywhere.  Built lazily: divisible-only
-        # datasets never pay the params fetch.
+        # LOCAL forward (no mesh pinning), built lazily: serves the
+        # whole-batch path when no mesh is given (incl. the multi-host
+        # partials branch, where params detach to host — a globally-placed
+        # replicated tree cannot mix with process-local batches) AND the
+        # fallback for batches not divisible by the data axis, where every
+        # process holds the full batch so scores stay identical.  Lazy so
+        # mesh runs with divisible-only batches never pay the params
+        # fetch; built at most ONCE per call.
         _fallback = {}
 
         def fwd_local(x):
@@ -115,6 +124,11 @@ def evaluate_dataset(model: Module, dataset,
                 _fallback["fn"] = _eval_forward(
                     model, host_params=jax.process_count() > 1)
             return _fallback["fn"](x)
+
+        # the mesh-pinned forward exists only when a mesh path can run —
+        # building it otherwise would eagerly fetch params for nothing
+        fwd = (_eval_forward(model, mesh) if mesh is not None
+               else fwd_local)
         totals: List[ValidationResult] = [None] * len(methods)
         it = dataset.data(train=False) if isinstance(
             dataset, AbstractDataSet) else iter(dataset)
@@ -152,12 +166,11 @@ def _merge_partials_across_processes(methods, totals):
     (the reference's ``.reduce(metric +)`` across executors).  Collective:
     every process must call with the same method list — the trainers'
     config-symmetry guard enforces that for the validation trigger path."""
-    from jax.experimental import multihost_utils
+    from bigdl_tpu.engine import allgather_sum
 
-    local = np.asarray([[t.result, t.count] if t is not None else [0.0, 0.0]
-                        for t in totals], dtype=np.float64)
-    gathered = np.asarray(multihost_utils.process_allgather(local))
-    summed = gathered.sum(axis=0)
+    local = [[t.result, t.count] if t is not None else [0.0, 0.0]
+             for t in totals]
+    summed = allgather_sum(local)
     merged = []
     for m, t, (r, c) in zip(methods, totals, summed):
         if c == 0:

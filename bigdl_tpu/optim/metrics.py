@@ -52,19 +52,11 @@ class Metrics:
         over every process and returns the global mean; single-process
         this equals :meth:`get`.  COLLECTIVE under multi-host: every
         process must call it with the same name."""
-        import jax
+        from bigdl_tpu.engine import allgather_sum
 
         with self._lock:
             v, p = self._scalar.get(name, (0.0, 0))
-        if jax.process_count() <= 1:
-            if p == 0:
-                raise KeyError(name)
-            return v / p
-        import numpy as np
-        from jax.experimental import multihost_utils
-        gathered = np.asarray(multihost_utils.process_allgather(
-            np.asarray([v, float(p)], np.float64)))
-        total_v, total_p = gathered.sum(axis=0)
+        total_v, total_p = allgather_sum([v, float(p)])
         if total_p == 0:
             raise KeyError(name)
         return float(total_v / total_p)

@@ -189,7 +189,10 @@ def test_add_after_init_extends_params():
     out = np.asarray(m.forward(x))
     assert out.shape == (2, 3)
     np.testing.assert_allclose(np.exp(out).sum(-1), 1.0, rtol=1e-5)
-    # the earlier children kept their initialized weights
-    np.testing.assert_allclose(np.asarray(m.forward(x)), out, rtol=1e-6)
     assert len(m.params) == 4 and len(m.state) == 4
-    del mid
+    # the earlier children kept their pre-add weights: pushing the
+    # pre-add activations through ONLY the new children reproduces the
+    # full forward exactly
+    want = np.asarray(m.children[3].forward(
+        np.asarray(m.children[2].forward(mid))))
+    np.testing.assert_allclose(out, want, rtol=1e-6)
