@@ -70,6 +70,10 @@ def main(argv=None):
     p.add_argument("-i", "--iterations", type=int, default=20)
     p.add_argument("--partitions", type=int, default=1,
                    help=">1: DistriOptimizer over the device mesh")
+    p.add_argument("--precision", choices=["fp32", "bf16"], default="fp32",
+                   help="fused-step compute precision (fp32 matches the "
+                        "reference harness; bf16 is the TPU-first mode "
+                        "the headline bench uses)")
     args = p.parse_args(argv)
     driver_utils.init_logging()
 
@@ -95,6 +99,8 @@ def main(argv=None):
 
     opt = optim.Optimizer.create(model, ds, criterion)
     opt.set_optim_method(optim.SGD(learning_rate=0.01, momentum=0.9))
+    if args.precision == "bf16":
+        opt.set_precision("bf16")
     # warm-up run absorbs the jit compile; the timed run is steady-state
     # (the reference harness likewise reports per-iteration throughput,
     # DistriOptimizerPerf.scala:130-140)
