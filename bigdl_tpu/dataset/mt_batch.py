@@ -26,6 +26,21 @@ from bigdl_tpu.dataset.native import load_native
 from bigdl_tpu.dataset.transformer import Transformer
 
 
+def _check_crop_fits(images: Sequence[np.ndarray],
+                     crop: Tuple[int, int]) -> None:
+    """Every image must be at least crop-sized: the native assembler
+    (``native/batch.cc``) does no bounds checks, so an undersized image
+    would turn into a negative offset and an out-of-bounds read."""
+    ch, cw = crop
+    for i, im in enumerate(images):
+        h, w = im.shape[:2]
+        if h < ch or w < cw:
+            raise ValueError(
+                f"assemble_batch: image {i} is {h}x{w}, smaller than the "
+                f"{ch}x{cw} crop; resize images to at least the crop size "
+                "before assembly")
+
+
 def assemble_batch(images: Sequence[np.ndarray],
                    crop: Tuple[int, int],
                    offsets: np.ndarray,
@@ -33,9 +48,11 @@ def assemble_batch(images: Sequence[np.ndarray],
                    mean: Sequence[float],
                    std: Sequence[float],
                    n_threads: int = 4) -> np.ndarray:
-    """images: HWC uint8 arrays (any sizes >= crop); offsets: (N, 2) int32
-    (y, x) crop origins; flips: (N,) uint8.  Returns (N, C, crop_h, crop_w)
-    float32: out = (crop(img) - mean) / std, optionally h-flipped."""
+    """images: HWC uint8 arrays (any sizes >= crop, enforced); offsets:
+    (N, 2) int32 (y, x) crop origins; flips: (N,) uint8.  Returns
+    (N, C, crop_h, crop_w) float32: out = (crop(img) - mean) / std,
+    optionally h-flipped."""
+    _check_crop_fits(images, crop)
     n = len(images)
     ch, cw = crop
     channels = images[0].shape[2] if images[0].ndim == 3 else 1
@@ -85,6 +102,7 @@ def assemble_batch_u8(images: Sequence[np.ndarray],
     pack WITHOUT normalization — the device-normalize ingest layout (pair
     with ``nn.ChannelNormalize`` on device).  Native std::thread path when
     built; numpy fallback."""
+    _check_crop_fits(images, crop)
     n = len(images)
     ch, cw = crop
     channels = images[0].shape[2] if images[0].ndim == 3 else 1
@@ -200,6 +218,17 @@ class MTLabeledBGRImgToBatch(Transformer):
                 flips = np.zeros((n,), np.uint8)
                 for i, im in enumerate(images):
                     h, w = im.shape[:2]
+                    if h < ch or w < cw:
+                        # the native assembler (native/batch.cc) does no
+                        # bounds checks — a negative offset would read out
+                        # of bounds; fail loudly naming the record instead
+                        raise ValueError(
+                            f"MTLabeledBGRImgToBatch: record {i} of the "
+                            f"current batch (label {recs[i].label}) decoded "
+                            f"to {h}x{w}, smaller than the {ch}x{cw} crop; "
+                            "resize records to at least the crop size "
+                            "upstream (reference pipelines feed "
+                            "pre-resized 256x256 records)")
                     if self.random_crop:
                         offsets[i] = (rng.random_int(0, h - ch + 1),
                                       rng.random_int(0, w - cw + 1))
@@ -237,7 +266,14 @@ class Prefetch(Transformer):
         # offsets) executes on the producer thread: it must continue the
         # CONSUMING thread's RandomGenerator stream, same contract as
         # Engine.BatchPrefetcher, or a user's set_seed silently stops
-        # governing augmentation whenever Prefetch is in the chain
+        # governing augmentation whenever Prefetch is in the chain.
+        # SINGLE-DRAWER CONTRACT: the RandomState is handed off, not
+        # shared — for the lifetime of this iterator the producer is the
+        # stream's only drawer.  A consumer that keeps drawing host RNG
+        # concurrently (a second pipeline on the same thread-local) gets
+        # nondeterministic interleaving; run such pipelines on distinct
+        # threads (each thread-local RNG is per-thread) or seed a separate
+        # RandomGenerator instance for them.
         rng = RandomGenerator.RNG()
 
         def put(item) -> bool:

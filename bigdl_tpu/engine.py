@@ -336,7 +336,17 @@ def allgather_sum(rows) -> np.ndarray:
     if jax.process_count() <= 1:
         return rows
     from jax.experimental import multihost_utils
-    return np.asarray(multihost_utils.process_allgather(rows)).sum(axis=0)
+    # process_allgather silently downcasts float64 wires to float32 when
+    # jax_enable_x64 is off (the default), which loses integer exactness
+    # above 2^24 — e.g. record counts or summed losses on very large
+    # validation sets.  Ship each value as a float32 (hi, lo) pair —
+    # hi = f32(x), lo = f32(x - hi) — and recombine in float64 after the
+    # gather: exact for counts up to ~2^48.
+    hi = rows.astype(np.float32)
+    lo = (rows - hi.astype(np.float64)).astype(np.float32)
+    gathered = np.asarray(
+        multihost_utils.process_allgather(np.stack([hi, lo])), np.float64)
+    return gathered.sum(axis=(0, 1))
 
 
 def to_device(x):

@@ -132,16 +132,29 @@ class Checkpoint:
         self.trigger = trigger
         self.overwrite = isOverwrite
 
+    #: seconds a ``.tmp_bigdl`` temp must sit untouched before the sweep
+    #: may reclaim it.  An atomic save holds its temp open for seconds at
+    #: most; an hour-old temp is an orphan from a hard-killed writer, not
+    #: another live job's in-flight write (two jobs pointed at one dir, a
+    #: stalled-but-alive writer) — sweeping those would break THEIR rename.
+    TEMP_SWEEP_AGE_S = 3600.0
+
     def save(self, model: Module, optim: OptimMethod, neval: int) -> None:
+        import time
         from bigdl_tpu.utils import file_io
         file_io.makedirs(self.path)
-        # sweep temps orphaned by a hard-killed earlier writer (their names
-        # are unique per pid, so nothing reclaims them on rewrite; with the
-        # single-writer discipline no live writer's temp can be swept here)
+        # sweep temps orphaned by a hard-killed earlier writer, age-gated:
+        # a recent temp (or one whose store reports no mtime) may be a
+        # concurrent writer's in-flight atomic write and is left alone
+        now = time.time()
         for f in file_io.listdir(self.path):
             if ".tmp_bigdl" in f:
+                full = file_io.join(self.path, f)
+                mtime = file_io.modified_time(full)
+                if mtime is None or now - mtime < self.TEMP_SWEEP_AGE_S:
+                    continue
                 try:
-                    file_io.remove(file_io.join(self.path, f))
+                    file_io.remove(full)
                 except Exception:
                     pass
         file_io.save(model, file_io.join(self.path, f"model.{neval}"),

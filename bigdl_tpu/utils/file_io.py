@@ -130,6 +130,23 @@ def save(obj: Any, path: str, overwrite: bool = True) -> None:
         raise
 
 
+def modified_time(path: str):
+    """Last-modified POSIX timestamp of a local or remote object, or
+    ``None`` when the backing filesystem cannot report one.  Used to
+    age-gate sweeps of orphaned atomic-write temps: a temp younger than
+    the gate may belong to a live writer elsewhere."""
+    try:
+        if _is_remote(path):
+            fs, p = _fs(path)
+            mt = fs.modified(p)
+            return mt.timestamp()
+        if path.startswith("file://"):
+            path = path[len("file://"):]
+        return os.path.getmtime(path)
+    except Exception:
+        return None
+
+
 def remove(path: str) -> None:
     """Delete a local or remote object; silently absent-tolerant (used to
     sweep orphaned atomic-write temps left by hard-killed writers)."""

@@ -45,7 +45,14 @@ class RandomGenerator:
         reshuffles must continue the SAME RandomState the user seeded via
         ``set_seed`` on the main thread, not a fresh default-seeded
         thread-local — otherwise reproducibility silently depends on which
-        thread performs the rollover (prefetch depth 0 vs >0)."""
+        thread performs the rollover (prefetch depth 0 vs >0).
+
+        Adoption is a HANDOFF, not a share: after it, the worker thread is
+        the stream's single drawer for the prefetcher's lifetime.  The
+        underlying numpy RandomState is not thread-safe, so the handing-off
+        thread must not keep drawing from the same instance concurrently —
+        use a separate seeded ``RandomGenerator`` (or another thread, whose
+        thread-local is distinct) for any concurrent host randomness."""
         cls._tls.inst = inst
 
     def set_seed(self, seed: int) -> "RandomGenerator":

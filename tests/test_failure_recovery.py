@@ -7,6 +7,8 @@ schedule).  Injection here is host-side (a transformer that fails once at a
 given batch) because under jit the module Python only runs at trace time.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -214,6 +216,53 @@ class TestRemoteCheckpointIntegration:
             file_io.save({"v": 2}, "memory://bigdl_it/obj",
                          overwrite=False)
         assert file_io.load("memory://bigdl_it/obj")["v"] == 1
+
+    def test_temp_sweep_is_age_gated(self, tmp_path):
+        """Checkpoint.save must not reclaim a RECENT foreign temp (it may
+        be another live writer's in-flight atomic write); an hour-old
+        orphan from a hard-killed writer IS swept."""
+        import time
+        from bigdl_tpu.optim.optimizer import Checkpoint
+        ckpt = Checkpoint(str(tmp_path), optim.every_epoch())
+        fresh = tmp_path / "model.9.tmp_bigdl.4242.deadbeef"
+        stale = tmp_path / "model.8.tmp_bigdl.4243.cafebabe"
+        fresh.write_bytes(b"live writer in flight")
+        stale.write_bytes(b"orphan")
+        old = time.time() - Checkpoint.TEMP_SWEEP_AGE_S - 60
+        os.utime(stale, (old, old))
+        ckpt.save(_mlp(4, 2), optim.SGD(learning_rate=0.1), 1)
+        assert fresh.exists(), "recent foreign temp was swept"
+        assert not stale.exists(), "hour-old orphan survived the sweep"
+        # and neither ever pollutes latest()
+        _, _, n = ckpt.latest()
+        assert n == 1
+
+    def test_remote_temp_sweep_is_age_gated(self):
+        """The age gate must work through the fsspec modified() branch of
+        file_io.modified_time, not just local getmtime: a backdated
+        memory:// orphan is swept, a fresh one survives."""
+        import datetime
+        import fsspec
+        from bigdl_tpu.optim.optimizer import Checkpoint
+        self._clean()
+        root = "memory://bigdl_it/sweep"
+        ckpt = Checkpoint(root, optim.every_epoch())
+        fs = fsspec.filesystem("memory")
+        fs.makedirs("/bigdl_it/sweep", exist_ok=True)
+        for name in ("model.9.tmp_bigdl.77.aa", "model.8.tmp_bigdl.78.bb"):
+            with fs.open(f"/bigdl_it/sweep/{name}", "wb") as f:
+                f.write(b"x")
+        fs.store["/bigdl_it/sweep/model.8.tmp_bigdl.78.bb"].modified = (
+            datetime.datetime.now(datetime.timezone.utc)
+            - datetime.timedelta(seconds=Checkpoint.TEMP_SWEEP_AGE_S + 60))
+        assert file_io.modified_time(
+            root + "/model.8.tmp_bigdl.78.bb") is not None
+        ckpt.save(_mlp(4, 2), optim.SGD(learning_rate=0.1), 1)
+        names = file_io.listdir(root)
+        assert "model.9.tmp_bigdl.77.aa" in names, names
+        assert "model.8.tmp_bigdl.78.bb" not in names, names
+        _, _, n = ckpt.latest()
+        assert n == 1
 
     def test_partial_remote_write_never_selected_as_latest(self):
         """Atomic remote saves: a crashed in-flight temp must neither be

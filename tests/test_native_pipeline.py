@@ -195,6 +195,23 @@ class TestMTLabeledBGRImgToBatch:
         for a, b in zip(direct, chained):
             np.testing.assert_array_equal(a, b)
 
+    def test_undersized_image_raises_named_error(self):
+        """An image smaller than the crop must fail loudly naming the
+        record BEFORE offsets reach the native assembler (which does no
+        bounds checks — a negative offset would read out of bounds)."""
+        import pytest
+        from bigdl_tpu.dataset.mt_batch import MTLabeledBGRImgToBatch
+
+        recs = self._jpeg_records(n=4, hw=(40, 48))
+        recs[2:3] = self._jpeg_records(n=1, hw=(20, 48))   # too short
+        recs[2].label = 9.0
+        for random_crop in (False, True):
+            mt = MTLabeledBGRImgToBatch(4, crop=(32, 32),
+                                        random_crop=random_crop,
+                                        n_threads=2)
+            with pytest.raises(ValueError, match=r"record 2 .*20x48.*32x32"):
+                list(mt(iter(recs)))
+
     def test_batches_and_shapes(self):
         from bigdl_tpu.dataset.mt_batch import MTLabeledBGRImgToBatch
         recs = self._jpeg_records(n=10)

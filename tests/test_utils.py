@@ -182,6 +182,32 @@ class TestDispatchPipeline:
         assert drained[-1] == ("e", None)
 
 
+def test_allgather_sum_exact_above_f32_integer_range(monkeypatch):
+    """allgather_sum must keep integer exactness past 2^24 even though
+    process_allgather downcasts float64 wires to float32 (jax_enable_x64
+    off): values ride as float32 (hi, lo) pairs recombined in float64."""
+    import jax
+
+    from bigdl_tpu import engine as eng
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+
+    def fake_allgather(x):
+        # emulate the real wire: per-process float32 payloads, stacked
+        assert x.dtype == np.float32, "wire must already be float32-safe"
+        return np.stack([x, x])
+
+    fake_mod = type("M", (), {"process_allgather": staticmethod(
+        fake_allgather)})
+    import jax.experimental
+    monkeypatch.setattr(jax.experimental, "multihost_utils", fake_mod,
+                        raising=False)
+
+    big = float(2 ** 25 + 1)            # not representable in float32
+    out = eng.allgather_sum(np.array([big, 3.0]))
+    np.testing.assert_array_equal(out, [2.0 * big, 6.0])
+
+
 def test_batch_prefetcher_blocks_only_large_batches():
     """The ready-before-handoff guard is SIZE-GATED: bulk batches are
     blocked device-resident (dispatching against an in-flight bulk
