@@ -481,29 +481,48 @@ def main():
     # end; consumers parse the LAST JSON line.
     print(json.dumps(result), flush=True)
 
-    # LM flagship legs: two REALISTIC shapes through the same fused step.
+    # LM flagship legs: four REALISTIC shapes through the same fused step.
     # - base: 134M params, d1024/L8/T2048/B8 (head_dim 128) — r3's point,
     #   ~107k tokens/s = ~55% MFU on one v5e.
     # - large: 537M params, d2048/L8/vocab 32k/T2048/B4 — the >= 0.5B
-    #   point; B8 and L12/L16 exceed 16 GB HBM (measured r4: momentum
-    #   slots + fp32 masters + B*T*d activation residuals), B4 runs at
-    #   ~65% MFU, so the chip — not the framework — sets the size wall.
+    #   point; without remat, B8 and L12/L16 exceed 16 GB HBM (measured
+    #   r4: momentum slots + fp32 masters + B*T*d activation residuals).
+    # - large_b8_remat: the SAME 537M at B8 with per-block activation
+    #   checkpointing ("dots" policy: matmul outputs saved, attention +
+    #   elementwise recomputed) — fits where non-remat OOMs.  Measured
+    #   r5: 33.4k tok/s, 61.5% useful-FLOPs MFU; the ~7% drop vs B4
+    #   non-remat (66%) IS the attention recompute (~12LdT extra
+    #   FLOPs/token ~= +11%), so hardware utilization is unchanged —
+    #   remat buys capacity, not speed, at this arithmetic intensity.
+    # - 1b_remat: 1.04B params (d2048/L18) at B4, FULL per-block remat —
+    #   the >= 1B single-chip point that cannot exist without remat
+    #   (params+momentum+grads alone ~12.5GB).  52.5% useful-MFU = ~70%
+    #   hardware utilization once the extra full forward (8/6 FLOPs) is
+    #   counted.  537M/B16+remat dies in the backend compile helper
+    #   (HTTP 500), not HBM — same crash class as T16384 standard
+    #   attention (see docs/longctx_t16384_repro.md).
     # Flash attention re-measured r3 at the base shape is slower than
     # XLA's fused path (0.68x), so the default attention stays.
     # Failures here must not touch the headline metric.
     lm_configs = [
-        ("transformer_lm_train_tokens_per_sec", 16384, 1024, 8, 8, 2048, 8),
-        ("transformer_lm_large_tokens_per_sec", 32768, 2048, 8, 16, 2048, 4),
+        ("transformer_lm_train_tokens_per_sec",
+         16384, 1024, 8, 8, 2048, 8, False),
+        ("transformer_lm_large_tokens_per_sec",
+         32768, 2048, 8, 16, 2048, 4, False),
+        ("transformer_lm_large_b8_remat_tokens_per_sec",
+         32768, 2048, 8, 16, 2048, 8, "dots"),
+        ("transformer_lm_1b_remat_tokens_per_sec",
+         32768, 2048, 18, 16, 2048, 4, True),
     ]
     lm_points = []
-    for metric, v, d, nl, h, t, b in lm_configs:
+    for metric, v, d, nl, h, t, b, remat in lm_configs:
         try:
             import jax as _jax
             import bigdl_tpu.nn as nn
             from bigdl_tpu.models.transformer import transformer_lm
 
             lm = transformer_lm(v, d_model=d, n_head=h, n_layers=nl,
-                                max_len=t)
+                                max_len=t, remat=remat)
             r_lm = bench_model(
                 lm, b, (t,), v, steps=args.steps,
                 precision="bf16",
@@ -529,7 +548,9 @@ def main():
                          "config": {"batch": b, "seq_len": t, "d_model": d,
                                     "n_layers": nl, "n_head": h, "vocab": v,
                                     "params_m": round(n_params / 1e6, 1),
-                                    "precision": "bf16"}}
+                                    "precision": "bf16",
+                                    "remat": ("full" if remat is True
+                                              else remat or "off")}}
             base_path = os.path.join(
                 os.path.dirname(os.path.abspath(__file__)),
                 "bench_baseline.json")

@@ -27,18 +27,21 @@ from bigdl_tpu.dataset.transformer import Transformer
 
 
 def _check_crop_fits(images: Sequence[np.ndarray],
-                     crop: Tuple[int, int]) -> None:
+                     crop: Tuple[int, int], describe=None) -> None:
     """Every image must be at least crop-sized: the native assembler
     (``native/batch.cc``) does no bounds checks, so an undersized image
-    would turn into a negative offset and an out-of-bounds read."""
+    would turn into a negative offset and an out-of-bounds read.
+    ``describe(i)`` customizes how the offending image is named (the MT
+    transformer names the record and label)."""
     ch, cw = crop
     for i, im in enumerate(images):
         h, w = im.shape[:2]
         if h < ch or w < cw:
+            who = describe(i) if describe else f"assemble_batch: image {i}"
             raise ValueError(
-                f"assemble_batch: image {i} is {h}x{w}, smaller than the "
-                f"{ch}x{cw} crop; resize images to at least the crop size "
-                "before assembly")
+                f"{who} is {h}x{w}, smaller than the {ch}x{cw} crop; "
+                "resize images to at least the crop size first "
+                "(reference pipelines feed pre-resized 256x256 records)")
 
 
 def assemble_batch(images: Sequence[np.ndarray],
@@ -216,19 +219,13 @@ class MTLabeledBGRImgToBatch(Transformer):
                 n = len(images)
                 offsets = np.empty((n, 2), np.int32)
                 flips = np.zeros((n,), np.uint8)
+                _check_crop_fits(
+                    images, self.crop,
+                    describe=lambda i: (
+                        f"MTLabeledBGRImgToBatch: record {i} of the "
+                        f"current batch (label {recs[i].label})"))
                 for i, im in enumerate(images):
                     h, w = im.shape[:2]
-                    if h < ch or w < cw:
-                        # the native assembler (native/batch.cc) does no
-                        # bounds checks — a negative offset would read out
-                        # of bounds; fail loudly naming the record instead
-                        raise ValueError(
-                            f"MTLabeledBGRImgToBatch: record {i} of the "
-                            f"current batch (label {recs[i].label}) decoded "
-                            f"to {h}x{w}, smaller than the {ch}x{cw} crop; "
-                            "resize records to at least the crop size "
-                            "upstream (reference pipelines feed "
-                            "pre-resized 256x256 records)")
                     if self.random_crop:
                         offsets[i] = (rng.random_int(0, h - ch + 1),
                                       rng.random_int(0, w - cw + 1))

@@ -146,7 +146,7 @@ def transformer_block(d_model: int, n_head: int, ff_mult: int = 4,
 def transformer_lm(vocab_size: int, d_model: int = 128, n_head: int = 4,
                    n_layers: int = 2, max_len: int = 4096,
                    tp: bool = False, moe_experts: int = 0,
-                   moe_top_k: int = 1) -> nn.Sequential:
+                   moe_top_k: int = 1, remat=False) -> nn.Sequential:
     """Token ids (B, T), 1-based -> log-probs (B, T, vocab).
 
     ``moe_experts=E`` makes every block's FFN a MoE (train on a
@@ -154,14 +154,21 @@ def transformer_lm(vocab_size: int, d_model: int = 128, n_head: int = 4,
     ``--expert-parallel``); ``moe_top_k`` selects the routing: 1 = Switch,
     2 = the GShard configuration (driver ``--moe-top-k``).  ``tp=True``
     tags Megatron splits (train on a ``("data", "model")`` mesh —
-    ``--tensor-parallel``)."""
+    ``--tensor-parallel``).  ``remat`` wraps every decoder block in
+    :class:`~bigdl_tpu.nn.Remat` activation checkpointing — ``True`` saves
+    nothing per block, ``"dots"`` saves matmul outputs (driver
+    ``--remat``); identical numerics, O(layers) less activation memory."""
     m = (nn.Sequential()
          .add(nn.LookupTable(vocab_size, d_model))
          .add(PositionalEncoding(d_model, max_len)))
     for _ in range(n_layers):
-        m.add(transformer_block(d_model, n_head, tp=tp,
-                                moe_experts=moe_experts,
-                                moe_top_k=moe_top_k))
+        block = transformer_block(d_model, n_head, tp=tp,
+                                  moe_experts=moe_experts,
+                                  moe_top_k=moe_top_k)
+        if remat:
+            block = nn.Remat(block,
+                             policy=None if remat is True else remat)
+        m.add(block)
     m.add(LayerNorm(d_model))
     m.add(nn.Linear(d_model, vocab_size))
     m.add(nn.LogSoftMax())
@@ -171,7 +178,7 @@ def transformer_lm(vocab_size: int, d_model: int = 128, n_head: int = 4,
 def transformer_lm_pipeline(vocab_size: int, d_model: int = 128,
                             n_head: int = 4, n_layers: int = 2,
                             max_len: int = 4096, moe_experts: int = 0,
-                            moe_top_k: int = 1):
+                            moe_top_k: int = 1, remat=False):
     """``(embed, blocks, head)`` for
     :class:`~bigdl_tpu.parallel.pipeline.PipelineOptimizer`: the embedding
     and LM head run replicated, the ``n_layers`` homogeneous decoder
@@ -185,6 +192,9 @@ def transformer_lm_pipeline(vocab_size: int, d_model: int = 128,
     blocks = [transformer_block(d_model, n_head, moe_experts=moe_experts,
                                 moe_top_k=moe_top_k)
               for _ in range(n_layers)]
+    if remat:
+        policy = None if remat is True else remat
+        blocks = [nn.Remat(b, policy=policy) for b in blocks]
     head = (nn.Sequential()
             .add(LayerNorm(d_model))
             .add(nn.Linear(d_model, vocab_size))

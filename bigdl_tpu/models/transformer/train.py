@@ -92,6 +92,11 @@ def main(argv=None):
                         "mesh axis (sets --layers S)")
     p.add_argument("--n-micro", type=int, default=4,
                    help="GPipe microbatches per replica (with --pipeline)")
+    p.add_argument("--remat", choices=["full", "dots"], default=None,
+                   help="activation-checkpoint every decoder block: 'full' "
+                        "saves nothing per block, 'dots' keeps matmul "
+                        "outputs (trade FLOPs for HBM — how the >=1B "
+                        "single-chip point fits)")
     args = p.parse_args(argv)
     driver_utils.init_logging()
     batch = args.batch_size or 32
@@ -105,6 +110,8 @@ def main(argv=None):
         raise SystemExit("--expert-parallel needs --moe-experts")
     if args.moe_top_k != 1 and not args.moe_experts:
         raise SystemExit("--moe-top-k needs --moe-experts")
+
+    remat = {"full": True, "dots": "dots", None: False}[args.remat]
 
     if args.synthetic:
         records = _synthetic(args.synthetic, args.seq_len)
@@ -128,7 +135,7 @@ def main(argv=None):
         embed, blocks, head = transformer_lm_pipeline(
             VOCAB, args.d_model, args.heads, n_layers=args.pipeline,
             max_len=max(4096, args.seq_len), moe_experts=args.moe_experts,
-            moe_top_k=args.moe_top_k)
+            moe_top_k=args.moe_top_k, remat=remat)
         shape = (dp, args.pipeline) if dp > 1 else (args.pipeline,)
         names = ("data", "stage") if dp > 1 else ("stage",)
         mesh = _partial_mesh(Engine, shape, names)
@@ -145,7 +152,8 @@ def main(argv=None):
                                          max_len=max(4096, args.seq_len),
                                          tp=args.tensor_parallel > 1,
                                          moe_experts=args.moe_experts,
-                                         moe_top_k=args.moe_top_k),
+                                         moe_top_k=args.moe_top_k,
+                                         remat=remat),
             lambda: optim.Adam(learning_rate=lr))
         if args.seq_parallel > 1:
             mesh = _partial_mesh(Engine, (dp, args.seq_parallel),

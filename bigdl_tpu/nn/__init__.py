@@ -47,7 +47,8 @@ from bigdl_tpu.nn.structural import (Identity, Echo, Contiguous, Reshape,
                                      Transpose, Narrow, Select, Index,
                                      MaskedSelect, Max, Min, Mean, Sum,
                                      Replicate, Padding, SpatialZeroPadding,
-                                     GradientReversal, Scale, Bottle, MM, MV,
+                                     GradientReversal, Scale, Bottle, Remat,
+                                     MM, MV,
                                      DotProduct, Pack, Reverse,
                                      MulConstant, AddConstant,
                                      ChannelNormalize)

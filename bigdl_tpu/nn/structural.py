@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from bigdl_tpu.nn.module import Module
+from bigdl_tpu.nn.module import Container, Module, _child_rng
 
 
 def _axis(dim_1based: int, ndim: int, n_input_dims: int = -1) -> int:
@@ -414,6 +414,67 @@ class Bottle(Module):
                                    training=training, rng=rng)
         out = jnp.reshape(out, lead + out.shape[1:])
         return out, [s]
+
+
+class Remat(Container):
+    """Activation-checkpoint (rematerialization) wrapper.
+
+    ``jax.checkpoint`` around the wrapped module's pure ``apply``: the
+    backward pass recomputes the module's internal activations from the
+    module INPUT instead of storing them through the whole forward —
+    trading one extra forward's FLOPs per wrapped span for O(spans)
+    instead of O(all ops) activation residency.  This is the standard
+    TPU lever for pushing a deep transformer stack past the HBM capacity
+    wall (no reference equivalent: the reference keeps every layer's
+    ``output``/``gradInput`` buffer resident by design,
+    ``nn/abstractnn/AbstractModule.scala:54``).
+
+    ``policy`` selects what intermediates MAY be saved anyway:
+
+    - ``None`` / ``"nothing"`` — save nothing inside the span (max memory
+      savings, full forward recompute in the VJP);
+    - ``"dots"`` — save matmul/contraction outputs
+      (``jax.checkpoint_policies.dots_with_no_batch_dims_saveable``):
+      only cheap elementwise/norm ops recompute, a good default when the
+      span is matmul-dominated;
+    - any ``jax.checkpoint_policies`` callable.
+
+    Implemented as a Container with one child so ``modules()`` walks,
+    ``parallel.tp_specs``'s spec recursion, sequence-parallel wiring and
+    child param adoption all see through it transparently.
+    """
+
+    def __init__(self, inner: Module, policy=None, name=None):
+        super().__init__(name)
+        self.add(inner)
+        self.policy = policy
+
+    def add(self, module: Module) -> "Container":
+        if self.children:
+            raise ValueError("Remat wraps exactly one module; compose a "
+                             "Sequential inside it instead")
+        return super().add(module)
+
+    def checkpoint_policy(self):
+        if callable(self.policy):
+            return self.policy
+        if self.policy in (None, "nothing"):
+            return None
+        if self.policy == "dots":
+            return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        raise ValueError(
+            f"unknown remat policy {self.policy!r}: expected None, "
+            "'nothing', 'dots', or a jax.checkpoint_policies callable")
+
+    def apply(self, params, input, state, training=False, rng=None):
+        inner = self.children[0]
+
+        def fn(p, x, s, r):
+            return inner.apply(p, x, s, training=training, rng=r)
+
+        out, new_s = jax.checkpoint(fn, policy=self.checkpoint_policy())(
+            params[0], input, state[0], _child_rng(rng, 0))
+        return out, [new_s]
 
 
 class MM(Module):
