@@ -17,6 +17,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from bigdl_tpu.nn import init as init_methods
 from bigdl_tpu.nn.module import Module
@@ -53,35 +54,137 @@ def scaled_dot_product_attention(q: jnp.ndarray, k: jnp.ndarray,
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
+def chunked_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      causal: bool = False, chunk: int = 1024
+                      ) -> jnp.ndarray:
+    """Query-chunked attention in pure XLA: identical numerics to
+    :func:`scaled_dot_product_attention`, O(T * chunk) score memory
+    instead of O(T^2).
+
+    A ``lax.scan`` walks the query blocks; each step attends its block
+    against the FULL key/value (one big MXU-shaped matmul pair), and the
+    step body is ``jax.checkpoint``-ed so the backward pass rematerializes
+    each block's scores instead of saving all of them — without that, the
+    scan VJP would stash every step's (B, H, chunk, T) probability matrix
+    and reinstate the O(T^2) footprint.
+
+    This is the single-chip fallback for shapes where the one-shot
+    standard path's O(T^2) program crashes the backend compiler (measured
+    at T16384: ``docs/longctx_t16384_repro.md``) and the pallas kernel's
+    constraints (head_dim % 128, TPU-only) don't hold.  For causal masks
+    it still computes the fully-masked upper blocks (~2x the minimal
+    FLOPs) — static shapes keep XLA happy; the pallas flash kernel is the
+    path that skips them."""
+    bsz, t, h, dh = q.shape
+    tk = k.shape[1]
+    if t % chunk != 0:
+        raise ValueError(f"chunked attention needs T divisible by the "
+                         f"chunk size: T={t}, chunk={chunk}")
+    nq = t // chunk
+    scale = 1.0 / math.sqrt(dh)
+    neg_big = jnp.asarray(jnp.finfo(q.dtype).min, q.dtype)
+    k_pos = jnp.arange(tk)
+    # (nq, B, chunk, H, Dh) so scan's leading axis is the q-block index
+    qb = jnp.moveaxis(q.reshape(bsz, nq, chunk, h, dh), 1, 0)
+
+    @jax.checkpoint
+    def step(_, qi):
+        i, qc = qi
+        scores = jnp.einsum("bqhd,bkhd->bhqk", qc, k) * scale
+        if causal:
+            # bottom-right aligned like scaled_dot_product_attention's
+            # tril(k=tk-tq): for Tq != Tkv, query i attends keys up to
+            # i + (tk - t)
+            q_pos = i * chunk + jnp.arange(chunk) + (tk - t)
+            allowed = q_pos[:, None] >= k_pos[None, :]
+            scores = jnp.where(allowed[None, None], scores, neg_big)
+        p = jax.nn.softmax(scores, axis=-1)
+        return None, jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    _, ob = lax.scan(step, None, (jnp.arange(nq), qb))
+    return jnp.moveaxis(ob, 0, 1).reshape(bsz, t, h, dh)
+
+
+def _flash_block_sizes(t: int):
+    """Measured v5e tile sizes for the pallas flash kernel (r5,
+    ``_flash_tune`` protocol, B1/H8/Dh128, fwd+bwd, carried chain):
+
+    ======  ==========  =========  ==========
+    tiles   T8192       T16384     speedup
+    ======  ==========  =========  ==========
+    128     32.6 ms     139.1 ms   1.0x (stock default)
+    512     10.8 ms      27.0 ms   3.0-5.2x
+    1024     8.4 ms      22.0 ms   3.9-6.3x
+    2048    compile-helper crash (same class as the T16384 standard
+            path, docs/longctx_t16384_repro.md)
+    ======  ==========  =========  ==========
+
+    The stock default (every tile 128) starves the kernel; 1024-square
+    tiles are the measured optimum at every shape that compiles.  Tiles
+    must divide the sequence length, so shorter/odd T fall back through
+    the power-of-two ladder."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes
+    blk = 128
+    for cand in (1024, 512, 256):
+        if t % cand == 0:
+            blk = cand
+            break
+    return BlockSizes(
+        block_q=blk, block_k_major=blk, block_k=blk, block_b=1,
+        block_q_major_dkv=blk, block_k_major_dkv=blk,
+        block_k_dkv=blk, block_q_dkv=blk,
+        block_k_major_dq=blk, block_k_dq=blk, block_q_dq=blk)
+
+
 class MultiHeadAttention(Module):
     """Self-attention over (B, T, D) input; table input (q_src, kv_src)
     gives cross-attention.
 
-    ``flash``: opt-in TPU pallas flash-attention kernel.  Measured on v5e
-    across the full shape range (bench_longctx.json): XLA's fused bf16
-    path wins at every shape it compiles — flash is 0.68x at T2048 and
-    0.58x at T8192 in the full jitted train step — but at T16384 the
-    standard path's O(T^2) program fails to compile on this backend
-    while flash runs (13.9k tokens/s at d1024/L8/B1), so flash is the
-    single-chip path beyond ~T8192 (multi-chip: ring attention over a
-    ``seq`` axis).  Default (False) is the standard path; pass ``True``
-    to require the kernel (raises when the backend/shape constraints
-    aren't met; self-attention only — the kernel's causal mask is
-    top-left aligned, which diverges from the reference's
-    bottom-right-aligned mask when Tq != Tkv).  Revisit per hardware
-    generation."""
+    ``flash``: opt-in TPU pallas flash-attention kernel with v5e-tuned
+    tile sizes (:func:`_flash_block_sizes` — the stock 128 defaults are
+    3.9-6.3x slower).  Measured r5 in the full jitted train step
+    (bench_longctx.json): flash WINS beyond ~T8k once tuned — the r4
+    "0.58x at T8192" was the untuned default.  At T16384 the one-shot
+    standard path exhausts HBM on saved O(T^2) residuals beyond 2 layers
+    (docs/longctx_t16384_repro.md); flash, ``chunk``, or per-block remat
+    all recover it.  Default (False) stays the standard path (it wins at
+    T<=4k); pass ``True`` to require the kernel (raises when the
+    backend/shape constraints aren't met; self-attention only — the
+    kernel's causal mask is top-left aligned, which diverges from the
+    reference's bottom-right-aligned mask when Tq != Tkv).  Revisit per
+    hardware generation.
+
+    ``chunk=N``: the pure-XLA q-blockwise path (:func:`chunked_attention`)
+    — same numerics as standard (incl. the bottom-right-aligned causal
+    mask for Tq != Tkv), O(T*N) score memory; the second long-context
+    path where pallas is unwanted (e.g. under the GSPMD head split,
+    which pallas kernels cannot partition)."""
+
+    # class-level defaults keep OLD pickled snapshots forward-loadable:
+    # Module.__setstate__ dict-updates, so instances serialized before an
+    # attribute existed fall through to these
+    flash = False
+    chunk: Optional[int] = None
+    sequence_parallel: Optional[str] = None
 
     def __init__(self, hidden_size: int, n_head: int, causal: bool = False,
-                 with_bias: bool = True, flash: bool = False, name=None):
+                 with_bias: bool = True, flash: bool = False,
+                 chunk: Optional[int] = None, name=None):
         super().__init__(name)
         if hidden_size % n_head != 0:
             raise ValueError(f"hidden {hidden_size} % heads {n_head} != 0")
+        if flash and chunk:
+            raise ValueError("flash and chunk are alternative long-context "
+                             "paths; pick one")
         self.hidden_size = hidden_size
         self.n_head = n_head
         self.head_dim = hidden_size // n_head
         self.causal = causal
         self.with_bias = with_bias
         self.flash = flash
+        # chunk=N: q-blockwise scan attention (pure XLA; see
+        # chunked_attention) — the second long-context path, for shapes
+        # where one-shot O(T^2) breaks the backend and pallas is unwanted
+        self.chunk = chunk
         # mesh-axis name for ring-attention sequence parallelism; the ring
         # path engages only while that named axis is bound (i.e. inside a
         # shard_map over the mesh's seq axis — DistriOptimizer sets this
@@ -154,6 +257,9 @@ class MultiHeadAttention(Module):
             out = _ring_attention_shard(q, k, v,
                                         axis_name=self.sequence_parallel,
                                         causal=self.causal)
+        elif self.chunk:
+            out = chunked_attention(q, k, v, causal=self.causal,
+                                    chunk=self.chunk)
         elif self._flash_ok(q, k):
             from jax.experimental.pallas.ops.tpu.flash_attention import (
                 flash_attention)
@@ -162,7 +268,8 @@ class MultiHeadAttention(Module):
                 jnp.transpose(k, (0, 2, 1, 3)),
                 jnp.transpose(v, (0, 2, 1, 3)),
                 causal=self.causal,
-                sm_scale=1.0 / math.sqrt(self.head_dim))
+                sm_scale=1.0 / math.sqrt(self.head_dim),
+                block_sizes=_flash_block_sizes(q.shape[1]))
             out = jnp.transpose(out, (0, 2, 1, 3))
         else:
             out = scaled_dot_product_attention(q, k, v, causal=self.causal)

@@ -448,6 +448,7 @@ class Remat(Container):
         super().__init__(name)
         self.add(inner)
         self.policy = policy
+        self.checkpoint_policy()   # typo'd policies fail HERE, not at trace
 
     def add(self, module: Module) -> "Container":
         if self.children:

@@ -261,6 +261,22 @@ class DistriOptimizer(Optimizer):
                     "the GSPMD tensor-parallel step composes with 'data' "
                     "only — a mesh mixing 'model' with 'seq'/'expert' is "
                     "not supported")
+            if self.compression:
+                # NOT silently ignorable: on the GSPMD path the gradient
+                # all-reduces are inserted by XLA's partitioner, which
+                # accumulates and reduces in f32 even for bf16 compute
+                # (verified from compiled HLO: f32 all-reduce(dot) then
+                # convert) — there is no program point "before the psum"
+                # to cast at.  The explicit shard_map dp step is where
+                # the wire dtype is controllable.
+                raise ValueError(
+                    "compression='bf16' controls the explicit reduce-"
+                    "scatter wire of the data-parallel shard_map step; "
+                    "on a tensor-parallel ('model') mesh the gradient "
+                    "collectives are XLA-partitioner-inserted and their "
+                    "wire dtype is not controllable — drop compression "
+                    "for this mesh (set_precision('bf16') already keeps "
+                    "activations/backward matmuls in bf16)")
             return self._optimize_gspmd()
         if self.seq_axis:
             self._wire_sequence_parallel(model)

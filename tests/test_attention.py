@@ -44,6 +44,82 @@ class TestFullAttention:
         np.testing.assert_allclose(np.asarray(out[:, 0]),
                                    np.asarray(expect0), rtol=1e-5)
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_chunked_matches_full(self, causal):
+        """chunked_attention is the same softmax, q-block-scanned: parity
+        with the one-shot path to float tolerance, fwd and grad."""
+        from bigdl_tpu.nn.attention import chunked_attention
+        q, k, v = _qkv(t=32)
+
+        def full(q):
+            return jnp.sum(
+                scaled_dot_product_attention(q, k, v, causal=causal) ** 2)
+
+        def chunked(q):
+            return jnp.sum(
+                chunked_attention(q, k, v, causal=causal, chunk=8) ** 2)
+
+        np.testing.assert_allclose(float(full(q)), float(chunked(q)),
+                                   rtol=1e-5)
+        gf = jax.grad(full)(q)
+        gc = jax.grad(chunked)(q)
+        np.testing.assert_allclose(np.asarray(gc), np.asarray(gf),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_chunked_cross_attention_causal_alignment(self):
+        """Tq != Tkv: the causal mask must stay bottom-right aligned like
+        the one-shot path (query i sees keys up to i + Tkv - Tq), not
+        top-left (the flash kernel's divergence this path must NOT have)."""
+        from bigdl_tpu.nn.attention import chunked_attention
+        rng = np.random.RandomState(4)
+        q = jnp.asarray(rng.normal(size=(2, 16, 4, 8)).astype(np.float32))
+        k, v = (jnp.asarray(rng.normal(size=(2, 32, 4, 8))
+                            .astype(np.float32)) for _ in range(2))
+        want = scaled_dot_product_attention(q, k, v, causal=True)
+        got = chunked_attention(q, k, v, causal=True, chunk=8)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_old_snapshot_without_chunk_attr_still_forwards(self):
+        """Snapshots pickled before the chunk/flash attributes existed
+        must load and forward (class-level defaults backfill them)."""
+        import pickle
+        mha = MultiHeadAttention(32, 4)
+        mha._ensure_init()
+        state = mha.__getstate__()
+        for key in ("chunk", "flash", "sequence_parallel"):
+            state.pop(key, None)       # as an old pickle would lack them
+        old = MultiHeadAttention.__new__(MultiHeadAttention)
+        old.__setstate__(state)
+        x = jnp.asarray(np.random.RandomState(6)
+                        .normal(size=(1, 8, 32)).astype(np.float32))
+        assert np.asarray(old.forward(x)).shape == (1, 8, 32)
+
+    def test_chunked_rejects_indivisible_t(self):
+        from bigdl_tpu.nn.attention import chunked_attention
+        q, k, v = _qkv(t=12)
+        with pytest.raises(ValueError, match="divisible"):
+            chunked_attention(q, k, v, chunk=8)
+
+    def test_mha_chunk_param_end_to_end(self):
+        """MultiHeadAttention(chunk=N) must produce the standard module's
+        output on the same params."""
+        base = MultiHeadAttention(32, 4, causal=True)
+        base._ensure_init()
+        ch = MultiHeadAttention(32, 4, causal=True, chunk=8)
+        ch._params = base._params
+        ch._state = base._state
+        ch._grads = base._grads
+        x = jnp.asarray(np.random.RandomState(5)
+                        .normal(size=(2, 16, 32)).astype(np.float32))
+        np.testing.assert_allclose(np.asarray(ch.forward(x)),
+                                   np.asarray(base.forward(x)),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_mha_flash_and_chunk_exclusive(self):
+        with pytest.raises(ValueError, match="pick one"):
+            MultiHeadAttention(32, 4, flash=True, chunk=8)
+
     def test_mha_module_shapes_and_grad(self):
         mha = MultiHeadAttention(32, 4)
         x = np.random.RandomState(1).normal(size=(2, 16, 32)).astype(np.float32)

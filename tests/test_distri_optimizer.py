@@ -211,6 +211,25 @@ class TestDistriOptimizer:
             samples, [optim.Top1Accuracy()], 64)[0][1].final_result()
         assert acc > 0.9
 
+    def test_bf16_compression_rejected_on_tp_mesh(self):
+        """compression='bf16' must fail LOUDLY on a ('data','model') mesh:
+        the GSPMD step's gradient collectives are XLA-inserted (f32
+        accumulate-and-reduce, verified from compiled HLO), so the knob
+        cannot take effect there — silence would quietly ship fp32 wire
+        traffic a user believes is compressed."""
+        from bigdl_tpu.engine import Engine
+        from bigdl_tpu.models.transformer import transformer_lm
+        mesh = Engine.create_mesh((2, 2), ("data", "model"),
+                                  devices=Engine.devices()[:4])
+        lm = transformer_lm(16, d_model=16, n_head=2, n_layers=1, tp=True)
+        ds = ShardedDataSet(synthetic_separable(64, 4, n_classes=3), 2)
+        opt = DistriOptimizer(lm, ds, nn.ClassNLLCriterion(), mesh=mesh,
+                              compression="bf16")
+        opt.set_optim_method(optim.SGD(learning_rate=0.1))
+        opt.set_end_when(optim.max_iteration(1))
+        with pytest.raises(ValueError, match="wire dtype is not"):
+            opt.optimize()
+
     def test_conv_pool_model_distributed(self):
         """LeNet-style conv+pool through the sharded fused step."""
         from tests.test_e2e_train import synthetic_digit_images
