@@ -178,19 +178,23 @@ def transformer_lm(vocab_size: int, d_model: int = 128, n_head: int = 4,
 def transformer_lm_pipeline(vocab_size: int, d_model: int = 128,
                             n_head: int = 4, n_layers: int = 2,
                             max_len: int = 4096, moe_experts: int = 0,
-                            moe_top_k: int = 1, remat=False):
+                            moe_top_k: int = 1, remat=False,
+                            tp: bool = False):
     """``(embed, blocks, head)`` for
     :class:`~bigdl_tpu.parallel.pipeline.PipelineOptimizer`: the embedding
     and LM head run replicated, the ``n_layers`` homogeneous decoder
     blocks pipeline over a ``stage`` mesh axis (one block per stage
     device — the driver's ``--pipeline``).  ``moe_experts=E`` gives every
     block a Switch-MoE FFN; the pipeline trainer folds the collected
-    ``aux_loss`` into its objective (``pipeline_apply(return_aux=True)``)."""
+    ``aux_loss`` into its objective (``pipeline_apply(return_aux=True)``).
+    ``tp=True`` Megatron-tags each block for the 3-D
+    ``('data','stage','model')`` composition (driver
+    ``--pipeline --tensor-parallel``)."""
     embed = (nn.Sequential()
              .add(nn.LookupTable(vocab_size, d_model))
              .add(PositionalEncoding(d_model, max_len)))
     blocks = [transformer_block(d_model, n_head, moe_experts=moe_experts,
-                                moe_top_k=moe_top_k)
+                                moe_top_k=moe_top_k, tp=tp)
               for _ in range(n_layers)]
     if remat:
         policy = None if remat is True else remat

@@ -104,8 +104,10 @@ def main(argv=None):
                              ("--tensor-parallel", args.tensor_parallel > 1),
                              ("--expert-parallel", args.expert_parallel > 1),
                              ("--pipeline", args.pipeline > 1)) if on]
-    if len(modes) > 1:
-        raise SystemExit(f"pick one parallelism mode, got {modes}")
+    if len(modes) > 1 and set(modes) != {"--pipeline", "--tensor-parallel"}:
+        raise SystemExit(f"pick one parallelism mode, got {modes} "
+                         "(--pipeline composes with --tensor-parallel "
+                         "only)")
     if args.expert_parallel > 1 and not args.moe_experts:
         raise SystemExit("--expert-parallel needs --moe-experts")
     if args.moe_top_k != 1 and not args.moe_experts:
@@ -132,12 +134,20 @@ def main(argv=None):
         if args.model or args.state:
             raise SystemExit("--pipeline does not support --model/--state "
                              "snapshot resume yet")
+        tp_n = args.tensor_parallel if args.tensor_parallel > 1 else 0
+        if tp_n and args.moe_experts:
+            raise SystemExit("pick one of --tensor-parallel / "
+                             "--moe-experts per block")
         embed, blocks, head = transformer_lm_pipeline(
             VOCAB, args.d_model, args.heads, n_layers=args.pipeline,
             max_len=max(4096, args.seq_len), moe_experts=args.moe_experts,
-            moe_top_k=args.moe_top_k, remat=remat)
+            moe_top_k=args.moe_top_k, remat=remat, tp=bool(tp_n))
         shape = (dp, args.pipeline) if dp > 1 else (args.pipeline,)
         names = ("data", "stage") if dp > 1 else ("stage",)
+        if tp_n:
+            # 3-D composition: ('data','stage','model') (or 2-D without dp)
+            shape = shape + (tp_n,)
+            names = names + ("model",)
         mesh = _partial_mesh(Engine, shape, names)
         ds = driver_utils.make_dataset(records, args, batch)
         opt = PipelineOptimizer(blocks, ds, crit, mesh=mesh,

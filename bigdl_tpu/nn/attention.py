@@ -165,6 +165,9 @@ class MultiHeadAttention(Module):
     flash = False
     chunk: Optional[int] = None
     sequence_parallel: Optional[str] = None
+    #: mesh-axis name for the EXPLICIT (shard_map) Megatron head split —
+    #: the pipeline x tp composition; GSPMD meshes use tp_specs instead
+    model_parallel: Optional[str] = None
 
     def __init__(self, hidden_size: int, n_head: int, causal: bool = False,
                  with_bias: bool = True, flash: bool = False,
@@ -196,7 +199,27 @@ class MultiHeadAttention(Module):
         if axis_name and self.flash:
             raise ValueError("flash kernel and ring sequence parallelism "
                              "are mutually exclusive")
+        if axis_name and self.model_parallel:
+            raise ValueError("pick one of model_parallel / "
+                             "sequence_parallel per attention layer")
         self.sequence_parallel = axis_name
+        self._jit_apply = None
+        return self
+
+    def set_model_parallel(self, axis_name: Optional[str]
+                           ) -> "MultiHeadAttention":
+        """Explicit Megatron head split over the named mesh axis (engages
+        only while that axis is bound — the shard_map pipeline x tp step):
+        wq/wk/wv are column-split so each device computes its local heads,
+        wo is row-split with the pair's single psum on the output."""
+        if axis_name and self.flash:
+            raise ValueError("flash kernel is incompatible with the "
+                             "Megatron head split (pallas kernels do not "
+                             "partition)")
+        if axis_name and self.sequence_parallel:
+            raise ValueError("pick one of model_parallel / "
+                             "sequence_parallel per attention layer")
+        self.model_parallel = axis_name
         self._jit_apply = None
         return self
 
@@ -238,13 +261,18 @@ class MultiHeadAttention(Module):
         if self.with_bias:
             y = y + params[b]
         bsz, t, _ = y.shape
-        return y.reshape(bsz, t, self.n_head, self.head_dim)
+        # -1 heads: under the explicit Megatron split params hold only
+        # the LOCAL heads' columns (head_dim never splits)
+        return y.reshape(bsz, t, -1, self.head_dim)
 
     def apply(self, params, input, state, training=False, rng=None):
         if isinstance(input, (list, tuple)):
             q_src, kv_src = input[0], input[1]
         else:
             q_src = kv_src = input
+        tp_axis = self.model_parallel
+        if not (tp_axis and _axis_bound(tp_axis)):
+            tp_axis = None
         q = self._project(params, q_src, "wq", "bq")
         k = self._project(params, kv_src, "wk", "bk")
         v = self._project(params, kv_src, "wv", "bv")
@@ -274,7 +302,10 @@ class MultiHeadAttention(Module):
         else:
             out = scaled_dot_product_attention(q, k, v, causal=self.causal)
         bsz, t = out.shape[0], out.shape[1]
-        out = out.reshape(bsz, t, self.hidden_size) @ params["wo"]
+        # -1: local heads * head_dim under the explicit Megatron split
+        out = out.reshape(bsz, t, -1) @ params["wo"]
+        if tp_axis:
+            out = lax.psum(out, tp_axis)   # the head-split pair's one psum
         if self.with_bias:
             out = out + params["bo"]
         return out, state

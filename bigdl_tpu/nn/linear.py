@@ -22,7 +22,23 @@ from bigdl_tpu.nn import init as init_methods
 
 
 class Linear(Module):
-    """y = x W + b (reference ``nn/Linear.scala``)."""
+    """y = x W + b (reference ``nn/Linear.scala``).
+
+    Tensor parallelism: tagging via ``parallel.column_parallel`` /
+    ``row_parallel`` serves two execution styles.  On the GSPMD path the
+    tag only picks the ``tp_specs`` sharding and XLA inserts the
+    collectives.  Inside an EXPLICIT shard_map step (the pipeline x tp
+    composition), :meth:`set_model_parallel` names the mesh axis and this
+    module runs the Megatron split by hand: a column Linear emits
+    feature-sharded output from the replicated input; a row Linear
+    contracts its local rows and psums the pair's single all-reduce.
+    The manual path engages only while the named axis is bound; ordinary
+    forwards are untouched."""
+
+    #: "column"/"row" Megatron tag; None = not tensor-parallel
+    _tp = None
+    #: mesh-axis name for the explicit shard_map tp path
+    model_parallel = None
 
     def __init__(self, input_size: int, output_size: int, with_bias: bool = True,
                  w_regularizer=None, b_regularizer=None,
@@ -68,10 +84,37 @@ class Linear(Module):
                                                   fan_in, fan_out)
         return p
 
+    def set_model_parallel(self, axis_name) -> "Linear":
+        self.model_parallel = axis_name
+        self._jit_apply = None
+        return self
+
     def apply(self, params, input, state, training=False, rng=None):
+        if self._tp and self.model_parallel:
+            from bigdl_tpu.nn.attention import _axis_bound
+            if _axis_bound(self.model_parallel):
+                return self._apply_tp(params, input, state)
         out = input @ params["weight"]
         if self.with_bias:
             out = out + params["bias"]
+        return out, state
+
+    def _apply_tp(self, params, input, state):
+        """Megatron split with explicit collectives (axis bound — inside
+        the shard_map pipeline step; ``params`` leaves are the LOCAL
+        shard).  No Megatron f/g custom-vjp operators: shard_map's
+        transpose handles the replicated/split gradient accounting
+        (grad-parity-tested against the unsplit stack)."""
+        from jax import lax
+        if self._tp == "column":
+            out = input @ params["weight"]     # replicated in, sharded out
+            if self.with_bias:
+                out = out + params["bias"]     # column-sliced bias
+            return out, state
+        out = input @ params["weight"]         # partial: local rows only
+        out = lax.psum(out, self.model_parallel)   # the pair's one psum
+        if self.with_bias:
+            out = out + params["bias"]         # full bias (replicated add)
         return out, state
 
 

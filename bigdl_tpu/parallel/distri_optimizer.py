@@ -102,6 +102,21 @@ def _local_axis_chunks(mesh: Mesh, axis: str):
     return per_axis[axis]
 
 
+def map_over_slots(optim_method, fn, slots, per_param_tree):
+    """Apply ``fn(slot_leaf_tree_element, per_param_element)`` across
+    every slot family (Adam's m/v, momentum's v, …): slot pytrees are
+    {family: params-shaped tree}, so the per-parameter spec tree is
+    zipped against each family's subtree.  Shared by the GSPMD dp x tp
+    step and the pipeline trainer's ZeRO-1 slot placement."""
+    outer = jax.tree_util.tree_structure(
+        optim_method.init_slots(jnp.zeros(())))
+    subtrees = outer.flatten_up_to(slots)
+    return jax.tree_util.tree_unflatten(
+        outer,
+        [jax.tree_util.tree_map(fn, st, per_param_tree)
+         for st in subtrees])
+
+
 def _pmean_float(tree, axis: str):
     """Average float leaves across the axis (keeps BatchNorm running stats
     consistent between replicas); non-float leaves pass through (they evolve
@@ -508,17 +523,7 @@ class DistriOptimizer(Optimizer):
         return model
 
     def _map_over_slots(self, fn, slots, per_param_tree):
-        """Apply ``fn(slot_leaf_tree_element, per_param_element)`` across
-        every slot family (Adam's m/v, momentum's v, …): slot pytrees are
-        {family: params-shaped tree}, so the per-parameter spec tree is
-        zipped against each family's subtree."""
-        outer = jax.tree_util.tree_structure(
-            self.optim_method.init_slots(jnp.zeros(())))
-        subtrees = outer.flatten_up_to(slots)
-        return jax.tree_util.tree_unflatten(
-            outer,
-            [jax.tree_util.tree_map(fn, st, per_param_tree)
-             for st in subtrees])
+        return map_over_slots(self.optim_method, fn, slots, per_param_tree)
 
     def _build_gspmd_step(self, out_shardings=None):
         model, criterion = self.model, self.criterion

@@ -53,11 +53,53 @@ def unstack_stage_params(stacked, n_stages: int) -> List:
             for i in range(n_stages)]
 
 
-def pipeline_shard_params(stacked, mesh: Mesh, axis: str = "stage"):
+def pipeline_shard_params(stacked, mesh: Mesh, axis: str = "stage",
+                          specs=None):
     """Place stacked params with the stage dimension split across the mesh:
-    each device physically holds only its own stage's weights."""
+    each device physically holds only its own stage's weights.  ``specs``
+    (a per-leaf PartitionSpec tree, e.g. from :func:`stage_tp_specs`)
+    additionally splits each stage's weights over a ``model`` axis — the
+    pipeline x tensor-parallel composition."""
+    if specs is None:
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, NamedSharding(mesh, P(axis))),
+            stacked)
     return jax.tree_util.tree_map(
-        lambda x: jax.device_put(x, NamedSharding(mesh, P(axis))), stacked)
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        stacked, specs)
+
+
+def stage_tp_specs(block: Module, tp_axis: str = "model",
+                   axis: str = "stage"):
+    """Per-leaf PartitionSpecs for STACKED stage params of a tp-tagged
+    block: dim 0 (the stage dimension) splits over ``axis``, and each
+    leaf's Megatron split (``parallel.tp_specs``) shifts right by one —
+    a column weight (S, in, out) becomes P(stage, None, model)."""
+    from bigdl_tpu.parallel.tensor_parallel import tp_specs
+    specs = tp_specs(block, axis=tp_axis)
+    return jax.tree_util.tree_map(
+        lambda s: P(axis, *s), specs,
+        is_leaf=lambda s: isinstance(s, P))
+
+
+def wire_model_parallel(block: Module, axis: Optional[str],
+                        mesh: Optional[Mesh] = None) -> None:
+    """Point every tp-capable module (tagged Linear, MultiHeadAttention)
+    at the named mesh axis for the EXPLICIT Megatron path (duck-typed,
+    like the seq/expert wiring).  Rejects stochastic blocks: a dropout
+    mask drawn per model-axis device would decorrelate across feature
+    shards, silently changing the layer's semantics."""
+    if axis and block.is_stochastic():
+        raise ValueError(
+            "tensor-parallel pipeline stages must be deterministic "
+            "(Dropout & co. would draw per-shard masks over a "
+            "feature-sharded activation)")
+    if axis and mesh is not None:
+        from bigdl_tpu.parallel.tensor_parallel import head_count_divisible
+        head_count_divisible(block, mesh, axis)
+    for m in block.modules():
+        if hasattr(m, "set_model_parallel"):
+            m.set_model_parallel(axis)
 
 
 def _check_block(block: Module) -> None:
@@ -74,7 +116,7 @@ def pipeline_apply(block: Module, stacked_params, x: jnp.ndarray,
                    n_micro: int, mesh: Mesh, axis: str = "stage",
                    data_axis: Optional[str] = None,
                    training: bool = False, rng=None,
-                   return_aux: bool = False):
+                   return_aux: bool = False, param_specs=None):
     """Run the S-stage pipeline over ``x`` (batch, ...) and return the
     final-stage output for the whole batch, replicated over stages.
 
@@ -100,6 +142,16 @@ def pipeline_apply(block: Module, stacked_params, x: jnp.ndarray,
     balancing) over all real (non-drain) microbatch executions and all
     stages — the term a trainer must fold into its objective, since the
     scanned schedule otherwise discards per-forward state.
+
+    ``param_specs``: the pipeline x tensor-parallel composition on a
+    ``('data','stage','model')`` mesh — each stage's Megatron-tagged
+    weights additionally split over ``model`` (per-leaf PartitionSpec
+    tree from :func:`stage_tp_specs`), and the block must be wired with
+    :func:`wire_model_parallel` so its Linears/MHA run the explicit
+    split (local matmuls + the pair's one psum) inside this shard_map.
+    No custom gradient bookkeeping is needed: shard_map's transpose
+    handles the replicated/split accounting (verified by grad-parity
+    tests against the unsplit stack).
     """
     from bigdl_tpu.parallel.all_reduce import shard_map
 
@@ -115,6 +167,14 @@ def pipeline_apply(block: Module, stacked_params, x: jnp.ndarray,
             raise ValueError(f"batch {x.shape[0]} must divide by the "
                              f"'{data_axis}' axis size {n_data}")
     _check_block(block)
+    if param_specs is not None and not any(
+            getattr(m, "model_parallel", None) for m in block.modules()):
+        # split weights with an unwired block would run row-parallel
+        # matmuls WITHOUT their pair psum: finite loss, garbage numbers
+        raise ValueError(
+            "param_specs splits stage weights over a model axis but no "
+            "module in the block is wired for the explicit Megatron "
+            "split — call wire_model_parallel(block, axis, mesh) first")
     for leaf in jax.tree_util.tree_leaves(stacked_params):
         if leaf.shape[0] != n_stages:
             raise ValueError(
@@ -182,7 +242,9 @@ def pipeline_apply(block: Module, stacked_params, x: jnp.ndarray,
 
     x_spec = P(data_axis) if data_axis is not None else P()
     fn = shard_map(shard_fn, mesh=mesh,
-                   in_specs=(P(axis), x_spec), out_specs=(x_spec, P()),
+                   in_specs=(param_specs if param_specs is not None
+                             else P(axis), x_spec),
+                   out_specs=(x_spec, P()),
                    check_rep=False)
     out, aux = fn(stacked_params, x)
     if return_aux:
@@ -237,6 +299,12 @@ class PipelineOptimizer(Optimizer):
                 f"{len(self.blocks)} blocks vs 'stage' axis size "
                 f"{self._mesh.shape['stage']} — one stage per device")
         self.data_axis = "data" if "data" in self._mesh.shape else None
+        # 3-D composition: a 'model' axis Megatron-splits each stage's
+        # tagged weights INSIDE the ppermute schedule (explicit
+        # collectives — wire_model_parallel), ZeRO-1 shards optimizer
+        # slots over 'data' on top
+        self.model_axis = "model" if "model" in self._mesh.shape else None
+        self._stage_specs = None
         for m in (embed, head):
             if m is not None:
                 m._ensure_init()
@@ -275,7 +343,7 @@ class PipelineOptimizer(Optimizer):
                     block, p["stages"], h, n_micro, mesh,
                     data_axis=data_axis, training=True,
                     rng=None if rng is None else jax.random.fold_in(rng, 1),
-                    return_aux=True)
+                    return_aux=True, param_specs=self._stage_specs)
                 if head is not None:
                     h, _ = head.apply(p["head"], h, head.state,
                                       training=True,
@@ -301,7 +369,23 @@ class PipelineOptimizer(Optimizer):
                                                       hyper)
             return new_params, new_slots, loss
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        out_shardings = None
+        if getattr(self, "_slot_specs", None) is not None:
+            # pin the composed-mesh placements: params come back in their
+            # stage(+model) split, slots keep the ZeRO-1 data shard —
+            # otherwise the partitioner may silently regather them
+            ns = lambda s: NamedSharding(mesh, s)  # noqa: E731
+            param_sh = jax.tree_util.tree_map(
+                ns, self._param_specs_tree,
+                is_leaf=lambda s: isinstance(s, P))
+            from bigdl_tpu.parallel.distri_optimizer import map_over_slots
+            slot_sh = map_over_slots(optim, lambda x, s: ns(s),
+                                     self.optim_method._slots,
+                                     self._slot_specs)
+            out_shardings = (param_sh, slot_sh, None)
+
+        return jax.jit(step, donate_argnums=(0, 1),
+                       out_shardings=out_shardings)
 
     def _optimize(self):
         import numpy as np
@@ -315,8 +399,16 @@ class PipelineOptimizer(Optimizer):
             # updates in the scanned schedule just as surely as at stage 0
             _check_block(b)
 
+        if self.model_axis:
+            # explicit Megatron split inside the schedule: wire every
+            # stage's tagged modules at the axis and validate head counts
+            for b in self.blocks:
+                wire_model_parallel(b, self.model_axis, mesh)
+            self._stage_specs = stage_tp_specs(self.blocks[0],
+                                               tp_axis=self.model_axis)
+        stacked = stack_stage_params([b.params for b in self.blocks])
         params = {"stages": pipeline_shard_params(
-            stack_stage_params([b.params for b in self.blocks]), mesh)}
+            stacked, mesh, specs=self._stage_specs)}
         rep = NamedSharding(mesh, P())
         if self.embed is not None:
             params["embed"] = jax.device_put(self.embed.params, rep)
@@ -324,6 +416,31 @@ class PipelineOptimizer(Optimizer):
             params["head"] = jax.device_put(self.head.params, rep)
         carry = {"params": params,
                  "slots": self.optim_method.slots(params)}
+        self._slot_specs = None
+        if self.model_axis:
+            # per-param spec tree over the whole params dict; stage slots
+            # additionally ZeRO-1 shard over 'data' (each data replica
+            # holds 1/dp of every stage-shard's Adam m/v — elementwise
+            # updates need only the slice XLA scatters to it)
+            from bigdl_tpu.parallel.tensor_parallel import zero1_slot_specs
+            per_param = {"stages": self._stage_specs}
+            for key in ("embed", "head"):
+                if key in params:
+                    per_param[key] = jax.tree_util.tree_map(
+                        lambda _: P(), params[key])
+            slot_per_param = dict(per_param)
+            if self.data_axis:
+                slot_per_param["stages"] = zero1_slot_specs(
+                    params["stages"], self._stage_specs,
+                    mesh.shape[self.data_axis])
+            from bigdl_tpu.parallel.distri_optimizer import map_over_slots
+            carry["slots"] = map_over_slots(
+                self.optim_method,
+                lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+                carry["slots"], slot_per_param)
+            self.optim_method.set_slots(carry["slots"])
+            self._param_specs_tree = per_param
+            self._slot_specs = slot_per_param
         self.optim_method.state.setdefault("epoch", 1)
         if self._step_fn is None:
             self._step_fn = self._build_step()

@@ -179,6 +179,14 @@ class TestTransformerLM:
         acc = self._drive(capsys, ["--pipeline", "2", "--remat", "full"])
         assert 0.0 <= acc <= 1.0
 
+    def test_driver_pipeline_composes_with_tensor_parallel(self, capsys):
+        """--pipeline --tensor-parallel together build the 3-D
+        ('data','stage','model') mesh: Megatron-split stages inside the
+        GPipe schedule, trained through the public driver."""
+        acc = self._drive(capsys, ["--pipeline", "2", "--partitions", "2",
+                                   "--tensor-parallel", "2"])
+        assert 0.0 <= acc <= 1.0
+
     @pytest.mark.slow
     def test_driver_expert_parallel_flag(self, capsys):
         acc = self._drive(capsys, ["--moe-experts", "4", "--partitions", "2",
@@ -206,9 +214,13 @@ class TestTransformerLM:
 
     def test_driver_rejects_mode_combo_and_missing_moe(self):
         from bigdl_tpu.models.transformer import train as drv
+        # pipeline composes with tensor-parallel ONLY; other combos reject
         with pytest.raises(SystemExit, match="one parallelism"):
             drv.main(["--synthetic", "8", "--pipeline", "2",
-                      "--tensor-parallel", "2"])
+                      "--seq-parallel", "2"])
+        with pytest.raises(SystemExit, match="one parallelism"):
+            drv.main(["--synthetic", "8", "--tensor-parallel", "2",
+                      "--expert-parallel", "2", "--moe-experts", "2"])
         with pytest.raises(SystemExit, match="moe-experts"):
             drv.main(["--synthetic", "8", "--expert-parallel", "2"])
         with pytest.raises(SystemExit, match="moe-experts"):
