@@ -27,6 +27,44 @@ def test_pinned_artifact_meets_protocol():
     assert rec["config"]["driver"] == "bigdl_tpu.models.lenet.train"
 
 
+def test_pinned_r05_artifact_meets_protocol():
+    """Round-5 artifact: TWO legs — the LeNet protocol plus the
+    above-LeNet-scale point (the unmodified VGG-16 CIFAR-10 driver on
+    real digit images in CIFAR binary format, BASELINE config #2)."""
+    path = os.path.join(REPO, "ACCURACY_r05.json")
+    assert os.path.exists(path), "ACCURACY_r05.json missing"
+    with open(path) as f:
+        rec = json.load(f)
+    by_metric = {p["metric"]: p for p in rec["points"]}
+    assert by_metric["lenet_digits_top1"]["value"] >= 0.98
+    vgg = by_metric["vgg16_cifar_driver_digits_top1"]
+    assert vgg["value"] >= 0.90, vgg
+    assert "vgg" in vgg["config"]["driver"]
+
+
+def test_digits_as_cifar_roundtrips_through_driver_ingest(tmp_path):
+    """The r05 VGG leg's DATA PATH: real digit images written by
+    ``make_digits_cifar`` must round-trip through the driver's
+    production ``load_cifar10`` binary-batch parser with intact labels
+    and pixel content.  (The 30-epoch 98.3% convergence itself runs on
+    the chip via ``accuracy.py`` — a single VGG-16 CPU epoch is ~9 min,
+    unaffordable in the suite, so the suite pins ingest + artifact.)"""
+    from accuracy import make_digits_cifar
+    from bigdl_tpu.dataset.datasets import load_cifar10
+
+    n_train, n_test = make_digits_cifar(str(tmp_path))
+    train = load_cifar10(str(tmp_path), "train")
+    test = load_cifar10(str(tmp_path), "test")
+    assert len(train) == n_train and len(test) == n_test
+    labs = sorted({int(im.label) for im in train})
+    assert labs == list(range(1, 11)), labs     # 1-based, all 10 digits
+    img = train[0].data
+    assert img.shape == (32, 32, 3)
+    # grey replicated across channels survives the BGR flip unchanged
+    np.testing.assert_array_equal(img[..., 0], img[..., 2])
+    assert img.max() > 100, "pixels lost dynamic range in the round-trip"
+
+
 @pytest.mark.slow
 def test_driver_reaches_accuracy_on_real_digits(tmp_path, capsys):
     """Shortened re-run of the artifact protocol: real data through the
