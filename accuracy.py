@@ -197,6 +197,14 @@ def main():
     if unknown or not legs:
         raise SystemExit(f"--legs must name at least one of "
                          f"{sorted(known)}; got {args.legs!r}")
+    if set(legs) != set(known) and args.out == "ACCURACY_r05.json":
+        # a partial re-run must not clobber the pinned two-leg artifact
+        # with a one-leg record (the schema test would then fail on the
+        # missing metric)
+        raise SystemExit(
+            f"--legs {args.legs!r} runs a subset of the artifact's legs; "
+            "pass an explicit --out so the pinned ACCURACY_r05.json "
+            "(which carries ALL legs) is not overwritten")
     points = [known[l](args) for l in legs]
     record = dict(points[0])
     record["points"] = points

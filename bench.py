@@ -474,24 +474,29 @@ def bench_realdata(batch: int = 128, steps: int = 20, warmup: int = 4,
          f"{rate_u8:,.1f} img/s (sustained median {med_u8:,.1f})")
     best_med = max(med_u8, med_f32)
     # re-sample the upload roofline AFTER training: the tunnel's
-    # bandwidth drifts tens of percent within minutes, so a bound built
-    # from a single pre-training sample mis-scores the runs
+    # bandwidth drifts tens of percent within minutes, so a single
+    # sample mis-scores the runs.  The roofline is therefore a RANGE
+    # [pre, post], and the e2e score is reported against both edges.
     u8_bps2, u8_imgs2 = upload_rate(u8)
     drift = u8_imgs2 / u8_imgs
-    u8_mean = (u8_imgs + u8_imgs2) / 2.0
-    # the budget the framework cannot beat on this rig: every image must
-    # be ingested on the host, cross the degraded link, AND be stepped,
-    # serially (the overlap probe above and r4's dispatch-against-
-    # in-flight-transfer measurement both show overlap is
-    # counterproductive on this tunnel), so the bound harmonically
-    # composes the three rates
+    # per-sample ceiling: ingest overlaps in the producer threads (it is
+    # NOT serial with the device work), while upload serializes with
+    # dispatch on this tunnel (the overlap probe above) — so the
+    # steady-state ceiling at an upload rate U is
+    # min(ingest, 1/(1/U + 1/compute)).  The link is nonstationary, so
+    # the two samples bracket the regime the training iterations saw;
+    # a sustained median outside the bracket means the link moved
+    # further than the samples caught.
     compute = synthetic_rate or 1834.0   # resident-input step rate
-    serial_bound = 1.0 / (1.0 / ingest_rate + 1.0 / u8_mean +
-                          1.0 / compute)
+
+    def ceiling(upload):
+        return min(ingest_rate, 1.0 / (1.0 / upload + 1.0 / compute))
+
+    bounds = sorted([ceiling(u8_imgs), ceiling(u8_imgs2)])
     _log(f"  upload roofline re-sample: {u8_imgs2:,.1f} img/s "
-         f"(drift x{drift:.2f}); serial bound {serial_bound:,.1f} img/s; "
-         f"e2e sustained {best_med:,.1f} = "
-         f"{best_med / serial_bound:.0%} of bound")
+         f"(drift x{drift:.2f}); transfer-bound ceiling "
+         f"[{bounds[0]:,.1f}, {bounds[1]:,.1f}] img/s; uint8 e2e "
+         f"sustained {med_u8:,.1f}")
     stages = {"seqfile_read_recs_per_sec": round(read_rate, 1),
               "jpeg_decode_imgs_per_sec": round(decode_rate, 1),
               "native_assemble_imgs_per_sec": round(assemble_rate, 1),
@@ -504,14 +509,17 @@ def bench_realdata(batch: int = 128, steps: int = 20, warmup: int = 4,
               "upload_f32_imgs_per_sec": round(f32_imgs, 1),
               "overlap_probe_s": round(overlap_s, 2),
               "overlap_serial_s": round(serial_s, 2),
-              "serial_bound_imgs_per_sec": round(serial_bound, 1),
+              "transfer_ceiling_imgs_per_sec": [round(bounds[0], 1),
+                                                round(bounds[1], 1)],
               "train_f32_upload_imgs_per_sec": round(rate_f32, 1),
+              "train_u8_sustained_median_imgs_per_sec": round(med_u8, 1),
               "sustained_median_imgs_per_sec": round(best_med, 1),
-              # the bound is built from the uint8 layout's upload rate,
-              # so it scores the uint8 leg's sustained median — not
-              # best_med, which may come from the f32 leg on a
-              # stall-heavy run
-              "e2e_sustained_vs_bound": round(med_u8 / serial_bound, 3),
+              # the uint8 leg's sustained median scored against both
+              # roofline samples' ceilings: inside (or above) the
+              # bracket = the framework delivers whatever the drifting
+              # link allows
+              "e2e_vs_ceiling_range": [round(med_u8 / bounds[1], 3),
+                                       round(med_u8 / bounds[0], 3)],
               "host_cores": os.cpu_count()}
     return max(rate_u8, rate_f32), stages
 
@@ -601,13 +609,17 @@ def main():
     #   remat buys capacity, not speed, at this arithmetic intensity.
     # - 1b_remat: 1.04B params (d2048/L18) at B4, FULL per-block remat —
     #   the >= 1B single-chip point that cannot exist without remat
-    #   (params+momentum+grads alone ~12.5GB).  52.5% useful-MFU = ~70%
-    #   hardware utilization once the extra full forward (8/6 FLOPs) is
-    #   counted.  537M/B16+remat dies in the backend compile helper
-    #   (HTTP 500), not HBM — same crash class as T16384 standard
-    #   attention (see docs/longctx_t16384_repro.md).
-    # Flash attention re-measured r3 at the base shape is slower than
-    # XLA's fused path (0.68x), so the default attention stays.
+    #   (params+momentum+grads alone ~12.5GB).  537M/B16+remat dies in
+    #   the backend compile helper (HTTP 500), not HBM — same crash
+    #   class as T16384 standard attention (docs/longctx_t16384_repro.md).
+    # All four legs run the TUNED pallas flash kernel
+    # (_flash_block_sizes): measured r5, it beats XLA's fused standard
+    # path at every one of these shapes (+17-21% on the dense legs; the
+    # current numbers live in bench_lm.json — this comment stays
+    # number-free so it cannot go stale against the artifact).  The r3
+    # "flash loses at T2048" rejection was the stock 128-tile default.
+    # Standard attention stays the MODULE default (exact numerics
+    # parity, GSPMD-tp compatible); perf-critical dense paths opt in.
     # Failures here must not touch the headline metric.
     lm_configs = [
         ("transformer_lm_train_tokens_per_sec",
@@ -628,6 +640,9 @@ def main():
 
             lm = transformer_lm(v, d_model=d, n_head=h, n_layers=nl,
                                 max_len=t, remat=remat)
+            for m in lm.modules():
+                if isinstance(m, nn.MultiHeadAttention):
+                    m.flash = True
             r_lm = bench_model(
                 lm, b, (t,), v, steps=args.steps,
                 precision="bf16",
@@ -654,6 +669,7 @@ def main():
                                     "n_layers": nl, "n_head": h, "vocab": v,
                                     "params_m": round(n_params / 1e6, 1),
                                     "precision": "bf16",
+                                    "attention": "flash_tuned",
                                     "remat": ("full" if remat is True
                                               else remat or "off")}}
             base_path = os.path.join(
@@ -679,6 +695,21 @@ def main():
     # T16384 (bench_longctx.json).  Failures must not touch the headline.
     try:
         lc = bench_longctx(steps=max(4, args.steps // 2))
+
+        def _rate(t, mode):
+            for p in lc:
+                if p["seq_len"] == t and p["mode"] == mode:
+                    return p.get("tokens_per_sec")
+            return None
+
+        f8, s8 = _rate(8192, "flash"), _rate(8192, "standard")
+        f16 = _rate(16384, "flash")
+        # the verdict is FORMATTED FROM THIS RUN'S POINTS so the artifact
+        # can never contradict itself across re-runs
+        ratio8 = (f"{f8 / s8:.2f}x standard at T8192"
+                  if f8 and s8 else "standard@T8192 unmeasured this run")
+        t16 = (f"{f16 / 1e3:.1f}k tok/s at T16384"
+               if f16 else "T16384 flash unmeasured this run")
         with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "bench_longctx.json"), "w") as f:
             json.dump({"config": {"d_model": 1024, "n_layers": 8,
@@ -686,16 +717,17 @@ def main():
                                   "precision": "bf16"},
                        "points": lc,
                        "verdict": "TUNED flash (1024-sq tiles, "
-                                  "_flash_block_sizes) wins decisively at "
-                                  "long context: 1.8x standard at T8192 "
-                                  "and 63k tok/s at T16384 where one-shot "
-                                  "standard exhausts HBM on saved O(T^2) "
-                                  "residuals (docs/longctx_t16384_repro"
-                                  ".md); the r4 '0.58x' was the stock "
-                                  "128-tile default.  chunked scan and "
-                                  "per-block remat are the pure-XLA "
-                                  "fallback paths; standard still wins "
-                                  "at T<=4k"}, f, indent=1)
+                                  "_flash_block_sizes) wins at every "
+                                  f"measured shape: {ratio8}, {t16} "
+                                  "where one-shot standard exhausts HBM "
+                                  "on saved O(T^2) residuals (docs/"
+                                  "longctx_t16384_repro.md), and wins "
+                                  "at T2048 too (bench_lm.json); the "
+                                  "r3/r4 flash-loses results were the "
+                                  "stock 128-tile default.  chunked "
+                                  "scan and per-block remat are the "
+                                  "pure-XLA fallback paths"},
+                      f, indent=1)
     except Exception as e:  # diagnostic only
         _log(f"long-context bench skipped: {e}")
 
@@ -724,33 +756,40 @@ def main():
                                  "DistriOptimizer fused bf16 step with "
                                  "nn.ChannelNormalize on device",
                      "analysis": "the wall on THIS rig is the axon tunnel "
-                                 "client, not the framework — now PINNED "
-                                 "by an isolated upload roofline at the "
-                                 "exact batch payload (stages: uint8 and "
-                                 "f32 MB/s, sampled before AND after the "
-                                 "runs because the link drifts tens of "
-                                 "percent within minutes). The serial "
-                                 "bound composes ingest + upload + "
-                                 "resident-input compute harmonically; "
-                                 "the overlap probe shows hiding the "
-                                 "upload behind compute buys nothing "
-                                 "here (dispatching against an in-flight "
-                                 "bulk transfer serializes in the tunnel "
-                                 "client, re-confirming r4), so the "
-                                 "bound IS the budget and "
-                                 "e2e_sustained_vs_bound scores the "
-                                 "framework against it; residual <1.0 "
-                                 "is within the pinned link drift. "
-                                 "Framework-side rates measured "
-                                 "independently: MT ingest sustains "
-                                 "~650-840 img/s on this 1-core host "
-                                 "(jpeg-decode-bound; the pool scales "
-                                 "with cores) and the identical "
-                                 "DistriOptimizer step runs ~1850 img/s "
-                                 "on resident inputs. The uint8+device-"
-                                 "normalize layout (4x fewer link bytes) "
-                                 "nearly doubles end-to-end throughput "
-                                 "here and is the right layout on any "
+                                 "client, not the framework — PINNED by "
+                                 "an isolated upload roofline at the "
+                                 "exact batch payload (uint8 and f32 "
+                                 "MB/s), sampled before AND after the "
+                                 "runs because the link's bandwidth "
+                                 "drifts tens of percent within minutes "
+                                 "(upload_link_drift). The two samples "
+                                 "bracket a transfer-bound ceiling "
+                                 "(ingest overlaps in producer threads; "
+                                 "upload serializes with dispatch — the "
+                                 "overlap probe shows double-buffering "
+                                 "buys nothing here, re-confirming r4), "
+                                 "and e2e_vs_ceiling_range scores the "
+                                 "uint8 leg's SUSTAINED MEDIAN against "
+                                 "both edges: inside or above the "
+                                 "bracket means the framework delivers "
+                                 "whatever the drifting link allows. "
+                                 "The stall-inclusive MEAN (the "
+                                 "headline 'value') can land far below "
+                                 "the median when the link collapses "
+                                 "mid-run for multiple seconds — "
+                                 "compare sustained_median_imgs_per_sec "
+                                 "before reading the mean as a "
+                                 "framework number. Framework-side "
+                                 "rates measured independently: MT "
+                                 "ingest sustains ~650-840 img/s on "
+                                 "this 1-core host (jpeg-decode-bound; "
+                                 "the pool scales with cores) and the "
+                                 "identical DistriOptimizer step runs "
+                                 "~1850-2030 img/s on resident inputs. "
+                                 "The uint8+device-normalize layout (4x "
+                                 "fewer link bytes) roughly doubled "
+                                 "end-to-end in calm-link rounds (r4) "
+                                 "and is the right layout on any "
                                  "deployment; on a standard PCIe TPU "
                                  "host the 19 MB uint8 batch transfer "
                                  "is ~2 ms and end-to-end becomes "

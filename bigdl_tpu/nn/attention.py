@@ -141,17 +141,22 @@ class MultiHeadAttention(Module):
 
     ``flash``: opt-in TPU pallas flash-attention kernel with v5e-tuned
     tile sizes (:func:`_flash_block_sizes` — the stock 128 defaults are
-    3.9-6.3x slower).  Measured r5 in the full jitted train step
-    (bench_longctx.json): flash WINS beyond ~T8k once tuned — the r4
-    "0.58x at T8192" was the untuned default.  At T16384 the one-shot
-    standard path exhausts HBM on saved O(T^2) residuals beyond 2 layers
-    (docs/longctx_t16384_repro.md); flash, ``chunk``, or per-block remat
-    all recover it.  Default (False) stays the standard path (it wins at
-    T<=4k); pass ``True`` to require the kernel (raises when the
-    backend/shape constraints aren't met; self-attention only — the
-    kernel's causal mask is top-left aligned, which diverges from the
-    reference's bottom-right-aligned mask when Tq != Tkv).  Revisit per
-    hardware generation.
+    3.9-6.3x slower and the reason earlier rounds measured flash losing).
+    Measured r5 in the full jitted train step: flash WINS at every
+    realistic shape tried — T2048/B8 +21%, 537M/T2048 +17% (76.0%
+    MFU), T8192 1.86x, T16384 65k tok/s where the one-shot standard path
+    exhausts HBM on saved O(T^2) residuals beyond 2 layers
+    (docs/longctx_t16384_repro.md; ``chunk`` or per-block remat also
+    recover that shape).  Default (False) stays the standard path — it
+    is bit-exact against the other paths, composes with the GSPMD head
+    split (pallas kernels do not partition), and has no shape
+    constraints; perf-critical dense training opts in (bench.py's LM
+    legs do).  flash=True raises when the backend/shape constraints
+    aren't met (TPU only, T % 128 == 0, head_dim % 128 == 0,
+    self-attention with Tq == Tkv — the kernel's causal mask is
+    top-left aligned, which diverges from the reference's
+    bottom-right-aligned mask when Tq != Tkv).  Revisit per hardware
+    generation.
 
     ``chunk=N``: the pure-XLA q-blockwise path (:func:`chunked_attention`)
     — same numerics as standard (incl. the bottom-right-aligned causal
