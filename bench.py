@@ -226,6 +226,7 @@ def bench_longctx(steps: int = 5):
             (16384, "chunked", lambda: run_jit(16384, "chunked")),
             (16384, "standard_remat",
              lambda: run_jit(16384, "standard_remat")),
+            (32768, "flash", lambda: run_jit(32768, "flash")),
             (16384, "standard", lambda: run_jit(16384, "standard"))]
     records = []
     for t, mode, fn in plan:
