@@ -245,6 +245,80 @@ def bench_longctx(steps: int = 5):
     return records
 
 
+def bench_inference(steps: int = 20, warmup: int = 4):
+    """Serving-side throughput: the jitted eval-mode forward (the
+    reference's Predictor/LocalPredictor hot path,
+    ``optim/LocalPredictor.scala:37``, minus host batching — measured
+    as pure device throughput with a full dispatch queue).
+
+    Two points, both bf16 via the same ``mixed_precision_forward`` the
+    trainers use: ResNet-50 b128 images/s and the 134M-param LM forward
+    (B8/T2048, tuned flash) tokens/s.  Returns per-point records."""
+    import jax
+    import jax.numpy as jnp
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.optim.optimizer import mixed_precision_forward
+
+    def run(model, x, n_items):
+        model.evaluate()
+        model._ensure_init()
+        params, state = model.params, model.state
+
+        @jax.jit
+        def fwd(p, xb):
+            out, _ = mixed_precision_forward(model, p, xb, state,
+                                             "bf16", False, None)
+            return out
+
+        xb = jnp.asarray(x)
+        t_c = time.time()
+        fwd(params, xb).block_until_ready()
+        _log(f"  compile+first forward: {time.time() - t_c:.1f}s")
+        out = None
+        for _ in range(warmup):
+            out = fwd(params, xb)
+        out.block_until_ready()
+        t0 = time.time()
+        # async dispatch keeps the device queue full; block once at the
+        # end — serving throughput, not per-call host latency.  Only the
+        # LAST output is retained: the LM point's log-probs are ~1 GB
+        # per call, so holding all `steps` of them would exhaust HBM.
+        for _ in range(steps):
+            out = fwd(params, xb)
+        out.block_until_ready()
+        dt = (time.time() - t0) / steps
+        return n_items / dt, dt * 1e3
+
+    records = []
+    rng = np.random.RandomState(0)
+
+    from bigdl_tpu.models.resnet import resnet, model_init, DatasetType
+    r50 = model_init(resnet(1000, depth=50, dataset=DatasetType.IMAGENET))
+    rate, ms = run(r50, rng.uniform(-1, 1, (128, 3, 224, 224))
+                   .astype(np.float32), 128)
+    _log(f"  inference resnet50 b128 bf16: {rate:,.0f} img/s ({ms:.1f} ms)")
+    records.append({"model": "resnet50", "batch": 128,
+                    "value": round(rate, 1), "unit": "images/sec",
+                    "step_ms": round(ms, 2)})
+    del r50
+
+    from bigdl_tpu.models.transformer import transformer_lm
+    v, t = 16384, 2048
+    lm = transformer_lm(v, d_model=1024, n_head=8, n_layers=8, max_len=t)
+    for m in lm.modules():
+        if isinstance(m, nn.MultiHeadAttention):
+            m.flash = True
+    rate, ms = run(lm, rng.randint(1, v + 1, (8, t)).astype(np.float32),
+                   8 * t)
+    _log(f"  inference transformer-lm 134M B8/T2048 bf16 flash: "
+         f"{rate:,.0f} tokens/s ({ms:.1f} ms)")
+    records.append({"model": "transformer_lm_134m", "batch": 8,
+                    "seq_len": t, "value": round(rate, 0),
+                    "unit": "tokens/sec", "step_ms": round(ms, 2)})
+    return records
+
+
 def _make_bench_seqfiles(root: str, n_images: int, files: int = 10):
     """Write a synthetic-image SequenceFile set ONCE (cached across runs):
     256x256 JPEG q90 — the reference's ImageNet seqfile protocol stores
@@ -691,6 +765,16 @@ def main():
         with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "bench_lm.json"), "w") as f:
             json.dump(out, f, indent=1)
+
+    # Inference leg: eval-mode forward throughput (the Predictor hot
+    # path) — bench_infer.json.  Failures must not touch the headline.
+    try:
+        infer = bench_inference(steps=max(10, args.steps))
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "bench_infer.json"), "w") as f:
+            json.dump({"points": infer}, f, indent=1)
+    except Exception as e:  # diagnostic only
+        _log(f"inference leg skipped: {e}")
 
     # Long-context leg: the attention-path comparison measured AT T8192 /
     # T16384 (bench_longctx.json).  Failures must not touch the headline.

@@ -156,8 +156,9 @@ def transformer_lm(vocab_size: int, d_model: int = 128, n_head: int = 4,
     tags Megatron splits (train on a ``("data", "model")`` mesh —
     ``--tensor-parallel``).  ``remat`` wraps every decoder block in
     :class:`~bigdl_tpu.nn.Remat` activation checkpointing — ``True`` saves
-    nothing per block, ``"dots"`` saves matmul outputs (driver
-    ``--remat``); identical numerics, O(layers) less activation memory."""
+    nothing per block, ``"dots"`` saves matmul outputs, ``"save_attn"``
+    saves only the tagged attention context (driver ``--remat``);
+    identical numerics, O(layers) less activation memory."""
     m = (nn.Sequential()
          .add(nn.LookupTable(vocab_size, d_model))
          .add(PositionalEncoding(d_model, max_len)))

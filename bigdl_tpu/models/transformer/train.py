@@ -92,10 +92,12 @@ def main(argv=None):
                         "mesh axis (sets --layers S)")
     p.add_argument("--n-micro", type=int, default=4,
                    help="GPipe microbatches per replica (with --pipeline)")
-    p.add_argument("--remat", choices=["full", "dots"], default=None,
+    p.add_argument("--remat", choices=["full", "dots", "save_attn"],
+                   default=None,
                    help="activation-checkpoint every decoder block: 'full' "
                         "saves nothing per block, 'dots' keeps matmul "
-                        "outputs (trade FLOPs for HBM — how the >=1B "
+                        "outputs, 'save_attn' keeps only the attention "
+                        "context (trade FLOPs for HBM — how the >=1B "
                         "single-chip point fits)")
     args = p.parse_args(argv)
     driver_utils.init_logging()
@@ -113,7 +115,7 @@ def main(argv=None):
     if args.moe_top_k != 1 and not args.moe_experts:
         raise SystemExit("--moe-top-k needs --moe-experts")
 
-    remat = {"full": True, "dots": "dots", None: False}[args.remat]
+    remat = {"full": True, None: False}.get(args.remat, args.remat)
 
     if args.synthetic:
         records = _synthetic(args.synthetic, args.seq_len)

@@ -18,6 +18,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
 from bigdl_tpu.nn import init as init_methods
 from bigdl_tpu.nn.module import Module
@@ -306,6 +307,11 @@ class MultiHeadAttention(Module):
             out = jnp.transpose(out, (0, 2, 1, 3))
         else:
             out = scaled_dot_product_attention(q, k, v, causal=self.causal)
+        # names the attention context for selective rematerialization:
+        # nn.Remat(policy="save_attn") saves THIS tensor (O(B*T*d) per
+        # block) so the VJP recomputes only projections/elementwise, never
+        # the attention kernel itself.  A no-op outside jax.checkpoint.
+        out = checkpoint_name(out, "attn_ctx")
         bsz, t = out.shape[0], out.shape[1]
         # -1: local heads * head_dim under the explicit Megatron split
         out = out.reshape(bsz, t, -1) @ params["wo"]

@@ -437,6 +437,13 @@ class Remat(Container):
       (``jax.checkpoint_policies.dots_with_no_batch_dims_saveable``):
       only cheap elementwise/norm ops recompute, a good default when the
       span is matmul-dominated;
+    - ``"save_attn"`` — save ONLY tensors tagged ``attn_ctx``
+      (:class:`~bigdl_tpu.nn.attention.MultiHeadAttention` names its
+      attention context): one O(B*T*d) residual per block keeps the
+      attention kernel (flash/chunked/standard) out of the VJP's
+      recompute while projections/elementwise still remat — the
+      middle ground where ``"dots"`` exceeds HBM but full recompute
+      wastes the most expensive op;
     - any ``jax.checkpoint_policies`` callable.
 
     Implemented as a Container with one child so ``modules()`` walks,
@@ -463,9 +470,12 @@ class Remat(Container):
             return None
         if self.policy == "dots":
             return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        if self.policy == "save_attn":
+            return jax.checkpoint_policies.save_only_these_names("attn_ctx")
         raise ValueError(
             f"unknown remat policy {self.policy!r}: expected None, "
-            "'nothing', 'dots', or a jax.checkpoint_policies callable")
+            "'nothing', 'dots', 'save_attn', or a jax.checkpoint_policies "
+            "callable")
 
     def apply(self, params, input, state, training=False, rng=None):
         inner = self.children[0]

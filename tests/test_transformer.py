@@ -39,7 +39,7 @@ class TestTransformerLM:
         np.testing.assert_allclose(a[0, :-1], b[0, :-1], atol=1e-5)
         assert not np.allclose(a[0, -1], b[0, -1])
 
-    @pytest.mark.parametrize("policy", [True, "dots"])
+    @pytest.mark.parametrize("policy", [True, "dots", "save_attn"])
     def test_remat_matches_nonremat_bitwise(self, policy):
         """Activation checkpointing is a memory schedule, not a numerics
         change: the loss must match the non-remat model bit-for-bit (the
