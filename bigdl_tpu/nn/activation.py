@@ -26,6 +26,8 @@ class _Elementwise(Module):
 
 
 class ReLU(_Elementwise):
+    """Rectified linear max(x, 0) (reference ``nn/ReLU.scala``)."""
+
     def __init__(self, ip: bool = False, name=None):
         super().__init__(name)
         self.inplace = ip  # meaningless under XLA; kept for API parity
@@ -35,11 +37,15 @@ class ReLU(_Elementwise):
 
 
 class ReLU6(_Elementwise):
+    """ReLU capped at 6: min(max(x, 0), 6) (reference ``nn/ReLU6.scala``)."""
+
     def _fn(self, x):
         return jnp.clip(x, 0.0, 6.0)
 
 
 class LeakyReLU(_Elementwise):
+    """ReLU with fixed negative slope ``negval`` (reference ``nn/LeakyReLU.scala``)."""
+
     def __init__(self, negval: float = 0.01, inplace: bool = False, name=None):
         super().__init__(name)
         self.negval = negval
@@ -49,6 +55,8 @@ class LeakyReLU(_Elementwise):
 
 
 class ELU(_Elementwise):
+    """Exponential linear unit (reference ``nn/ELU.scala``)."""
+
     def __init__(self, alpha: float = 1.0, inplace: bool = False, name=None):
         super().__init__(name)
         self.alpha = alpha
@@ -58,21 +66,29 @@ class ELU(_Elementwise):
 
 
 class Tanh(_Elementwise):
+    """Elementwise tanh (reference ``nn/Tanh.scala``)."""
+
     def _fn(self, x):
         return jnp.tanh(x)
 
 
 class TanhShrink(_Elementwise):
+    """x - tanh(x) (reference ``nn/TanhShrink.scala``)."""
+
     def _fn(self, x):
         return x - jnp.tanh(x)
 
 
 class Sigmoid(_Elementwise):
+    """Logistic sigmoid (reference ``nn/Sigmoid.scala``)."""
+
     def _fn(self, x):
         return jax.nn.sigmoid(x)
 
 
 class LogSigmoid(_Elementwise):
+    """log(sigmoid(x)), numerically stable (reference ``nn/LogSigmoid.scala``)."""
+
     def _fn(self, x):
         return jax.nn.log_sigmoid(x)
 
@@ -85,16 +101,22 @@ class SoftMax(_Elementwise):
 
 
 class SoftMin(_Elementwise):
+    """Softmax of -x over the last dim (reference ``nn/SoftMin.scala``)."""
+
     def _fn(self, x):
         return jax.nn.softmax(-x, axis=-1)
 
 
 class LogSoftMax(_Elementwise):
+    """log-softmax over the last dim (reference ``nn/LogSoftMax.scala``)."""
+
     def _fn(self, x):
         return jax.nn.log_softmax(x, axis=-1)
 
 
 class SoftPlus(_Elementwise):
+    """Smooth ReLU log(1 + exp(beta*x))/beta (reference ``nn/SoftPlus.scala``)."""
+
     def __init__(self, beta: float = 1.0, name=None):
         super().__init__(name)
         self.beta = beta
@@ -104,11 +126,15 @@ class SoftPlus(_Elementwise):
 
 
 class SoftSign(_Elementwise):
+    """x / (1 + |x|) (reference ``nn/SoftSign.scala``)."""
+
     def _fn(self, x):
         return x / (1.0 + jnp.abs(x))
 
 
 class SoftShrink(_Elementwise):
+    """Shrink toward zero by ``lambd``; zero inside the band (reference ``nn/SoftShrinkage.scala``)."""
+
     def __init__(self, lambd: float = 0.5, name=None):
         super().__init__(name)
         self.lambd = lambd
@@ -119,6 +145,8 @@ class SoftShrink(_Elementwise):
 
 
 class HardShrink(_Elementwise):
+    """Zero inside [-lambd, lambd], identity outside (reference ``nn/HardShrink.scala``)."""
+
     def __init__(self, lambd: float = 0.5, name=None):
         super().__init__(name)
         self.lambd = lambd
@@ -128,6 +156,8 @@ class HardShrink(_Elementwise):
 
 
 class HardTanh(_Elementwise):
+    """Clip to [min_value, max_value] (reference ``nn/HardTanh.scala``)."""
+
     def __init__(self, min_value: float = -1.0, max_value: float = 1.0,
                  inplace: bool = False, name=None):
         super().__init__(name)
@@ -138,11 +168,15 @@ class HardTanh(_Elementwise):
 
 
 class Clamp(HardTanh):
+    """HardTanh with mandatory bounds (reference ``nn/Clamp.scala``)."""
+
     def __init__(self, min_value: float, max_value: float, name=None):
         super().__init__(min_value, max_value, name=name)
 
 
 class Threshold(_Elementwise):
+    """x if x > th else replacement value v (reference ``nn/Threshold.scala``)."""
+
     def __init__(self, th: float = 1e-6, v: float = 0.0,
                  ip: bool = False, name=None):
         super().__init__(name)
@@ -165,31 +199,43 @@ class Power(_Elementwise):
 
 
 class Sqrt(_Elementwise):
+    """Elementwise square root (reference ``nn/Sqrt.scala``)."""
+
     def _fn(self, x):
         return jnp.sqrt(x)
 
 
 class Square(_Elementwise):
+    """Elementwise square (reference ``nn/Square.scala``)."""
+
     def _fn(self, x):
         return x * x
 
 
 class Abs(_Elementwise):
+    """Elementwise absolute value (reference ``nn/Abs.scala``)."""
+
     def _fn(self, x):
         return jnp.abs(x)
 
 
 class Log(_Elementwise):
+    """Elementwise natural log (reference ``nn/Log.scala``)."""
+
     def _fn(self, x):
         return jnp.log(x)
 
 
 class Exp(_Elementwise):
+    """Elementwise exponential (reference ``nn/Exp.scala``)."""
+
     def _fn(self, x):
         return jnp.exp(x)
 
 
 class Negative(_Elementwise):
+    """Elementwise negation (reference ``nn/Negative.scala``)."""
+
     def _fn(self, x):
         return -x
 

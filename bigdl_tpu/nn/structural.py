@@ -35,6 +35,8 @@ def _axis(dim_1based: int, ndim: int, n_input_dims: int = -1) -> int:
 
 
 class Identity(Module):
+    """Pass input through unchanged (reference ``nn/Identity.scala``)."""
+
     def apply(self, params, input, state, training=False, rng=None):
         return input, state
 
@@ -124,6 +126,8 @@ class InferReshape(Module):
 
 
 class Squeeze(Module):
+    """Drop size-1 dims (1-based ``dim``, reference ``nn/Squeeze.scala``)."""
+
     def __init__(self, dim: Optional[int] = None, num_input_dims: int = -1,
                  name=None):
         super().__init__(name)
@@ -140,6 +144,8 @@ class Squeeze(Module):
 
 
 class Unsqueeze(Module):
+    """Insert a size-1 dim at 1-based ``pos`` (reference ``nn/Unsqueeze.scala``)."""
+
     def __init__(self, pos: int, num_input_dims: int = -1, name=None):
         super().__init__(name)
         self.pos = pos
@@ -234,6 +240,8 @@ class MaskedSelect(Module):
 
 
 class Max(Module):
+    """Max over a 1-based dim (reference ``nn/Max.scala``)."""
+
     def __init__(self, dim: int = 1, num_input_dims: int = -1, name=None):
         super().__init__(name)
         self.dim = dim
@@ -245,6 +253,8 @@ class Max(Module):
 
 
 class Min(Module):
+    """Min over a 1-based dim (reference ``nn/Min.scala``)."""
+
     def __init__(self, dim: int = 1, num_input_dims: int = -1, name=None):
         super().__init__(name)
         self.dim = dim
@@ -256,6 +266,8 @@ class Min(Module):
 
 
 class Mean(Module):
+    """Mean over a 1-based dim (reference ``nn/Mean.scala``)."""
+
     def __init__(self, dimension: int = 1, n_input_dims: int = -1,
                  squeeze: bool = True, name=None):
         super().__init__(name)
@@ -269,6 +281,8 @@ class Mean(Module):
 
 
 class Sum(Module):
+    """Sum over a 1-based dim (reference ``nn/Sum.scala``)."""
+
     def __init__(self, dimension: int = 1, n_input_dims: int = -1,
                  size_average: bool = False, squeeze: bool = True, name=None):
         super().__init__(name)
@@ -326,6 +340,8 @@ class Padding(Module):
 
 
 class SpatialZeroPadding(Module):
+    """Zero-pad (or crop, negative) NCHW spatial borders (reference ``nn/SpatialZeroPadding.scala``)."""
+
     def __init__(self, pad_left: int, pad_right: int = None,
                  pad_top: int = None, pad_bottom: int = None, name=None):
         super().__init__(name)

@@ -201,26 +201,36 @@ class CAddTable(_BinaryTableOp):
 
 
 class CSubTable(_BinaryTableOp):
+    """Elementwise a - b of a Table [a, b] (reference ``nn/CSubTable.scala``)."""
+
     def _op(self, a, b):
         return a - b
 
 
 class CMulTable(_BinaryTableOp):
+    """Elementwise product of all Table elements (reference ``nn/CMulTable.scala``)."""
+
     def _op(self, a, b):
         return a * b
 
 
 class CDivTable(_BinaryTableOp):
+    """Elementwise a / b of a Table [a, b] (reference ``nn/CDivTable.scala``)."""
+
     def _op(self, a, b):
         return a / b
 
 
 class CMaxTable(_BinaryTableOp):
+    """Elementwise maximum of all Table elements (reference ``nn/CMaxTable.scala``)."""
+
     def _op(self, a, b):
         return jnp.maximum(a, b)
 
 
 class CMinTable(_BinaryTableOp):
+    """Elementwise minimum of all Table elements (reference ``nn/CMinTable.scala``)."""
+
     def _op(self, a, b):
         return jnp.minimum(a, b)
 
