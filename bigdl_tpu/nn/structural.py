@@ -340,7 +340,9 @@ class Padding(Module):
 
 
 class SpatialZeroPadding(Module):
-    """Zero-pad (or crop, negative) NCHW spatial borders (reference ``nn/SpatialZeroPadding.scala``)."""
+    """Zero-pad (or crop, negative) NCHW spatial borders (reference
+    ``nn/SpatialZeroPadding.scala`` — its negative pads ``narrow`` the
+    input; ``lax.pad``'s negative edge config is the same operation)."""
 
     def __init__(self, pad_left: int, pad_right: int = None,
                  pad_top: int = None, pad_bottom: int = None, name=None):
@@ -351,9 +353,13 @@ class SpatialZeroPadding(Module):
         self.pb = pad_bottom if pad_bottom is not None else pad_left
 
     def apply(self, params, input, state, training=False, rng=None):
-        pads = [(0, 0)] * (input.ndim - 2) + [(self.pt, self.pb),
-                                              (self.pl, self.pr)]
-        return jnp.pad(input, pads), state
+        if (input.shape[-1] + self.pl + self.pr < 1 or
+                input.shape[-2] + self.pt + self.pb < 1):
+            raise ValueError("input is too small")
+        cfg = ([(0, 0, 0)] * (input.ndim - 2) +
+               [(self.pt, self.pb, 0), (self.pl, self.pr, 0)])
+        zero = jnp.asarray(0, input.dtype)
+        return jax.lax.pad(input, zero, cfg), state
 
 
 class GradientReversal(Module):

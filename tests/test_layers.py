@@ -290,6 +290,21 @@ class TestStructural:
         assert nn.SpatialZeroPadding(1, 2, 3, 4).forward(
             rand(1, 2, 5, 5)).shape == (1, 2, 12, 8)
 
+    def test_spatial_zero_padding_negative_crops(self):
+        """Negative pads crop the matching border (reference
+        ``nn/SpatialZeroPadding.scala`` narrows the input)."""
+        x = rand(1, 2, 5, 6)
+        out = nn.SpatialZeroPadding(-1, -2, -1, 0).forward(x)
+        assert out.shape == (1, 2, 4, 3)
+        np.testing.assert_array_equal(out, x[:, :, 1:, 1:-2])
+        # mixed: pad left, crop top
+        out = nn.SpatialZeroPadding(1, 0, -2, 0).forward(x)
+        assert out.shape == (1, 2, 3, 7)
+        np.testing.assert_array_equal(out[:, :, :, 1:], x[:, :, 2:, :])
+        np.testing.assert_array_equal(out[:, :, :, 0], 0)
+        with pytest.raises(ValueError, match="too small"):
+            nn.SpatialZeroPadding(-3, -3).forward(rand(1, 2, 5, 5))
+
     def test_mm_mv_dot(self):
         a, b = rand(2, 3, 4), rand(2, 4, 5)
         assert nn.MM().forward([a, b]).shape == (2, 3, 5)
