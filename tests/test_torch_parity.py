@@ -150,6 +150,21 @@ class TestPoolNormParity:
             np.testing.assert_allclose(_np(m.forward(x)), want,
                                        rtol=RTOL, atol=ATOL)
 
+    def test_spatial_zero_padding_randomized_vs_torch(self):
+        """Randomized pad/crop sweep vs torch ZeroPad2d (negative pads
+        crop there too — reference ``nn/SpatialZeroPadding.scala``)."""
+        rng = np.random.RandomState(11)
+        for _ in range(12):
+            x = rng.normal(size=(2, 3, rng.randint(4, 9),
+                                 rng.randint(4, 9))).astype(np.float32)
+            pl, pr, pt, pb = (int(rng.randint(-2, 3)) for _ in range(4))
+            if (x.shape[3] + pl + pr < 1 or x.shape[2] + pt + pb < 1):
+                continue
+            m = nn.SpatialZeroPadding(pl, pr, pt, pb)
+            want = torch.nn.ZeroPad2d((pl, pr, pt, pb))(_t(x)).numpy()
+            np.testing.assert_allclose(_np(m.forward(x)), want,
+                                       rtol=RTOL, atol=ATOL)
+
     def test_volumetric_max_pooling(self):
         rng = np.random.RandomState(9)
         x = rng.normal(size=(2, 2, 6, 6, 6)).astype(np.float32)
