@@ -182,19 +182,23 @@ class SpatialSubtractiveNormalization(Module):
     def _local_mean(self, input):
         kh, kw = self.kernel.shape
         c = input.shape[1]
-        # depthwise mean filter, same padding, normalised by actual coverage
-        w = jnp.tile(self.kernel[:, :, None, None] / c, (1, 1, 1, c))
+        # one cross-channel mean map (the reference sums the kernel over
+        # every input plane and divides by nInputPlane): kernel/c on each
+        # of the c INPUT features of a single-output conv, same padding,
+        # normalised by actual coverage at the borders
+        w = jnp.tile(self.kernel[:, :, None, None] / c,
+                     (1, 1, c, 1)).astype(input.dtype)
         pad = ((kh // 2, (kh - 1) - kh // 2), (kw // 2, (kw - 1) - kw // 2))
         dn = jax.lax.conv_dimension_numbers(input.shape, w.shape,
                                             ("NCHW", "HWIO", "NCHW"))
         mean = jax.lax.conv_general_dilated(
-            input, w, (1, 1), pad, dimension_numbers=dn,
-            feature_group_count=1)
-        # coverage correction at borders
+            input, w, (1, 1), pad, dimension_numbers=dn)
+        # coverage correction at borders (__init__ normalized the kernel
+        # to sum 1, so cov is the fraction of kernel mass inside the map)
         ones = jnp.ones((1, c) + input.shape[2:], input.dtype)
         cov = jax.lax.conv_general_dilated(
             ones, w, (1, 1), pad, dimension_numbers=dn)
-        mean = mean / jnp.maximum(cov, 1e-8) * jnp.sum(self.kernel)
+        mean = mean / jnp.maximum(cov, 1e-8)
         return jnp.broadcast_to(mean, input.shape)
 
     def apply(self, params, input, state, training=False, rng=None):

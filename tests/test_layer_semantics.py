@@ -1,0 +1,245 @@
+"""Functional semantics for layers with no direct coverage elsewhere.
+
+These are the zoo entries a coverage audit (round 5) found constructed by
+no other test: elementwise/constant maps, binary table ops, gather/mask
+ops, stochastic regularizers, the spatial normalization family, shared /
+transposed conv variants, and the Fast-RCNN-era criterions.  Assertions
+are hand-computed/numpy golden values (SURVEY §4.1 strategy), torch where
+torch has the same op.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+
+
+def rand(*shape):
+    return jnp.asarray(np.random.RandomState(
+        sum(shape) + len(shape)).randn(*shape).astype(np.float32))
+
+
+class TestElementwiseAndConstants:
+    def test_clamp_negative_and_sqrt_square(self):
+        x = rand(3, 4)
+        np.testing.assert_allclose(nn.Clamp(-0.5, 0.5).forward(x),
+                                   np.clip(np.asarray(x), -0.5, 0.5))
+        pos = jnp.abs(x) + 0.1
+        np.testing.assert_allclose(nn.Sqrt().forward(pos),
+                                   np.sqrt(np.asarray(pos)), rtol=1e-6)
+        np.testing.assert_allclose(nn.Square().forward(x),
+                                   np.asarray(x) ** 2, rtol=1e-6)
+
+    def test_add_mul_constants_and_negative(self):
+        x = rand(2, 3)
+        np.testing.assert_allclose(nn.AddConstant(2.5).forward(x),
+                                   np.asarray(x) + 2.5, rtol=1e-6)
+        np.testing.assert_allclose(nn.MulConstant(-3.0).forward(x),
+                                   np.asarray(x) * -3.0, rtol=1e-6)
+        np.testing.assert_allclose(nn.Negative().forward(x),
+                                   -np.asarray(x))
+
+    def test_mul_learnable_scalar_trains(self):
+        m = nn.Mul()
+        x = rand(4, 4)
+        out = m.forward(x)
+        w = float(m.params["weight"])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x) * w,
+                                   rtol=1e-6)
+        m.backward(x, jnp.ones_like(x))
+        np.testing.assert_allclose(float(m.grads["weight"]),
+                                   float(jnp.sum(x)), rtol=1e-5)
+
+    def test_echo_and_contiguous_are_identity(self):
+        x = rand(2, 3)
+        np.testing.assert_array_equal(nn.Contiguous().forward(x), x)
+        np.testing.assert_array_equal(nn.Echo().forward(x), x)
+
+
+class TestTableOps:
+    def test_binary_table_ops(self):
+        a, b = rand(3, 4), jnp.abs(rand(3, 4)) + 0.5
+        for mod, want in [
+                (nn.CDivTable(), np.asarray(a) / np.asarray(b)),
+                (nn.CMaxTable(), np.maximum(np.asarray(a), np.asarray(b))),
+                (nn.CMinTable(), np.minimum(np.asarray(a), np.asarray(b)))]:
+            np.testing.assert_allclose(mod.forward([a, b]), want, rtol=1e-6)
+
+    def test_map_table_shares_the_one_child(self):
+        m = nn.MapTable(nn.Linear(4, 2))
+        a, b = rand(3, 4), rand(5, 4)
+        out = m.forward([a, b])
+        assert out[0].shape == (3, 2) and out[1].shape == (5, 2)
+        # same params applied to both elements
+        lin = m.children[0]
+        w, bias = lin.params["weight"], lin.params["bias"]   # (in, out)
+        np.testing.assert_allclose(np.asarray(out[1]),
+                                   np.asarray(b @ w + bias), rtol=1e-5)
+
+
+class TestGatherMask:
+    def test_index_gathers_1based(self):
+        x = rand(4, 5)
+        idx = jnp.asarray([3.0, 1.0])
+        out = nn.Index(1).forward([x, idx])
+        np.testing.assert_array_equal(out, np.asarray(x)[[2, 0]])
+        out2 = nn.Index(2).forward([x, idx])
+        np.testing.assert_array_equal(out2, np.asarray(x)[:, [2, 0]])
+
+    def test_masked_select_packs_front(self):
+        x = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+        mask = jnp.asarray([[1.0, 0.0], [0.0, 1.0]])
+        out = np.asarray(nn.MaskedSelect().forward([x, mask]))
+        np.testing.assert_array_equal(out, [1.0, 4.0, 0.0, 0.0])
+
+
+class TestStochasticRegularizers:
+    def test_gaussian_dropout_stats_and_eval_identity(self):
+        m = nn.GaussianDropout(0.5)   # stddev = sqrt(0.5/0.5) = 1
+        x = jnp.ones((200, 200))
+        out = np.asarray(m.forward(x))
+        assert abs(out.mean() - 1.0) < 0.02
+        assert abs(out.std() - 1.0) < 0.02
+        m.evaluate()
+        np.testing.assert_array_equal(np.asarray(m.forward(x)), 1.0)
+
+    def test_gaussian_noise_stats_and_eval_identity(self):
+        m = nn.GaussianNoise(0.3)
+        x = jnp.zeros((200, 200))
+        out = np.asarray(m.forward(x))
+        assert abs(out.mean()) < 0.02 and abs(out.std() - 0.3) < 0.02
+        m.evaluate()
+        np.testing.assert_array_equal(np.asarray(m.forward(x)), 0.0)
+
+    def test_l1penalty_identity_forward_sparsity_grad(self):
+        m = nn.L1Penalty(l1weight=0.1)
+        m.training()
+        x = jnp.asarray([[1.5, -2.0, 0.5]])
+        np.testing.assert_array_equal(m.forward(x), x)
+
+        def f(z):
+            out, _ = m.apply({}, z, {}, training=True)
+            return jnp.sum(out * 3.0)
+
+        g = np.asarray(jax.grad(f)(x))
+        # upstream grad 3.0 plus l1weight * sign(x)
+        np.testing.assert_allclose(g, [[3.1, 2.9, 3.1]], rtol=1e-6)
+
+
+class TestSpatialNormalizationFamily:
+    def test_subtractive_kills_constant_input(self):
+        m = nn.SpatialSubtractiveNormalization(2)
+        x = jnp.ones((1, 2, 12, 12)) * 7.0
+        out = np.asarray(m.forward(x))
+        np.testing.assert_allclose(out, 0.0, atol=1e-4)
+
+    def test_subtractive_bf16_input(self):
+        m = nn.SpatialSubtractiveNormalization(2)
+        x = jnp.ones((1, 2, 8, 8), jnp.bfloat16) * 3.0
+        out = m.forward(x)
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(out, np.float32), 0.0,
+                                   atol=0.05)
+
+    def test_subtractive_mean_is_cross_channel(self):
+        """The reference computes ONE mean map across all input planes
+        (kernel summed over channels / nInputPlane) and subtracts it from
+        every plane: channels [2, 6] see mean 4 -> [-2, +2]."""
+        m = nn.SpatialSubtractiveNormalization(2)
+        x = jnp.stack([jnp.full((12, 12), 2.0),
+                       jnp.full((12, 12), 6.0)])[None]
+        out = np.asarray(m.forward(x))
+        np.testing.assert_allclose(out[0, 0], -2.0, atol=1e-4)
+        np.testing.assert_allclose(out[0, 1], 2.0, atol=1e-4)
+
+    def test_divisive_normalizes_scale(self):
+        m = nn.SpatialDivisiveNormalization(1)
+        x = rand(1, 1, 16, 16)
+        out_small = np.asarray(m.forward(x))
+        out_big = np.asarray(m.forward(x * 100.0))
+        # scale-invariant up to the mean-std floor: both land near unit std
+        np.testing.assert_allclose(out_small, out_big, rtol=1e-3)
+
+    def test_contrastive_composes_sub_then_div(self):
+        x = rand(1, 1, 10, 10)
+        want = nn.SpatialDivisiveNormalization(1).forward(
+            nn.SpatialSubtractiveNormalization(1).forward(x))
+        got = nn.SpatialContrastiveNormalization(1).forward(x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5)
+
+    def test_within_channel_lrn_golden(self):
+        size, alpha, beta = 3, 1.0, 0.75
+        x = rand(1, 2, 5, 5)
+        xn = np.asarray(x)
+        sq = xn * xn
+        padded = np.pad(sq, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        window = np.zeros_like(xn)
+        for i in range(size):
+            for j in range(size):
+                window += padded[:, :, i:i + 5, j:j + 5]
+        want = xn / (1.0 + alpha / (size * size) * window) ** beta
+        got = nn.SpatialWithinChannelLRN(size, alpha, beta).forward(x)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+
+
+class TestConvVariants:
+    def test_share_convolution_matches_spatial_convolution(self):
+        share = nn.SpatialShareConvolution(3, 8, 3, 3, 2, 2, 1, 1)
+        plain = nn.SpatialConvolution(3, 8, 3, 3, 2, 2, 1, 1)
+        share._ensure_init()
+        plain.params = share.params
+        x = rand(2, 3, 10, 10)
+        np.testing.assert_array_equal(share.forward(x), plain.forward(x))
+
+    def test_volumetric_full_convolution_vs_torch(self):
+        import torch
+        import torch.nn.functional as F
+        m = nn.VolumetricFullConvolution(2, 3, 2, 2, 2, d_t=2, d_w=2, d_h=2)
+        m._ensure_init()
+        x = rand(1, 2, 3, 4, 4)
+        got = np.asarray(m.forward(x))
+        # our kernel layout (t, h, w, in, out) -> torch (in, out, t, h, w)
+        w = np.transpose(np.asarray(m.params["weight"]), (3, 4, 0, 1, 2))
+        want = F.conv_transpose3d(
+            torch.from_numpy(np.asarray(x)), torch.from_numpy(w),
+            torch.from_numpy(np.asarray(m.params["bias"])),
+            stride=2).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_conv_lstm_peephole_3d_forward(self):
+        rec = nn.Recurrent().add(nn.ConvLSTMPeephole3D(2, 4))
+        x = rand(1, 3, 2, 4, 4, 4)   # (B, T, C, D, H, W)
+        out = np.asarray(rec.forward(x))
+        assert out.shape == (1, 3, 4, 4, 4, 4)
+        assert np.all(np.isfinite(out))
+
+
+class TestRcnnEraCriterions:
+    def test_l1_hinge_embedding(self):
+        a, b = jnp.asarray([1.0, 2.0]), jnp.asarray([0.5, 0.0])
+        d = 2.5
+        crit = nn.L1HingeEmbeddingCriterion(margin=3.0)
+        np.testing.assert_allclose(float(crit.apply([a, b], 1.0)), d)
+        np.testing.assert_allclose(float(crit.apply([a, b], -1.0)), 3.0 - d)
+
+    def test_smooth_l1_with_weights(self):
+        x = jnp.asarray([0.2, 3.0])
+        t = jnp.asarray([0.0, 0.0])
+        inw = jnp.asarray([1.0, 1.0])
+        outw = jnp.asarray([2.0, 0.5])
+        crit = nn.SmoothL1CriterionWithWeights(sigma=1.0, num=2)
+        want = (2.0 * 0.5 * 0.2 ** 2 + 0.5 * (3.0 - 0.5)) / 2
+        np.testing.assert_allclose(float(crit.apply(x, [t, inw, outw])),
+                                   want, rtol=1e-6)
+
+
+class TestScaleLayer:
+    def test_scale_cmul_cadd(self):
+        m = nn.Scale((3,), init_weight=[1.0, 2.0, 3.0],
+                     init_bias=[0.5, 0.0, -0.5])
+        x = rand(2, 3)
+        want = np.asarray(x) * [1.0, 2.0, 3.0] + [0.5, 0.0, -0.5]
+        np.testing.assert_allclose(np.asarray(m.forward(x)), want, rtol=1e-6)
