@@ -60,7 +60,11 @@ class TestElementwiseAndConstants:
 
 class TestTableOps:
     def test_binary_table_ops(self):
-        a, b = rand(3, 4), jnp.abs(rand(3, 4)) + 0.5
+        rng = np.random.RandomState(42)
+        a = jnp.asarray(rng.randn(3, 4).astype(np.float32))
+        b = jnp.asarray(rng.randn(3, 4).astype(np.float32))
+        b = jnp.where(jnp.abs(b) < 0.1, 0.5, b)   # keep the divide tame
+        assert bool(jnp.any(a > b)) and bool(jnp.any(b > a))
         for mod, want in [
                 (nn.CDivTable(), np.asarray(a) / np.asarray(b)),
                 (nn.CMaxTable(), np.maximum(np.asarray(a), np.asarray(b))),
@@ -234,6 +238,61 @@ class TestRcnnEraCriterions:
         want = (2.0 * 0.5 * 0.2 ** 2 + 0.5 * (3.0 - 0.5)) / 2
         np.testing.assert_allclose(float(crit.apply(x, [t, inw, outw])),
                                    want, rtol=1e-6)
+
+
+class TestInitMethods:
+    def test_const_ones_zeros(self):
+        from bigdl_tpu.nn import init
+        key = jax.random.PRNGKey(0)
+        np.testing.assert_array_equal(init.Zeros()(key, (3, 4)), 0.0)
+        np.testing.assert_array_equal(init.Ones()(key, (3, 4)), 1.0)
+        np.testing.assert_array_equal(init.ConstInitMethod(0.25)(key, (5,)),
+                                      0.25)
+
+    def test_statistical_inits(self):
+        from bigdl_tpu.nn import init
+        key = jax.random.PRNGKey(1)
+        w = np.asarray(init.RandomUniform()(key, (400, 100), fan_in=400))
+        bound = 1.0 / np.sqrt(400)
+        assert w.min() >= -bound and w.max() <= bound
+        w = np.asarray(init.Xavier()(key, (400, 100),
+                                     fan_in=400, fan_out=100))
+        b = np.sqrt(6.0 / 500)
+        assert w.min() >= -b and w.max() <= b
+        assert abs(w.std() - b / np.sqrt(3)) < 0.01   # uniform stddev
+        w = np.asarray(init.MsraFiller()(key, (400, 100), fan_in=400))
+        assert abs(w.std() - np.sqrt(2.0 / 400)) < 0.005
+        w = np.asarray(init.RandomNormal(1.0, 0.5)(key, (400, 100)))
+        assert abs(w.mean() - 1.0) < 0.01 and abs(w.std() - 0.5) < 0.01
+
+    def test_bilinear_filler_interpolates(self):
+        """The factor-2 kernel is the Caffe bilinear outer([.25 .75 .75
+        .25]); a stride-2 SpatialFullConvolution with it preserves a
+        constant image in the interior (each output pixel's weights sum
+        to 1 away from the borders)."""
+        from bigdl_tpu.nn import init
+        k = np.asarray(init.BilinearFiller()(jax.random.PRNGKey(0),
+                                             (4, 4, 1, 1)))[:, :, 0, 0]
+        want1d = np.array([0.25, 0.75, 0.75, 0.25])
+        np.testing.assert_allclose(k, np.outer(want1d, want1d), rtol=1e-6)
+        m = nn.SpatialFullConvolution(1, 1, 4, 4, 2, 2, 1, 1, no_bias=True)
+        m.set_init_method(weight_init=init.BilinearFiller())
+        out = np.asarray(m.forward(jnp.ones((1, 1, 5, 5))))
+        assert out.shape == (1, 1, 10, 10)
+        np.testing.assert_allclose(out[0, 0, 1:-1, 1:-1], 1.0, rtol=1e-5)
+
+    def test_set_init_method_on_linear_and_conv(self):
+        from bigdl_tpu.nn import init
+        lin = nn.Linear(4, 3).set_init_method(
+            weight_init=init.ConstInitMethod(2.0),
+            bias_init=init.Zeros())
+        lin._ensure_init()
+        np.testing.assert_array_equal(lin.params["weight"], 2.0)
+        np.testing.assert_array_equal(lin.params["bias"], 0.0)
+        conv = nn.SpatialConvolution(2, 4, 3, 3).set_init_method(
+            weight_init=init.Ones())
+        conv._ensure_init()
+        np.testing.assert_array_equal(conv.params["weight"], 1.0)
 
 
 class TestScaleLayer:
