@@ -145,6 +145,42 @@ class TestImageTransforms:
         assert batches[1].get_input().shape == (1, 3, 8, 8)
 
 
+class TestTransformerPlumbing:
+    def test_chained_transformer_flattens_and_composes(self):
+        from bigdl_tpu.dataset.transformer import (ChainedTransformer,
+                                                   FuncTransformer)
+        double = FuncTransformer(lambda x: x * 2)
+        inc = FuncTransformer(lambda x: x + 1)
+        chain = ChainedTransformer(double, inc)
+        assert list(chain(iter([1, 2]))) == [3, 5]
+        # nesting flattens into one stage list
+        nested = ChainedTransformer(chain, double)
+        assert len(nested.stages) == 3
+        assert list(nested(iter([1]))) == [6]
+
+    def test_reference_name_aliases(self):
+        from bigdl_tpu.dataset import SampleToBatch
+        from bigdl_tpu.dataset.image import (GreyImgNormalizer,
+                                             GreyImgToBatch)
+        assert SampleToBatch is SampleToMiniBatch
+        assert GreyImgNormalizer is ChannelNormalize
+        assert GreyImgToBatch is BGRImgToBatch
+
+    def test_local_img_reader_scales_shorter_side(self, tmp_path):
+        from PIL import Image
+        from bigdl_tpu.dataset.image import LocalImgPath, LocalImgReader
+        arr = np.zeros((10, 20, 3), np.uint8)
+        arr[..., 0] = 200   # red in RGB -> B-last in BGR output
+        p = tmp_path / "img.png"
+        Image.fromarray(arr).save(p)
+        out = next(iter(LocalImgReader(scale_to=16)(
+            [LocalImgPath(str(p), 3.0)])))
+        h, w = out.data.shape[:2]
+        assert h == 16 and w == 32 and out.label == 3.0
+        # BGR channel order: red lands in the LAST channel
+        assert out.data[..., 2].mean() > 150 and out.data[..., 0].mean() < 10
+
+
 class TestText:
     def test_split_tokenize(self):
         sents = list(SentenceSplitter()(["Hello there. How are you?"]))

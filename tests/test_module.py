@@ -166,6 +166,13 @@ class TestGraph:
         wa = g.executions  # smoke: topo order computed
         assert gin.shape == x.shape
 
+    def test_input_factory_node(self):
+        """nn.Input() is the reference's placeholder source node."""
+        inp = nn.Input()
+        out = nn.Linear(4, 2).inputs(inp)
+        g = nn.Graph(inp, out)
+        assert g.forward(rand(3, 4)).shape == (3, 2)
+
     def test_multi_output_graph(self):
         inp = nn.Identity().inputs()
         a = nn.Linear(4, 3).inputs(inp)
