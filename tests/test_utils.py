@@ -233,3 +233,41 @@ def test_batch_prefetcher_blocks_only_large_batches():
     assert calls == [], "small batch must not be blocked"
     pf()
     assert sorted(calls) == [1024, 8 << 20], "large batch blocks all leaves"
+
+
+class TestConfigProperties:
+    def test_resolution_order_override_env_default(self, monkeypatch):
+        from bigdl_tpu.utils import config
+        # table default (shield from any ambient env setting)
+        monkeypatch.delenv("BIGDL_FAILURE_RETRYTIMES", raising=False)
+        assert config.get_int("bigdl.failure.retryTimes") == 5
+        # env var wins over default (dots -> underscores, upper-cased)
+        monkeypatch.setenv("BIGDL_FAILURE_RETRYTIMES", "9")
+        assert config.get_int("bigdl.failure.retryTimes") == 9
+        # programmatic override wins over env
+        config.set_property("bigdl.failure.retryTimes", 3)
+        try:
+            assert config.get_int("bigdl.failure.retryTimes") == 3
+        finally:
+            config.clear_property("bigdl.failure.retryTimes")
+        assert config.get_int("bigdl.failure.retryTimes") == 9
+
+    def test_typed_getters_and_diagnostics(self, monkeypatch):
+        from bigdl_tpu.utils import config
+        monkeypatch.delenv("BIGDL_ENGINETYPE", raising=False)
+        config.set_property("bigdl.summary.flushSecs", "2.5")
+        try:
+            assert config.get_float("bigdl.summary.flushSecs") == 2.5
+        finally:
+            config.clear_property("bigdl.summary.flushSecs")
+        try:
+            for truthy in ("1", "true", "YES", "on", True):
+                config.set_property("bigdl.check.singleton", truthy)
+                assert config.get_bool("bigdl.check.singleton") is True
+            config.set_property("bigdl.check.singleton", "off")
+            assert config.get_bool("bigdl.check.singleton") is False
+        finally:
+            config.clear_property("bigdl.check.singleton")
+        table = config.known_properties()
+        assert table["bigdl.engineType"] == "tpu"
+        assert "bigdl.pipeline.depth" in table
