@@ -99,11 +99,17 @@ def py_read_records(path: str) -> Iterator[Tuple[bytes, bytes]]:
                 if marker != sync:
                     raise IOError(f"bad sync marker in {path}")
                 continue
+            # same sanity cap as the native reader: a flipped length
+            # byte must not become a giant read or a silent short record
+            if rec_len < 0 or rec_len > (1 << 30):
+                raise IOError(f"corrupt SequenceFile record in {path}")
             (key_len,) = struct.unpack(">i", f.read(4))
             if key_len < 0 or key_len > rec_len:
                 raise IOError(f"corrupt SequenceFile record in {path}")
             key = f.read(key_len)
             value = f.read(rec_len - key_len)
+            if len(key) != key_len or len(value) != rec_len - key_len:
+                raise IOError(f"corrupt SequenceFile record in {path}")
             yield key, value
 
 

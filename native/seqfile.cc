@@ -141,6 +141,11 @@ int seqfile_next(void* handle, const char** key, int* klen,
       if (memcmp(sync, r->sync, 16) != 0) return -1;
       continue;
     }
+    // corrupt length bytes must not reach resize(): a flipped bit can
+    // read as ~2 GB and either bad_alloc (which would terminate across
+    // the C ABI) or grind the host allocating it.  Records here are
+    // JPEG frames (MBs); 1 GB is far beyond any legitimate record.
+    if (rec_len < 0 || rec_len > (1 << 30)) return -1;
     int32_t key_len = read_i32be(r->f, &ok);
     if (!ok || key_len < 0 || key_len > rec_len) return -1;
     r->key.resize((size_t)key_len);

@@ -41,6 +41,52 @@ class TestSeqFile:
         back = list(seqfile.py_read_records(p))
         assert back == recs
 
+    @staticmethod
+    def _first_record_offset(path):
+        """Parse the header with the module's own helpers: the first
+        record's rec_len field starts right after the 16-byte sync."""
+        with open(path, "rb") as f:
+            f.read(4)                      # SEQ + version
+            seqfile._read_text(f)          # key class
+            seqfile._read_text(f)          # value class
+            f.read(2)                      # compressed, block
+            f.read(4)                      # metadata count (0)
+            f.read(16)                     # sync
+            return f.tell()
+
+    @pytest.fixture(params=["native", "python"])
+    def reader(self, request):
+        if request.param == "native":
+            if not native_available():
+                pytest.skip("native library unavailable")
+            return seqfile.read_records
+        return seqfile.py_read_records
+
+    def test_truncated_file_raises_not_crashes(self, tmp_path, reader):
+        p = str(tmp_path / "trunc.seq")
+        seqfile.py_write_records(p, iter([(b"k", b"v" * 500)]))
+        import os
+        size = os.path.getsize(p)
+        with open(p, "r+b") as f:
+            f.truncate(size - 100)         # cut inside the value payload
+        with pytest.raises(IOError, match="corrupt"):
+            list(reader(p))
+
+    def test_corrupt_giant_record_length_raises_cheaply(self, tmp_path,
+                                                        reader):
+        """A flipped length byte (0x7FFFFFFF) must surface as 'corrupt',
+        not a ~2 GB allocation, a silent short record (python fallback),
+        or a bad_alloc terminating across the C ABI — both readers
+        sanity-cap rec_len before reading."""
+        p = str(tmp_path / "giant.seq")
+        seqfile.py_write_records(p, iter([(b"k", b"v" * 100)]))
+        off = self._first_record_offset(p)
+        with open(p, "r+b") as f:
+            f.seek(off)
+            f.write(b"\x7f\xff\xff\xff")
+        with pytest.raises(IOError, match="corrupt"):
+            list(reader(p))
+
     def test_image_seqfile_protocol(self, tmp_path):
         p = str(tmp_path / "imgs.seq")
         entries = self._entries()
