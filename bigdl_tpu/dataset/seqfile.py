@@ -103,7 +103,10 @@ def py_read_records(path: str) -> Iterator[Tuple[bytes, bytes]]:
             # byte must not become a giant read or a silent short record
             if rec_len < 0 or rec_len > (1 << 30):
                 raise IOError(f"corrupt SequenceFile record in {path}")
-            (key_len,) = struct.unpack(">i", f.read(4))
+            raw_kl = f.read(4)
+            if len(raw_kl) < 4:
+                raise IOError(f"corrupt SequenceFile record in {path}")
+            (key_len,) = struct.unpack(">i", raw_kl)
             if key_len < 0 or key_len > rec_len:
                 raise IOError(f"corrupt SequenceFile record in {path}")
             key = f.read(key_len)

@@ -62,13 +62,16 @@ class TestSeqFile:
             return seqfile.read_records
         return seqfile.py_read_records
 
-    def test_truncated_file_raises_not_crashes(self, tmp_path, reader):
+    @pytest.mark.parametrize("cut", ["value", "key_len"])
+    def test_truncated_file_raises_not_crashes(self, tmp_path, reader, cut):
         p = str(tmp_path / "trunc.seq")
         seqfile.py_write_records(p, iter([(b"k", b"v" * 500)]))
         import os
-        size = os.path.getsize(p)
         with open(p, "r+b") as f:
-            f.truncate(size - 100)         # cut inside the value payload
+            if cut == "value":             # cut inside the value payload
+                f.truncate(os.path.getsize(p) - 100)
+            else:                          # cut inside the key_len field
+                f.truncate(self._first_record_offset(p) + 5)
         with pytest.raises(IOError, match="corrupt"):
             list(reader(p))
 

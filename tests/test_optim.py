@@ -271,6 +271,22 @@ class TestLocalOptimizerE2E:
         opt.optimize()          # runs without error
 
 
+class TestValidatorNames:
+    def test_validator_over_minibatch_dataset(self):
+        """The reference's Validator API shape (optim/Validator.scala):
+        construct over a MiniBatch dataset, test(methods)."""
+        from bigdl_tpu.optim.evaluator import (DistriValidator,
+                                               LocalValidator, Validator)
+        assert LocalValidator is Validator and DistriValidator is Validator
+        samples = synthetic_separable(64, 4, n_classes=3, seed=5)
+        ds = LocalDataSet(samples).transform(SampleToMiniBatch(16))
+        model = _mlp(4, 3)
+        res = Validator(model, ds).test([optim.Top1Accuracy(),
+                                         optim.Loss(nn.ClassNLLCriterion())])
+        assert 0.0 <= res[0][1].final_result() <= 1.0
+        assert np.isfinite(res[1][1].final_result())
+
+
 class TestRegularizers:
     def test_penalty_values(self):
         from bigdl_tpu.optim.regularizer import (L1L2Regularizer,
