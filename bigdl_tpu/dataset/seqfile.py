@@ -91,8 +91,10 @@ def py_read_records(path: str) -> Iterator[Tuple[bytes, bytes]]:
         sync = f.read(16)
         while True:
             raw = f.read(4)
-            if len(raw) < 4:
+            if not raw:          # clean EOF: zero bytes at a boundary
                 return
+            if len(raw) < 4:     # cut inside the length field
+                raise IOError(f"corrupt SequenceFile record in {path}")
             (rec_len,) = struct.unpack(">i", raw)
             if rec_len == -1:
                 marker = f.read(16)

@@ -132,9 +132,16 @@ int seqfile_next(void* handle, const char** key, int* klen,
                  const char** value, int* vlen) {
   Reader* r = (Reader*)handle;
   for (;;) {
+    // clean EOF is ZERO bytes at a record boundary; 1-3 dangling bytes
+    // mean the file was cut inside the length field — corruption, kept
+    // in lockstep with the python reader
+    uint8_t lb[4];
+    size_t got = fread(lb, 1, 4, r->f);
+    if (got == 0) return 0;
+    if (got != 4) return -1;
+    int32_t rec_len = (int32_t)((uint32_t)lb[0] << 24 | (uint32_t)lb[1] << 16 |
+                                (uint32_t)lb[2] << 8 | (uint32_t)lb[3]);
     bool ok;
-    int32_t rec_len = read_i32be(r->f, &ok);
-    if (!ok) return 0;
     if (rec_len == -1) {  // sync escape
       uint8_t sync[16];
       if (fread(sync, 1, 16, r->f) != 16) return 0;

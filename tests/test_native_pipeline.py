@@ -62,7 +62,7 @@ class TestSeqFile:
             return seqfile.read_records
         return seqfile.py_read_records
 
-    @pytest.mark.parametrize("cut", ["value", "key_len"])
+    @pytest.mark.parametrize("cut", ["value", "key_len", "rec_len"])
     def test_truncated_file_raises_not_crashes(self, tmp_path, reader, cut):
         p = str(tmp_path / "trunc.seq")
         seqfile.py_write_records(p, iter([(b"k", b"v" * 500)]))
@@ -70,10 +70,20 @@ class TestSeqFile:
         with open(p, "r+b") as f:
             if cut == "value":             # cut inside the value payload
                 f.truncate(os.path.getsize(p) - 100)
-            else:                          # cut inside the key_len field
+            elif cut == "key_len":         # cut inside the key_len field
                 f.truncate(self._first_record_offset(p) + 5)
+            else:                          # cut inside rec_len itself
+                f.truncate(self._first_record_offset(p) + 2)
         with pytest.raises(IOError, match="corrupt"):
             list(reader(p))
+
+    def test_clean_eof_at_record_boundary(self, tmp_path, reader):
+        """Zero dangling bytes at a boundary is a clean EOF, not corrupt
+        — the strictness above must not reject well-formed files."""
+        p = str(tmp_path / "clean.seq")
+        recs = [(b"a", b"x" * 37), (b"b", b"y" * 53)]
+        seqfile.py_write_records(p, iter(recs))
+        assert list(reader(p)) == recs
 
     def test_corrupt_giant_record_length_raises_cheaply(self, tmp_path,
                                                         reader):
