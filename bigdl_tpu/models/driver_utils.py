@@ -53,6 +53,11 @@ def base_parser(description: str) -> argparse.ArgumentParser:
                    help="TensorBoard app name (defaults to the driver name)")
     p.add_argument("--synthetic", type=int, default=0, metavar="N",
                    help="train on N synthetic records instead of --folder")
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler trace of 3 steady-state "
+                        "iterations (from iteration 10) into this "
+                        "directory — open with TensorBoard's profile "
+                        "plugin or Perfetto")
     return p
 
 
@@ -103,4 +108,6 @@ def configure(opt, args, default_epochs: int, app_name: str):
         name = args.app_name or app_name
         opt.set_train_summary(TrainSummary(args.log_dir, name))
         opt.set_validation_summary(ValidationSummary(args.log_dir, name))
+    if getattr(args, "profile_dir", None):
+        opt.set_trace_profile(args.profile_dir)
     return opt

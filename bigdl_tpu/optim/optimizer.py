@@ -207,8 +207,33 @@ class Optimizer:
         self.precision: Optional[str] = None   # None = fp32; "bf16" = mixed
         self.moe_aux_weight: float = 0.01      # Switch paper's alpha
         self._step_fn = None
+        self._profile_dir: Optional[str] = None
+        self._profile_start: int = 10
+        self._profile_n: int = 3
 
     # -- fluent setters (reference Optimizer.scala fluent API) ------------
+
+    def set_trace_profile(self, log_dir: str, start_iteration: int = 10,
+                          n_iterations: int = 3) -> "Optimizer":
+        """Capture a ``jax.profiler`` device/host trace of ``n_iterations``
+        steady-state training iterations into ``log_dir`` (xplane + trace
+        viewer files; open with TensorBoard's profile plugin or Perfetto).
+
+        TPU-native counterpart of the per-module ns timing (SURVEY §5.1):
+        the per-module clocks attribute time WITHIN the model graph, the
+        trace shows the whole step — XLA fusions, collectives, host gaps.
+        ``start_iteration`` defaults past compile+warmup so the captured
+        window is the steady state the throughput logs report."""
+        if n_iterations < 1:
+            raise ValueError(f"n_iterations must be >= 1, got {n_iterations}")
+        if start_iteration < 1:
+            raise ValueError(
+                f"start_iteration must be >= 1 (iteration counting is "
+                f"1-based), got {start_iteration}")
+        self._profile_dir = log_dir
+        self._profile_start = start_iteration
+        self._profile_n = n_iterations
+        return self
 
     def set_optim_method(self, method: OptimMethod) -> "Optimizer":
         self.optim_method = method
@@ -431,8 +456,39 @@ class Optimizer:
                 reset_epoch()
 
         fetch = BatchPrefetcher(fetch_batch, on_batch=on_batch)
+        profiling = False
+        profiled = False   # the window fires once, even across resumes
+
+        def stop_profile():
+            nonlocal profiling
+            if profiling:
+                profiling = False
+                try:
+                    # flush first so the traced iterations' device work
+                    # (all dispatched asynchronously) completes inside the
+                    # window...
+                    flush_pending()
+                finally:
+                    # ...but a poisoned queue re-raising must STILL close
+                    # the global profiler session, or the retry loop's
+                    # next start_trace aborts on 'already running'
+                    jax.profiler.stop_trace()
+                logger.info("Profiler trace written to %s",
+                            self._profile_dir)
+
         try:
             while not should_end():
+                # >= not ==: a run resumed past the start iteration still
+                # captures (once) instead of silently skipping the window
+                if (self._profile_dir and not profiled and
+                        state["neval"] >= self._profile_start):
+                    pdir = self._profile_dir
+                    if jax.process_count() > 1:   # one capture per host
+                        pdir = os.path.join(
+                            pdir, f"process_{jax.process_index()}")
+                    jax.profiler.start_trace(pdir)
+                    profiling = profiled = True
+                    profile_end = state["neval"] + self._profile_n
                 t_data = time.time_ns()
                 inputs, targets, bsz = fetch()
                 self.metrics.add("get batch time", time.time_ns() - t_data)
@@ -460,6 +516,8 @@ class Optimizer:
                     state["recordsProcessedThisEpoch"] = 0
 
                 state["neval"] += 1
+                if profiling and state["neval"] >= profile_end:
+                    stop_profile()
                 # keep the snapshot's epoch current across the rollover so
                 # a resumed run continues at the right epoch
                 self.optim_method.state["epoch"] = state["epoch"]
@@ -483,7 +541,13 @@ class Optimizer:
                         self.train_summary.save_parameters(
                             self.model, state["neval"] - 1)
         finally:
-            fetch.stop()
+            # a run ending (or failing) inside the window must still close
+            # the trace — an unterminated xplane capture is unreadable —
+            # and the producer thread must stop even if closing re-raises
+            try:
+                stop_profile()
+            finally:
+                fetch.stop()
 
         flush_pending()
         publish()

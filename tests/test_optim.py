@@ -271,6 +271,66 @@ class TestLocalOptimizerE2E:
         opt.optimize()          # runs without error
 
 
+class TestTraceProfile:
+    def test_profiler_window_writes_trace(self, tmp_path):
+        """set_trace_profile captures a jax.profiler xplane trace of the
+        requested steady-state window and training still completes."""
+        samples = synthetic_separable(128, 4, n_classes=3, seed=9)
+        ds = LocalDataSet(samples).transform(SampleToMiniBatch(16))
+        model = _mlp(4, 3)
+        opt = optim.Optimizer.create(model, ds, nn.ClassNLLCriterion())
+        opt.set_optim_method(optim.SGD(learning_rate=0.3))
+        opt.set_end_when(optim.max_iteration(8))
+        opt.set_trace_profile(str(tmp_path), start_iteration=3,
+                              n_iterations=2)
+        opt.optimize()
+        import glob
+        files = glob.glob(str(tmp_path / "plugins" / "profile" / "*" / "*"))
+        assert files, "no profiler artifacts written"
+
+    def test_run_ending_inside_window_closes_trace(self, tmp_path):
+        """End trigger firing before the window completes must still stop
+        the trace (an unterminated capture poisons the NEXT start_trace
+        with 'profiler already running')."""
+        samples = synthetic_separable(64, 4, n_classes=3, seed=9)
+        model = _mlp(4, 3)
+        for _ in range(2):   # second run would fail if the first leaked
+            ds = LocalDataSet(samples).transform(SampleToMiniBatch(16))
+            opt = optim.Optimizer.create(model, ds, nn.ClassNLLCriterion())
+            opt.set_optim_method(optim.SGD(learning_rate=0.3))
+            opt.set_end_when(optim.max_iteration(4))
+            opt.set_trace_profile(str(tmp_path), start_iteration=3,
+                                  n_iterations=50)
+            opt.optimize()
+
+    def test_rejects_bad_window(self):
+        model = _mlp(4, 3)
+        ds = LocalDataSet(synthetic_separable(32, 4, n_classes=3)) \
+            .transform(SampleToMiniBatch(16))
+        opt = optim.Optimizer.create(model, ds, nn.ClassNLLCriterion())
+        with pytest.raises(ValueError, match="n_iterations"):
+            opt.set_trace_profile("/tmp/x", n_iterations=0)
+        with pytest.raises(ValueError, match="start_iteration"):
+            opt.set_trace_profile("/tmp/x", start_iteration=0)
+
+    def test_resume_past_start_iteration_still_captures(self, tmp_path):
+        """A run resumed beyond the window's start (evalCounter from a
+        snapshot) must still capture once, not silently skip."""
+        samples = synthetic_separable(128, 4, n_classes=3, seed=9)
+        ds = LocalDataSet(samples).transform(SampleToMiniBatch(16))
+        model = _mlp(4, 3)
+        opt = optim.Optimizer.create(model, ds, nn.ClassNLLCriterion())
+        method = optim.SGD(learning_rate=0.3)
+        method.state["evalCounter"] = 20   # as restored from a snapshot
+        opt.set_optim_method(method)
+        opt.set_end_when(optim.max_iteration(26))
+        opt.set_trace_profile(str(tmp_path), start_iteration=10,
+                              n_iterations=2)
+        opt.optimize()
+        import glob
+        assert glob.glob(str(tmp_path / "plugins" / "profile" / "*" / "*"))
+
+
 class TestValidatorNames:
     def test_validator_over_minibatch_dataset(self):
         """The reference's Validator API shape (optim/Validator.scala):
