@@ -278,7 +278,8 @@ def bench_inference(steps: int = 20, warmup: int = 4):
         out = None
         for _ in range(warmup):
             out = fwd(params, xb)
-        out.block_until_ready()
+        if out is not None:        # warmup=0: the compile call above was
+            out.block_until_ready()   # the only dispatch; nothing to drain
         t0 = time.time()
         # async dispatch keeps the device queue full; block once at the
         # end — serving throughput, not per-call host latency.  Only the
@@ -606,6 +607,10 @@ def main():
     ap.add_argument("--precision", choices=["fp32", "bf16"], default="bf16",
                     help="compute precision of the fused step (bf16 is the "
                          "TPU-first default: MXU-native, fp32 master weights)")
+    ap.add_argument("--layout", choices=["nhwc", "nchw"], default="nhwc",
+                    help="convnet compute layout for the headline model: "
+                         "nhwc = channels-last trunk (TPU-native default), "
+                         "nchw = the classic Torch layout for A/B runs")
     ap.add_argument("--quick", action="store_true",
                     help="LeNet only (CI smoke)")
     args = ap.parse_args()
@@ -633,13 +638,17 @@ def main():
         return
 
     # ResNet-50/ImageNet synthetic — the north-star protocol.
-    # ~4.09 GFLOPs/image forward; training ~3x forward.
+    # ~4.09 GFLOPs/image forward; training ~3x forward.  The model builds
+    # channels-last by default (interior NHWC compute, NCHW facade — the
+    # layout XLA:TPU wants); --layout nchw re-runs the old path for A/B.
     precision = None if args.precision == "fp32" else args.precision
-    model = model_init(resnet(1000, depth=50, dataset=DatasetType.IMAGENET))
+    model = model_init(resnet(1000, depth=50, dataset=DatasetType.IMAGENET,
+                              layout=args.layout.upper()))
     r50 = bench_model(model, args.batch, (3, 224, 224), 1000,
                       steps=args.steps, flops_per_image=3 * 4.09e9,
                       logits=True, precision=precision)
-    _log(f"resnet50 (batch {args.batch}, {args.precision}): {r50}")
+    _log(f"resnet50 (batch {args.batch}, {args.precision}, "
+         f"{args.layout}): {r50}")
     if "tflops" in r50:
         # bf16 peak of one v5e chip ~197 TFLOP/s
         _log(f"  achieved {r50['tflops']:.1f} TFLOP/s "

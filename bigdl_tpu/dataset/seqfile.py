@@ -9,6 +9,18 @@ ctypes); a pure-Python reader/writer covers toolchain-less environments and
 fixture generation.  Keys are Hadoop ``Text`` payloads (here: "path label"
 strings), values are raw byte blobs (the JPEG), with the ``BytesWritable``
 4-byte length prefix the reference's writer produces.
+
+Corruption guard: both readers sanity-cap the per-record length before
+allocating — a flipped bit in the 4-byte length field must surface as
+"corrupt", not a ~2 GB allocation.  The cap defaults to
+``MAX_RECORD_BYTES`` (1 GiB, far beyond any JPEG frame) and is
+configurable for legitimately larger records (e.g. a file produced by
+:func:`py_write_records` holding multi-GB blobs): either set the module
+level ``MAX_RECORD_BYTES`` or pass ``max_record_bytes=`` to
+:func:`read_records` / :func:`py_read_records`.  A cap different from the
+native reader's compiled-in 1 GiB automatically routes reads through the
+Python implementation, so a raised cap can't be misreported as corrupt by
+the native path.
 """
 
 from __future__ import annotations
@@ -20,6 +32,11 @@ from typing import Iterator, List, Optional, Tuple
 from bigdl_tpu.dataset.native import load_native
 
 SYNC = bytes(range(16))          # fixed sync marker for files we write
+
+#: default per-record sanity cap (bytes); the native reader's is fixed at
+#: this value, the Python reader's is configurable per call
+_NATIVE_MAX_RECORD_BYTES = 1 << 30
+MAX_RECORD_BYTES = _NATIVE_MAX_RECORD_BYTES
 
 
 # ---------------------------------------------------------------------------
@@ -71,8 +88,13 @@ def _read_text(f) -> bytes:
     return f.read(n)
 
 
-def py_read_records(path: str) -> Iterator[Tuple[bytes, bytes]]:
-    """(key, value) byte pairs from an uncompressed SequenceFile."""
+def py_read_records(path: str, max_record_bytes: Optional[int] = None
+                    ) -> Iterator[Tuple[bytes, bytes]]:
+    """(key, value) byte pairs from an uncompressed SequenceFile.
+
+    ``max_record_bytes`` overrides the module-level ``MAX_RECORD_BYTES``
+    corruption cap for files with legitimately huge records."""
+    cap = MAX_RECORD_BYTES if max_record_bytes is None else max_record_bytes
     with open(path, "rb") as f:
         if f.read(3) != b"SEQ":
             raise IOError(f"{path} is not a SequenceFile")
@@ -99,11 +121,15 @@ def py_read_records(path: str) -> Iterator[Tuple[bytes, bytes]]:
             if rec_len == -1:
                 marker = f.read(16)
                 if marker != sync:
-                    raise IOError(f"bad sync marker in {path}")
+                    # includes a SHORT read: a file cut inside the sync
+                    # escape is truncation, not clean EOF (the native
+                    # reader agrees, native/seqfile.cc)
+                    raise IOError(
+                        f"corrupt SequenceFile: bad sync marker in {path}")
                 continue
-            # same sanity cap as the native reader: a flipped length
-            # byte must not become a giant read or a silent short record
-            if rec_len < 0 or rec_len > (1 << 30):
+            # sanity cap (see module docstring): a flipped length byte
+            # must not become a giant read or a silent short record
+            if rec_len < 0 or rec_len > cap:
                 raise IOError(f"corrupt SequenceFile record in {path}")
             raw_kl = f.read(4)
             if len(raw_kl) < 4:
@@ -146,12 +172,19 @@ def py_write_records(path: str, records, key_class: str = "org.apache.hadoop.io.
 # native-preferred public API
 # ---------------------------------------------------------------------------
 
-def read_records(path: str) -> Iterator[Tuple[bytes, bytes]]:
-    """(key, value) pairs; native reader when available."""
+def read_records(path: str, max_record_bytes: Optional[int] = None
+                 ) -> Iterator[Tuple[bytes, bytes]]:
+    """(key, value) pairs; native reader when available.
+
+    ``max_record_bytes`` (default: module-level ``MAX_RECORD_BYTES``)
+    adjusts the corruption cap; any value other than the native reader's
+    compiled-in 1 GiB falls back to the Python reader so the cap is
+    actually honoured."""
     import ctypes
+    cap = MAX_RECORD_BYTES if max_record_bytes is None else max_record_bytes
     lib = load_native()
-    if lib is None:
-        yield from py_read_records(path)
+    if lib is None or cap != _NATIVE_MAX_RECORD_BYTES:
+        yield from py_read_records(path, max_record_bytes=cap)
         return
     handle = lib.seqfile_open(path.encode())
     if not handle:

@@ -1,12 +1,16 @@
-"""AlexNet (reference ``example/loadmodel/AlexNet.scala``)."""
+"""AlexNet (reference ``example/loadmodel/AlexNet.scala``).
+
+Builders default to ``layout="NHWC"``: channels-last conv trunk behind the
+NCHW facade (``nn/layout.py``)."""
 
 from bigdl_tpu.nn import (Sequential, SpatialConvolution, SpatialMaxPooling,
                           SpatialCrossMapLRN, ReLU, Dropout, View, Linear,
-                          LogSoftMax)
+                          LogSoftMax, apply_layout)
 
 
 def alexnet_owt(class_num: int = 1000, has_dropout: bool = True,
-                first_layer_propagate_back: bool = False) -> Sequential:
+                first_layer_propagate_back: bool = False,
+                layout: str = "NHWC") -> Sequential:
     """One-weird-trick AlexNet (no LRN, no grouping)."""
     m = Sequential()
     m.add(SpatialConvolution(3, 64, 11, 11, 4, 4, 2, 2, 1,
@@ -34,10 +38,10 @@ def alexnet_owt(class_num: int = 1000, has_dropout: bool = True,
         m.add(Dropout(0.5))
     m.add(Linear(4096, class_num, name="fc8"))
     m.add(LogSoftMax())
-    return m
+    return apply_layout(m, layout)
 
 
-def alexnet(class_num: int = 1000) -> Sequential:
+def alexnet(class_num: int = 1000, layout: str = "NHWC") -> Sequential:
     """Original grouped AlexNet with cross-map LRN."""
     m = Sequential()
     m.add(SpatialConvolution(3, 96, 11, 11, 4, 4, 0, 0, 1, False, name="conv1"))
@@ -64,4 +68,4 @@ def alexnet(class_num: int = 1000) -> Sequential:
     m.add(Dropout(0.5))
     m.add(Linear(4096, class_num, name="fc8"))
     m.add(LogSoftMax())
-    return m
+    return apply_layout(m, layout)

@@ -1,11 +1,16 @@
 """Inception v1 / v2 for ImageNet
 (reference ``models/inception/Inception_v1.scala:102``, ``Inception_v2.scala:152``).
+
+Builders default to ``layout="NHWC"``: the whole inception trunk (towers,
+channel concats, aux-head pools) computes channels-last behind the NCHW
+facade (``nn/layout.py``).
 """
 
 from bigdl_tpu.nn import (Sequential, SpatialConvolution, SpatialMaxPooling,
                           SpatialAveragePooling, SpatialCrossMapLRN,
                           SpatialBatchNormalization, ReLU, Concat, Dropout,
-                          View, Linear, LogSoftMax, Xavier, Zeros)
+                          View, Linear, LogSoftMax, Xavier, Zeros,
+                          apply_layout)
 
 
 def _conv(n_in, n_out, kw, kh, sw=1, sh=1, pw=0, ph=0, name=None,
@@ -71,7 +76,8 @@ def _v1_stem():
     return f
 
 
-def inception_v1_no_aux_classifier(class_num: int = 1000) -> Sequential:
+def inception_v1_no_aux_classifier(class_num: int = 1000,
+                                   layout: str = "NHWC") -> Sequential:
     m = _v1_stem()
     m.add(inception_layer_v1(512, ((160,), (112, 224), (24, 64), (64,)),
                              "inception_4b/"))
@@ -91,10 +97,10 @@ def inception_v1_no_aux_classifier(class_num: int = 1000) -> Sequential:
     m.add(View(1024).set_num_input_dims(3))
     m.add(Linear(1024, class_num, name="loss3/classifier"))
     m.add(LogSoftMax())
-    return m
+    return apply_layout(m, layout)
 
 
-def inception_v1(class_num: int = 1000) -> Sequential:
+def inception_v1(class_num: int = 1000, layout: str = "NHWC") -> Sequential:
     """Full GoogLeNet with the two auxiliary classifier heads; output is the
     channel-concat of [main, aux2, aux1] log-probabilities
     (reference ``Inception_v1.scala:104-186``)."""
@@ -147,7 +153,7 @@ def inception_v1(class_num: int = 1000) -> Sequential:
     split2 = Concat(2).add(output3).add(output2)
     main_branch = Sequential().add(feature2).add(split2)
     split1 = Concat(2).add(main_branch).add(output1)
-    return Sequential().add(feature1).add(split1)
+    return apply_layout(Sequential().add(feature1).add(split1), layout)
 
 
 def _conv_bn(seq, n_in, n_out, kw, kh, sw=1, sh=1, pw=0, ph=0, name="",
@@ -235,7 +241,8 @@ def _v2_stem():
     return f
 
 
-def inception_v2_no_aux_classifier(class_num: int = 1000) -> Sequential:
+def inception_v2_no_aux_classifier(class_num: int = 1000,
+                                   layout: str = "NHWC") -> Sequential:
     m = _v2_stem()
     for size, cfg, prefix in _V2_BLOCKS_3 + _V2_BLOCKS_4 + _V2_BLOCKS_5:
         m.add(inception_layer_v2(size, cfg, prefix))
@@ -243,10 +250,10 @@ def inception_v2_no_aux_classifier(class_num: int = 1000) -> Sequential:
     m.add(View(1024).set_num_input_dims(3))
     m.add(Linear(1024, class_num, name="loss3/classifier"))
     m.add(LogSoftMax())
-    return m
+    return apply_layout(m, layout)
 
 
-def inception_v2(class_num: int = 1000) -> Sequential:
+def inception_v2(class_num: int = 1000, layout: str = "NHWC") -> Sequential:
     features1 = _v2_stem()
     for size, cfg, prefix in _V2_BLOCKS_3:
         features1.add(inception_layer_v2(size, cfg, prefix))
@@ -286,4 +293,4 @@ def inception_v2(class_num: int = 1000) -> Sequential:
     split2 = Concat(2).add(output3).add(output2)
     main_branch = Sequential().add(features2).add(split2)
     split1 = Concat(2).add(main_branch).add(output1)
-    return Sequential().add(features1).add(split1)
+    return apply_layout(Sequential().add(features1).add(split1), layout)

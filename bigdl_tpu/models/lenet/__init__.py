@@ -1,10 +1,13 @@
-"""LeNet-5 for MNIST (reference ``models/lenet/LeNet5.scala:25``)."""
+"""LeNet-5 for MNIST (reference ``models/lenet/LeNet5.scala:25``).
+
+Builds channels-last by default (``layout="NHWC"``, see ``nn/layout.py``);
+the public input stays the flat/NCHW MNIST batch."""
 
 from bigdl_tpu.nn import (Sequential, Reshape, SpatialConvolution, Tanh,
-                          SpatialMaxPooling, Linear, LogSoftMax)
+                          SpatialMaxPooling, Linear, LogSoftMax, apply_layout)
 
 
-def lenet5(class_num: int = 10) -> Sequential:
+def lenet5(class_num: int = 10, layout: str = "NHWC") -> Sequential:
     """The classic 2-conv 2-fc LeNet: 28x28 grey image -> class_num logits."""
     m = Sequential()
     m.add(Reshape((1, 28, 28)))
@@ -19,4 +22,4 @@ def lenet5(class_num: int = 10) -> Sequential:
     m.add(Tanh())
     m.add(Linear(100, class_num, name="fc2"))
     m.add(LogSoftMax())
-    return m
+    return apply_layout(m, layout)

@@ -3,7 +3,9 @@
 
 TPU note: the reference's ``optnet`` buffer sharing (SpatialShareConvolution,
 shareGradInput) is a CPU memory trick; under XLA buffer reuse is the
-compiler's job, so plain convolutions are used everywhere.
+compiler's job, so plain convolutions are used everywhere.  Builders default
+to ``layout="NHWC"``: the conv trunk computes channels-last (the TPU-native
+image layout, ``nn/layout.py``) behind the unchanged NCHW input facade.
 """
 
 import math
@@ -14,7 +16,7 @@ import jax.numpy as jnp
 from bigdl_tpu.nn import (Sequential, SpatialConvolution, SpatialMaxPooling,
                           SpatialAveragePooling, SpatialBatchNormalization,
                           ReLU, ConcatTable, CAddTable, Identity, Linear,
-                          View, Concat, MulConstant, Module)
+                          View, Concat, MulConstant, Module, apply_layout)
 
 
 class DatasetType:
@@ -98,7 +100,8 @@ _IMAGENET_CFG = {
 
 def resnet(class_num: int, depth: int = 18,
            shortcut_type: str = ShortcutType.B,
-           dataset: str = DatasetType.CIFAR10) -> Sequential:
+           dataset: str = DatasetType.CIFAR10,
+           layout: str = "NHWC") -> Sequential:
     model = Sequential()
     if dataset == DatasetType.IMAGENET:
         if depth not in _IMAGENET_CFG:
@@ -135,7 +138,7 @@ def resnet(class_num: int, depth: int = 18,
         model.add(Linear(64, class_num))
     else:
         raise ValueError(f"Unknown dataset {dataset}")
-    return model
+    return apply_layout(model, layout)
 
 
 def model_init(model: Module, rng=None) -> Module:

@@ -1,11 +1,15 @@
-"""VGG family (reference ``models/vgg/VggForCifar10.scala:22,71,124``)."""
+"""VGG family (reference ``models/vgg/VggForCifar10.scala:22,71,124``).
+
+Builders default to ``layout="NHWC"``: channels-last conv trunk behind the
+NCHW facade (``nn/layout.py``)."""
 
 from bigdl_tpu.nn import (Sequential, SpatialConvolution, SpatialMaxPooling,
                           SpatialBatchNormalization, BatchNormalization, ReLU,
-                          Dropout, View, Linear, LogSoftMax, Threshold)
+                          Dropout, View, Linear, LogSoftMax, Threshold,
+                          apply_layout)
 
 
-def vgg_for_cifar10(class_num: int = 10) -> Sequential:
+def vgg_for_cifar10(class_num: int = 10, layout: str = "NHWC") -> Sequential:
     """VGG-16-style BN+Dropout net for 32x32 CIFAR-10 images."""
     m = Sequential()
 
@@ -41,10 +45,10 @@ def vgg_for_cifar10(class_num: int = 10) -> Sequential:
     m.add(Dropout(0.5))
     m.add(Linear(512, class_num))
     m.add(LogSoftMax())
-    return m
+    return apply_layout(m, layout)
 
 
-def _vgg_imagenet(block_convs, class_num: int) -> Sequential:
+def _vgg_imagenet(block_convs, class_num: int, layout: str) -> Sequential:
     m = Sequential()
     n_in = 3
     widths = (64, 128, 256, 512, 512)
@@ -63,12 +67,12 @@ def _vgg_imagenet(block_convs, class_num: int) -> Sequential:
     m.add(Dropout(0.5))
     m.add(Linear(4096, class_num))
     m.add(LogSoftMax())
-    return m
+    return apply_layout(m, layout)
 
 
-def vgg16(class_num: int = 1000) -> Sequential:
-    return _vgg_imagenet((2, 2, 3, 3, 3), class_num)
+def vgg16(class_num: int = 1000, layout: str = "NHWC") -> Sequential:
+    return _vgg_imagenet((2, 2, 3, 3, 3), class_num, layout)
 
 
-def vgg19(class_num: int = 1000) -> Sequential:
-    return _vgg_imagenet((2, 2, 4, 4, 4), class_num)
+def vgg19(class_num: int = 1000, layout: str = "NHWC") -> Sequential:
+    return _vgg_imagenet((2, 2, 4, 4, 4), class_num, layout)

@@ -76,6 +76,9 @@ from bigdl_tpu.nn.criterion import (ClassNLLCriterion, CrossEntropyCriterion,
                                     ClassSimplexCriterion,
                                     TimeDistributedCriterion)
 from bigdl_tpu.nn.graph import Graph, ModuleNode, Input
+from bigdl_tpu.nn.layout import (NCHWToNHWC, NHWCToNCHW, to_channels_last,
+                                 apply_layout)
+from bigdl_tpu.nn.fuse import fold_conv_bn
 from bigdl_tpu.nn.recurrent import (Cell, RnnCell, LSTM, LSTMPeephole, GRU,
                                     ConvLSTMPeephole, ConvLSTMPeephole3D,
                                     Recurrent, BiRecurrent, TimeDistributed,

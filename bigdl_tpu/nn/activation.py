@@ -18,6 +18,8 @@ from bigdl_tpu.nn.module import Module
 
 
 class _Elementwise(Module):
+    layout_role = "agnostic"   # pointwise: any data format passes through
+
     def _fn(self, x):
         raise NotImplementedError
 
@@ -96,6 +98,8 @@ class LogSigmoid(_Elementwise):
 class SoftMax(_Elementwise):
     """Softmax over the last dim for 1-D/2-D input (torch semantics)."""
 
+    layout_role = "opaque"     # axis-dependent, not pointwise
+
     def _fn(self, x):
         return jax.nn.softmax(x, axis=-1)
 
@@ -103,12 +107,16 @@ class SoftMax(_Elementwise):
 class SoftMin(_Elementwise):
     """Softmax of -x over the last dim (reference ``nn/SoftMin.scala``)."""
 
+    layout_role = "opaque"
+
     def _fn(self, x):
         return jax.nn.softmax(-x, axis=-1)
 
 
 class LogSoftMax(_Elementwise):
     """log-softmax over the last dim (reference ``nn/LogSoftMax.scala``)."""
+
+    layout_role = "opaque"
 
     def _fn(self, x):
         return jax.nn.log_softmax(x, axis=-1)
@@ -290,6 +298,8 @@ class RReLU(Module):
 class Dropout(Module):
     """Inverted dropout (reference ``nn/Dropout.scala:44``)."""
 
+    layout_role = "agnostic"
+
     def __init__(self, init_p: float = 0.5, inplace: bool = False,
                  scale: bool = True, name=None):
         super().__init__(name)
@@ -318,6 +328,8 @@ class Dropout(Module):
 class GaussianDropout(Module):
     """Multiplicative gaussian noise N(1, p/(1-p))."""
 
+    layout_role = "agnostic"
+
     def __init__(self, rate: float, name=None):
         super().__init__(name)
         self.rate = rate
@@ -335,6 +347,8 @@ class GaussianDropout(Module):
 
 class GaussianNoise(Module):
     """Additive gaussian noise (training only)."""
+
+    layout_role = "agnostic"
 
     def __init__(self, stddev: float, name=None):
         super().__init__(name)

@@ -29,6 +29,8 @@ from bigdl_tpu import ops
 class SpatialConvolution(Module):
     """2-D convolution (reference ``nn/SpatialConvolution.scala:42``)."""
 
+    layout_role = "spatial"
+
     def __init__(self, n_input_plane: int, n_output_plane: int,
                  kernel_w: int, kernel_h: int,
                  stride_w: int = 1, stride_h: int = 1,
@@ -124,6 +126,8 @@ class SpatialShareConvolution(SpatialConvolution):
 class SpatialDilatedConvolution(Module):
     """Atrous 2-D convolution (reference ``nn/SpatialDilatedConvolution.scala``)."""
 
+    layout_role = "spatial"
+
     def __init__(self, n_input_plane: int, n_output_plane: int,
                  kw: int, kh: int, dw: int = 1, dh: int = 1,
                  pad_w: int = 0, pad_h: int = 0,
@@ -167,6 +171,8 @@ class SpatialDilatedConvolution(Module):
 class SpatialFullConvolution(Module):
     """Transposed (fractionally-strided) convolution, a.k.a. deconvolution
     (reference ``nn/SpatialFullConvolution.scala``)."""
+
+    layout_role = "spatial"
 
     def __init__(self, n_input_plane: int, n_output_plane: int,
                  kw: int, kh: int, dw: int = 1, dh: int = 1,

@@ -125,6 +125,19 @@ class Module:
 
     _name_seq = itertools.count()
 
+    # ---- data-layout contract (channels-last compute path) --------------
+    # How this module relates to the data format of image activations
+    # (see nn/layout.py, which uses this to move a convnet's interior to
+    # the TPU-native NHWC layout while the public API stays NCHW):
+    #   "opaque"   — layout-dependent or unknown: must see the Torch-facade
+    #                NCHW activations (the safe default);
+    #   "agnostic" — elementwise/broadcast: whatever layout flows in flows
+    #                out unchanged;
+    #   "spatial"  — consumes image maps in ``self.format`` and can be
+    #                re-pointed between "NCHW" and "NHWC" via
+    #                :meth:`set_format`.
+    layout_role = "opaque"
+
     def __init__(self, name: Optional[str] = None):
         self.name = name or f"{type(self).__name__}_{next(Module._name_seq)}"
         self.train_mode: bool = True
@@ -300,6 +313,32 @@ class Module:
 
     # ---- mode / traversal ----------------------------------------------
 
+    def set_format(self, format: str) -> "Module":
+        """Switch a spatial module's compute data format ("NCHW"/"NHWC").
+
+        Clears this module's own jit cache; an ENCLOSING container that
+        already traced this module keeps its old-format trace — call
+        :meth:`clear_jit_cache` on the outermost model after re-pointing
+        modules inside a live one (``nn.to_channels_last`` does)."""
+        if self.layout_role != "spatial":
+            raise ValueError(
+                f"{type(self).__name__} has no data format (layout_role="
+                f"{self.layout_role!r})")
+        if format not in ("NCHW", "NHWC"):
+            raise ValueError(f"unknown data format {format!r}")
+        self.format = format
+        self.clear_jit_cache(recursive=False)
+        return self
+
+    def clear_jit_cache(self, recursive: bool = True) -> "Module":
+        """Drop cached jitted traces (forward shell + eval forward) so the
+        next call re-traces — required after structural or format edits on
+        an already-run model.  ``recursive`` walks the whole subtree."""
+        for m in (self.modules() if recursive else (self,)):
+            m._jit_apply = None
+            m.__dict__.pop("_eval_jit", None)
+        return self
+
     def is_stochastic(self) -> bool:
         """True if apply consumes rng during training (Dropout etc.)."""
         return False
@@ -431,13 +470,15 @@ class Module:
 
     # ---- prediction conveniences ---------------------------------------
 
-    def predict(self, dataset, batch_size: int = 32):
+    def predict(self, dataset, batch_size: int = 32, fold_bn: bool = False):
         from bigdl_tpu.optim.predictor import Predictor
-        return Predictor(self).predict(dataset, batch_size)
+        return Predictor(self, fold_bn=fold_bn).predict(dataset, batch_size)
 
-    def predict_class(self, dataset, batch_size: int = 32):
+    def predict_class(self, dataset, batch_size: int = 32,
+                      fold_bn: bool = False):
         from bigdl_tpu.optim.predictor import Predictor
-        return Predictor(self).predict_class(dataset, batch_size)
+        return Predictor(self, fold_bn=fold_bn).predict_class(dataset,
+                                                              batch_size)
 
     def __repr__(self):
         return f"{type(self).__name__}({self.name})"

@@ -104,13 +104,21 @@ class SpatialBatchNormalization(BatchNormalization):
     ``format="NHWC"`` normalizes the trailing channel axis instead (the
     TF-import and TPU-preferred activation layout)."""
 
+    layout_role = "spatial"
+
     def __init__(self, n_output, eps=1e-5, momentum=0.1, affine=True,
                  init_weight=None, init_bias=None, init_running_mean=None,
                  init_running_var=None, format="NCHW", name=None):
         super().__init__(n_output, eps, momentum, affine, init_weight,
                          init_bias, init_running_mean, init_running_var,
                          name=name)
+        self.format = format
         self.channel_axis = 1 if format == "NCHW" else -1
+
+    def set_format(self, format):
+        super().set_format(format)
+        self.channel_axis = 1 if format == "NCHW" else -1
+        return self
 
 
 class Normalize(Module):
@@ -134,27 +142,34 @@ class SpatialCrossMapLRN(Module):
     """AlexNet-style local response normalization across channels
     (reference ``nn/SpatialCrossMapLRN.scala``)."""
 
+    layout_role = "spatial"
+
     def __init__(self, size: int = 5, alpha: float = 1.0, beta: float = 0.75,
-                 k: float = 1.0, name=None):
+                 k: float = 1.0, format: str = "NCHW", name=None):
         super().__init__(name)
         self.size = size
         self.alpha = alpha
         self.beta = beta
         self.k = k
+        self.format = format
 
     def apply(self, params, input, state, training=False, rng=None):
-        # input (N, C, H, W); window sum of squares across C
+        # window sum of squares across the channel axis (1 for NCHW, -1
+        # for the channels-last path — where the window slides over the
+        # MINOR axis, the layout reduce/slice ops actually like)
+        ch = 1 if self.format == "NCHW" else input.ndim - 1
         sq = input * input
         half = (self.size - 1) // 2
-        pad_lo, pad_hi = half, self.size - 1 - half
-        padded = jnp.pad(sq, ((0, 0), (pad_lo, pad_hi), (0, 0), (0, 0)))
+        pads = [(0, 0)] * input.ndim
+        pads[ch] = (half, self.size - 1 - half)
+        padded = jnp.pad(sq, pads)
         # static unrolled window sum over the small channel window; avoids
         # lax.reduce_window over the non-minor channel dim, which the TPU
         # backend lays out poorly (and miscompiles under AOT).
-        c = input.shape[1]
-        window = padded[:, 0:c]
+        c = input.shape[ch]
+        window = jax.lax.slice_in_dim(padded, 0, c, axis=ch)
         for i in range(1, self.size):
-            window = window + padded[:, i:i + c]
+            window = window + jax.lax.slice_in_dim(padded, i, i + c, axis=ch)
         denom = (self.k + self.alpha / self.size * window) ** self.beta
         return input / denom, state
 
@@ -256,20 +271,27 @@ class SpatialWithinChannelLRN(Module):
     """LRN over a spatial window within each channel
     (reference ``nn/SpatialWithinChannelLRN.scala``)."""
 
+    layout_role = "spatial"
+
     def __init__(self, size: int = 5, alpha: float = 1.0, beta: float = 0.75,
-                 name=None):
+                 format: str = "NCHW", name=None):
         super().__init__(name)
         self.size = size
         self.alpha = alpha
         self.beta = beta
+        self.format = format
 
     def apply(self, params, input, state, training=False, rng=None):
+        from bigdl_tpu.ops.pooling import _spatial_axes
         sq = input * input
         half_lo = self.size // 2
         half_hi = (self.size - 1) - half_lo
-        pads = ((0, 0), (0, 0), (half_lo, half_hi), (half_lo, half_hi))
+        h_ax, w_ax = _spatial_axes(self.format)
+        pads = [(0, 0)] * 4
+        pads[h_ax] = pads[w_ax] = (half_lo, half_hi)
+        dims = [1] * 4
+        dims[h_ax] = dims[w_ax] = self.size
         window = jax.lax.reduce_window(
-            sq, 0.0, jax.lax.add, (1, 1, self.size, self.size), (1, 1, 1, 1),
-            pads)
+            sq, 0.0, jax.lax.add, tuple(dims), (1, 1, 1, 1), tuple(pads))
         denom = (1.0 + self.alpha / (self.size * self.size) * window) ** self.beta
         return input / denom, state

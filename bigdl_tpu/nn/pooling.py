@@ -15,6 +15,8 @@ from bigdl_tpu import ops
 class SpatialMaxPooling(Module):
     """2-D max pooling (reference ``nn/SpatialMaxPooling.scala``)."""
 
+    layout_role = "spatial"
+
     def __init__(self, kw: int, kh: int, dw: int = None, dh: int = None,
                  pad_w: int = 0, pad_h: int = 0, format: str = "NCHW",
                  name=None):
@@ -50,6 +52,8 @@ class SpatialMaxPooling(Module):
 
 class SpatialAveragePooling(Module):
     """2-D average pooling (reference ``nn/SpatialAveragePooling.scala``)."""
+
+    layout_role = "spatial"
 
     def __init__(self, kw: int, kh: int, dw: int = 1, dh: int = 1,
                  pad_w: int = 0, pad_h: int = 0,

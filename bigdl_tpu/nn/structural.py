@@ -37,12 +37,16 @@ def _axis(dim_1based: int, ndim: int, n_input_dims: int = -1) -> int:
 class Identity(Module):
     """Pass input through unchanged (reference ``nn/Identity.scala``)."""
 
+    layout_role = "agnostic"
+
     def apply(self, params, input, state, training=False, rng=None):
         return input, state
 
 
 class Echo(Module):
     """Identity that prints its input shape (debug aid, reference ``nn/Echo.scala``)."""
+
+    layout_role = "agnostic"
 
     def apply(self, params, input, state, training=False, rng=None):
         jax.debug.print("Echo {name}: shape {shape}", name=self.name,
@@ -52,6 +56,8 @@ class Echo(Module):
 
 class Contiguous(Module):
     """No-op on XLA arrays (kept for API parity, reference ``nn/Contiguous.scala``)."""
+
+    layout_role = "agnostic"
 
     def apply(self, params, input, state, training=False, rng=None):
         return input, state
@@ -366,6 +372,8 @@ class GradientReversal(Module):
     """Identity forward, -lambda * grad backward (reference
     ``nn/GradientReversal.scala``), via custom VJP."""
 
+    layout_role = "agnostic"
+
     def __init__(self, the_lambda: float = 1.0, name=None):
         super().__init__(name)
         self.the_lambda = the_lambda
@@ -574,6 +582,8 @@ class Reverse(Module):
 class MulConstant(Module):
     """Multiply by a scalar constant (reference ``nn/MulConstant.scala``)."""
 
+    layout_role = "agnostic"
+
     def __init__(self, constant_scalar: float, inplace: bool = False, name=None):
         super().__init__(name)
         self.constant = constant_scalar
@@ -596,17 +606,24 @@ class ChannelNormalize(Module):
     The subtraction/scale fuses into the first convolution under XLA.
     ``dtype`` pins the output precision (e.g. ``"bfloat16"`` under
     mixed-precision training, where a float32 output would silently
-    promote the first conv back to fp32)."""
+    promote the first conv back to fp32).  ``format="NHWC"`` normalizes
+    the trailing channel axis for the channels-last compute path."""
 
-    def __init__(self, mean, std, dtype=None, name=None):
+    layout_role = "spatial"
+
+    def __init__(self, mean, std, dtype=None, format="NCHW", name=None):
         super().__init__(name)
         self.mean = tuple(float(m) for m in mean)
         self.std = tuple(float(s) for s in std)
         self.dtype = dtype
+        self.format = format
 
     def apply(self, params, input, state, training=False, rng=None):
         c = len(self.mean)
-        shape = (1, c) + (1,) * (input.ndim - 2)
+        if self.format == "NCHW":
+            shape = (1, c) + (1,) * (input.ndim - 2)
+        else:
+            shape = (1,) * (input.ndim - 1) + (c,)
         mean = jnp.asarray(self.mean, jnp.float32).reshape(shape)
         std = jnp.asarray(self.std, jnp.float32).reshape(shape)
         out = (input.astype(jnp.float32) - mean) / std
@@ -617,6 +634,8 @@ class ChannelNormalize(Module):
 
 class AddConstant(Module):
     """Add a scalar constant (reference ``nn/AddConstant.scala``)."""
+
+    layout_role = "agnostic"
 
     def __init__(self, constant_scalar: float, inplace: bool = False, name=None):
         super().__init__(name)

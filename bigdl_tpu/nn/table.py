@@ -180,6 +180,8 @@ class MixtureTable(Module):
 
 
 class _BinaryTableOp(Module):
+    layout_role = "agnostic"   # elementwise over the table entries
+
     def _op(self, a, b):
         raise NotImplementedError
 

@@ -8,6 +8,12 @@ thread pool; we only describe layouts via ``dimension_numbers``.
 
 Kernel storage layout is always HWIO ((kh, kw, in/groups, out)) — the
 TPU-friendly layout — independent of the activations' data format.
+
+Data format: every 2-D primitive takes ``format`` ("NCHW"/"NHWC") and is
+TRANSPOSE-FREE in NHWC — the TPU-native channels-last layout the model
+zoo's interior computes in (``nn/layout.py``); only the NCHW small-taps
+matmul path below materialises transposes, and only because channel-first
+slicing would defeat the layout anyway.
 """
 
 from __future__ import annotations
@@ -22,6 +28,13 @@ _DN = {
     "NCHW": ("NCHW", "HWIO", "NCHW"),
     "NHWC": ("NHWC", "HWIO", "NHWC"),
 }
+
+
+def _dimension_numbers(x_shape, w_shape, format: str):
+    if format not in _DN:
+        raise ValueError(f"unknown data format {format!r}: "
+                         f"expected one of {sorted(_DN)}")
+    return lax.conv_dimension_numbers(x_shape, w_shape, _DN[format])
 
 
 def _same_pad(in_size: int, k: int, s: int, d: int = 1) -> Tuple[int, int]:
@@ -78,7 +91,7 @@ def conv2d(x: jnp.ndarray, weight: jnp.ndarray,
     padding: (padH, padW) explicit or "SAME".  BigDL encodes same-padding as
     pad = -1 (``nn/SpatialConvolution.scala``); callers translate that here.
     """
-    dn = lax.conv_dimension_numbers(x.shape, weight.shape, _DN[format])
+    dn = _dimension_numbers(x.shape, weight.shape, format)
     if padding == "SAME":
         h_ax, w_ax = (2, 3) if format == "NCHW" else (1, 2)
         pad = (_same_pad(x.shape[h_ax], weight.shape[0], stride[0], dilation[0]),
@@ -112,7 +125,7 @@ def conv_transpose2d(x: jnp.ndarray, weight: jnp.ndarray,
     out = (in - 1) * stride - 2 * pad + kernel + adj.
     """
     kh, kw = weight.shape[0], weight.shape[1]
-    dn = lax.conv_dimension_numbers(x.shape, weight.shape, _DN[format])
+    dn = _dimension_numbers(x.shape, weight.shape, format)
     pad = ((kh - 1 - padding[0], kh - 1 - padding[0] + adj[0]),
            (kw - 1 - padding[1], kw - 1 - padding[1] + adj[1]))
     # lhs_dilation inserts (stride-1) zeros between input rows/cols: the

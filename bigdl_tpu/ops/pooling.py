@@ -4,6 +4,10 @@ Reference equivalent: the hand-written pooling loops in
 ``nn/NNPrimitive.scala`` (max-pool fwd/bwd float+double variants).  XLA's
 reduce-window (and its built-in select-and-scatter gradient) replaces all of
 it; ceil-mode is expressed as extra low-priority padding on the high side.
+
+The 2-D primitives take ``format`` ("NCHW"/"NHWC") and are transpose-free
+in both: only the window/stride/pad axis positions move, so the
+channels-last path (``nn/layout.py``) pools NHWC maps natively.
 """
 
 from __future__ import annotations
@@ -13,6 +17,15 @@ from typing import Tuple
 
 import jax.numpy as jnp
 from jax import lax
+
+
+def _spatial_axes(format: str) -> Tuple[int, int]:
+    if format == "NCHW":
+        return 2, 3
+    if format == "NHWC":
+        return 1, 2
+    raise ValueError(f"unknown data format {format!r}: "
+                     f"expected 'NCHW' or 'NHWC'")
 
 
 def pool_out_size(in_size: int, k: int, stride: int, pad: int,
@@ -37,7 +50,7 @@ def max_pool2d(x: jnp.ndarray, kernel: Tuple[int, int],
     kh, kw = kernel
     sh, sw = stride
     ph, pw = padding
-    h_ax, w_ax = (2, 3) if format == "NCHW" else (1, 2)
+    h_ax, w_ax = _spatial_axes(format)
     pads = [(0, 0)] * x.ndim
     pads[h_ax] = (ph, _hi_pad(x.shape[h_ax], kh, sh, ph, ceil_mode))
     pads[w_ax] = (pw, _hi_pad(x.shape[w_ax], kw, sw, pw, ceil_mode))
@@ -58,7 +71,7 @@ def avg_pool2d(x: jnp.ndarray, kernel: Tuple[int, int],
     kh, kw = kernel
     sh, sw = stride
     ph, pw = padding
-    h_ax, w_ax = (2, 3) if format == "NCHW" else (1, 2)
+    h_ax, w_ax = _spatial_axes(format)
     pads = [(0, 0)] * x.ndim
     pads[h_ax] = (ph, _hi_pad(x.shape[h_ax], kh, sh, ph, ceil_mode))
     pads[w_ax] = (pw, _hi_pad(x.shape[w_ax], kw, sw, pw, ceil_mode))

@@ -475,6 +475,11 @@ class Optimizer:
                     jax.profiler.stop_trace()
                 logger.info("Profiler trace written to %s",
                             self._profile_dir)
+                # the request is consumed: a SECOND optimize() on this
+                # Optimizer must not silently re-capture into the same
+                # log_dir and mix xplane artifacts — callers wanting
+                # another window call set_trace_profile again
+                self._profile_dir = None
 
         try:
             while not should_end():

@@ -18,7 +18,17 @@ from bigdl_tpu.optim.evaluator import _eval_forward, _to_device
 
 
 class Predictor:
-    def __init__(self, model: Module):
+    """``fold_bn=True`` serves a CLONE of the model with every
+    conv+BatchNorm pair folded into the convolution
+    (:func:`bigdl_tpu.nn.fuse.fold_conv_bn`) — the inference-graph shape
+    the TPU wants: one conv kernel per pair, no separate normalize pass.
+    The caller's model is untouched (folding freezes BN at its running
+    statistics, which would corrupt further training)."""
+
+    def __init__(self, model: Module, fold_bn: bool = False):
+        if fold_bn:
+            from bigdl_tpu.nn.fuse import fold_conv_bn
+            model = fold_conv_bn(model.clone_module().evaluate())
         self.model = model
 
     def _batches(self, dataset, batch_size: int):

@@ -144,7 +144,10 @@ int seqfile_next(void* handle, const char** key, int* klen,
     bool ok;
     if (rec_len == -1) {  // sync escape
       uint8_t sync[16];
-      if (fread(sync, 1, 16, r->f) != 16) return 0;
+      // a short read here is a file cut INSIDE the sync marker —
+      // truncation, not clean EOF (the python reader raises on the
+      // mismatched short marker; -1 keeps the two in lockstep)
+      if (fread(sync, 1, 16, r->f) != 16) return -1;
       if (memcmp(sync, r->sync, 16) != 0) return -1;
       continue;
     }

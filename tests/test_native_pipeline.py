@@ -62,18 +62,30 @@ class TestSeqFile:
             return seqfile.read_records
         return seqfile.py_read_records
 
-    @pytest.mark.parametrize("cut", ["value", "key_len", "rec_len"])
+    @pytest.mark.parametrize("cut", ["value", "key_len", "rec_len", "sync"])
     def test_truncated_file_raises_not_crashes(self, tmp_path, reader, cut):
         p = str(tmp_path / "trunc.seq")
-        seqfile.py_write_records(p, iter([(b"k", b"v" * 500)]))
         import os
-        with open(p, "r+b") as f:
-            if cut == "value":             # cut inside the value payload
-                f.truncate(os.path.getsize(p) - 100)
-            elif cut == "key_len":         # cut inside the key_len field
-                f.truncate(self._first_record_offset(p) + 5)
-            else:                          # cut inside rec_len itself
-                f.truncate(self._first_record_offset(p) + 2)
+        if cut == "sync":
+            # first record big enough (>2000 payload bytes) that the writer
+            # emits a sync escape before the second; cut INSIDE the 16-byte
+            # marker — truncation, which must NOT read as clean EOF (the
+            # native reader used to return 0 here while python raised)
+            seqfile.py_write_records(
+                p, iter([(b"k", b"v" * 2500), (b"k2", b"w")]))
+            rec1 = 4 + 4 + 1 + 2500        # rec_len, key_len, key, value
+            off = self._first_record_offset(p) + rec1
+            with open(p, "r+b") as f:
+                f.truncate(off + 4 + 8)    # -1 escape + half the marker
+        else:
+            seqfile.py_write_records(p, iter([(b"k", b"v" * 500)]))
+            with open(p, "r+b") as f:
+                if cut == "value":         # cut inside the value payload
+                    f.truncate(os.path.getsize(p) - 100)
+                elif cut == "key_len":     # cut inside the key_len field
+                    f.truncate(self._first_record_offset(p) + 5)
+                else:                      # cut inside rec_len itself
+                    f.truncate(self._first_record_offset(p) + 2)
         with pytest.raises(IOError, match="corrupt"):
             list(reader(p))
 
@@ -99,6 +111,32 @@ class TestSeqFile:
             f.write(b"\x7f\xff\xff\xff")
         with pytest.raises(IOError, match="corrupt"):
             list(reader(p))
+
+    def test_record_cap_is_configurable(self, tmp_path):
+        """The rec_len sanity cap is a knob (module level or per call), so
+        legitimately huge records aren't misreported as corrupt — and a
+        non-default cap is actually honoured by read_records (it routes
+        around the native reader's compiled-in 1 GiB)."""
+        p = str(tmp_path / "cap.seq")
+        recs = [(b"k", b"v" * 5000)]
+        seqfile.py_write_records(p, iter(recs))
+        assert list(seqfile.read_records(p)) == recs
+        # a LOWERED cap flags the same record as corrupt (both entrypoints)
+        with pytest.raises(IOError, match="corrupt"):
+            list(seqfile.py_read_records(p, max_record_bytes=100))
+        with pytest.raises(IOError, match="corrupt"):
+            list(seqfile.read_records(p, max_record_bytes=100))
+        # module-level override is picked up as the default
+        old = seqfile.MAX_RECORD_BYTES
+        try:
+            seqfile.MAX_RECORD_BYTES = 100
+            with pytest.raises(IOError, match="corrupt"):
+                list(seqfile.read_records(p))
+        finally:
+            seqfile.MAX_RECORD_BYTES = old
+        # a RAISED cap still reads fine (python fallback path)
+        assert list(seqfile.read_records(
+            p, max_record_bytes=2 << 30)) == recs
 
     def test_image_seqfile_protocol(self, tmp_path):
         p = str(tmp_path / "imgs.seq")

@@ -313,6 +313,36 @@ class TestTraceProfile:
         with pytest.raises(ValueError, match="start_iteration"):
             opt.set_trace_profile("/tmp/x", start_iteration=0)
 
+    def test_second_optimize_does_not_recapture(self, tmp_path):
+        """A completed capture consumes the request: calling optimize()
+        again on the same Optimizer must not silently re-capture into the
+        same log_dir and mix xplane artifacts.  A fresh set_trace_profile
+        re-arms it."""
+        import glob
+        samples = synthetic_separable(128, 4, n_classes=3, seed=9)
+        ds = LocalDataSet(samples).transform(SampleToMiniBatch(16))
+        model = _mlp(4, 3)
+        opt = optim.Optimizer.create(model, ds, nn.ClassNLLCriterion())
+        opt.set_optim_method(optim.SGD(learning_rate=0.3))
+        opt.set_end_when(optim.max_iteration(6))
+        opt.set_trace_profile(str(tmp_path), start_iteration=3,
+                              n_iterations=2)
+        opt.optimize()
+        pattern = str(tmp_path / "plugins" / "profile" / "*")
+        runs = set(glob.glob(pattern))
+        assert runs, "first optimize() captured nothing"
+        opt.set_end_when(optim.max_iteration(12))
+        opt.optimize()
+        assert set(glob.glob(pattern)) == runs, \
+            "second optimize() re-captured into the same log_dir"
+        # explicit re-arm captures again, into a fresh dir
+        opt.set_trace_profile(str(tmp_path / "second"), start_iteration=1,
+                              n_iterations=1)
+        opt.set_end_when(optim.max_iteration(18))
+        opt.optimize()
+        assert glob.glob(str(tmp_path / "second" / "plugins" /
+                             "profile" / "*"))
+
     def test_resume_past_start_iteration_still_captures(self, tmp_path):
         """A run resumed beyond the window's start (evalCounter from a
         snapshot) must still capture once, not silently skip."""
