@@ -320,6 +320,135 @@ def bench_inference(steps: int = 20, warmup: int = 4):
     return records
 
 
+def bench_checkpoint(steps: int = 12, tmp_root: str = None):
+    """Checkpoint-overhead measurement: sync vs async save latency and
+    the step-time impact of checkpointing every iteration.
+
+    Three training legs over the same fused step (none / sync / async
+    checkpoint per iteration) plus isolated save-call timings.  The
+    number that matters for the ISSUE-2 acceptance criterion is
+    ``async_save_blocking_ms`` vs ``sync_save_ms``: the async writer
+    moves serialization's downstream IO (and on remote stores, the whole
+    transfer) off the critical path, so the train loop blocks only for
+    the host fetch + in-memory pickle."""
+    import shutil
+    import tempfile
+
+    import bigdl_tpu.nn as nn
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.dataset import LocalDataSet, SampleToMiniBatch
+    from bigdl_tpu.dataset.datasets import synthetic_separable
+    from bigdl_tpu.optim.optimizer import Checkpoint
+
+    # a model big enough that serialization cost is visible (~8M params,
+    # 32 MB of fp32) but cheap to compile/step
+    def build():
+        import jax
+        m = (nn.Sequential().add(nn.Linear(256, 4096)).add(nn.Tanh())
+             .add(nn.Linear(4096, 1024)).add(nn.Tanh())
+             .add(nn.Linear(1024, 10)).add(nn.LogSoftMax()))
+        m.reset(jax.random.PRNGKey(0))
+        return m
+
+    samples = synthetic_separable(256, 256, n_classes=10, seed=1)
+
+    def run_leg(mode: str) -> float:
+        root = tempfile.mkdtemp(dir=tmp_root, prefix=f"bench_ckpt_{mode}_")
+        try:
+            model = build()
+            ds = LocalDataSet(samples).transform(SampleToMiniBatch(64))
+            opt = optim.Optimizer.create(model, ds, nn.ClassNLLCriterion())
+            opt.set_optim_method(optim.SGD(learning_rate=0.1, momentum=0.9))
+            opt.set_end_when(optim.max_iteration(steps))
+            if mode != "none":
+                opt.set_checkpoint(root, optim.several_iteration(1),
+                                   async_write=(mode == "async"))
+            t0 = time.time()
+            opt.optimize()
+            return (time.time() - t0) / steps * 1e3
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+
+    # each leg builds a fresh jitted closure, so jit's in-process cache
+    # cannot carry over — but main() configures the PERSISTENT compile
+    # cache (jax_compilation_cache_dir), and all four legs trace the
+    # identical HLO: the first leg pays the real compile and populates
+    # the cache, the measured legs pay only a lookup+deserialize.
+    run_leg("none")                  # populate the persistent cache
+    step_none = run_leg("none")      # measured leg
+    step_sync = run_leg("sync")
+    step_async = run_leg("async")
+
+    # isolated save-call latency: how long the train loop BLOCKS per save
+    model = build()
+    model.training()
+    model._ensure_init()
+    method = optim.SGD(learning_rate=0.1, momentum=0.9)
+    method.slots(model.params)
+
+    def save_latency(async_write: bool, label: str, gap_s: float):
+        """Mean time a save call BLOCKS the caller.  ``gap_s`` emulates
+        the compute between checkpoint triggers — that is the window the
+        async writer overlaps into; back-to-back saves would degenerate
+        async to sync (each save joins the still-running previous
+        write)."""
+        root = tempfile.mkdtemp(dir=tmp_root, prefix=f"bench_ckpt_{label}_")
+        try:
+            ckpt = Checkpoint(root, optim.every_epoch(),
+                              async_write=async_write)
+            blocked = []
+            for n in range(1, 7):
+                t0 = time.time()
+                ckpt.save(model, method, n)
+                blocked.append(time.time() - t0)
+                time.sleep(gap_s)
+            t0 = time.time()
+            ckpt.join()
+            drain_ms = (time.time() - t0) * 1e3
+            return float(np.mean(blocked[1:])) * 1e3, drain_ms
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+
+    # gap = one measured step (the cadence of several_iteration(1)),
+    # capped so a compile-inflated or huge-model step cannot hand the
+    # async writer an unrealistically generous overlap window
+    gap_s = min(step_none / 1e3, 0.25)
+
+    sync_ms, _ = save_latency(False, "synlat", gap_s)
+    async_block_ms, async_drain_ms = save_latency(True, "asynclat", gap_s)
+    out = {
+        "model_mb": round(sum(l.size * 4 for l in
+                              __import__("jax").tree_util.tree_leaves(
+                                  model.params)) / 1e6, 1),
+        "sync_save_ms": round(sync_ms, 2),
+        "async_save_blocking_ms": round(async_block_ms, 2),
+        "async_final_drain_ms": round(async_drain_ms, 2),
+        "async_blocking_vs_sync": round(async_block_ms / max(sync_ms, 1e-9),
+                                        3),
+        "step_ms_no_ckpt": round(step_none, 2),
+        "step_ms_sync_ckpt": round(step_sync, 2),
+        "step_ms_async_ckpt": round(step_async, 2),
+        "ckpt_overhead_sync_ms": round(step_sync - step_none, 2),
+        "ckpt_overhead_async_ms": round(step_async - step_none, 2),
+    }
+    _log(f"  checkpoint overhead: sync save {sync_ms:.1f} ms blocks the "
+         f"loop, async save blocks {async_block_ms:.1f} ms "
+         f"(x{out['async_blocking_vs_sync']}); per-step impact "
+         f"sync +{out['ckpt_overhead_sync_ms']:.1f} ms / async "
+         f"+{out['ckpt_overhead_async_ms']:.1f} ms over a "
+         f"{step_none:.1f} ms step")
+    return out
+
+
+def _write_ckpt_artifact(ck: dict) -> dict:
+    """bench_ckpt.json, shared by --ckpt-only and the full run."""
+    record = {"metric": "checkpoint_overhead", "checkpoint": ck}
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "bench_ckpt.json"), "w") as f:
+        json.dump(record, f, indent=1)
+    return record
+
+
 def _make_bench_seqfiles(root: str, n_images: int, files: int = 10):
     """Write a synthetic-image SequenceFile set ONCE (cached across runs):
     256x256 JPEG q90 — the reference's ImageNet seqfile protocol stores
@@ -613,6 +742,9 @@ def main():
                          "nchw = the classic Torch layout for A/B runs")
     ap.add_argument("--quick", action="store_true",
                     help="LeNet only (CI smoke)")
+    ap.add_argument("--ckpt-only", action="store_true",
+                    help="checkpoint-overhead leg only (sync vs async "
+                         "save latency + step-time impact)")
     args = ap.parse_args()
 
     import jax
@@ -620,6 +752,10 @@ def main():
     _log(f"devices: {jax.devices()}")
 
     from bigdl_tpu.models.resnet import resnet, model_init, DatasetType
+
+    if args.ckpt_only:
+        print(json.dumps(_write_ckpt_artifact(bench_checkpoint())))
+        return
 
     if args.quick:
         # LeNet/MNIST (BASELINE config #1 shape) — CI smoke.  The
@@ -827,6 +963,16 @@ def main():
     except Exception as e:  # diagnostic only
         _log(f"long-context bench skipped: {e}")
 
+
+    # Checkpoint-overhead leg: sync vs async save latency and the
+    # step-time impact of per-iteration checkpointing (bench_ckpt.json +
+    # the headline record).  Failures must not touch the headline.
+    try:
+        ck = bench_checkpoint()
+        result["checkpoint"] = ck
+        _write_ckpt_artifact(ck)
+    except Exception as e:  # diagnostic only
+        _log(f"checkpoint bench skipped: {e}")
 
     # Real-data ingest leg: the same ResNet-50 b128 bf16 step fed by the
     # repo's OWN production pipeline (seqfile -> MT decode/assemble ->

@@ -44,6 +44,14 @@ def base_parser(description: str) -> argparse.ArgumentParser:
                    help="where to write model.N/optimMethod.N snapshots")
     p.add_argument("--overwrite", action="store_true",
                    help="overwrite existing checkpoint files")
+    p.add_argument("--ckpt-keep-last", type=int, default=None,
+                   metavar="N",
+                   help="retain only the N newest committed snapshots "
+                        "(default bigdl.checkpoint.keepLast; 0 keeps all)")
+    p.add_argument("--ckpt-async", action="store_true",
+                   help="write snapshots on a background thread (the "
+                        "train step blocks only for capture; writer "
+                        "errors surface at the next save and at exit)")
     p.add_argument("--partitions", type=int, default=1,
                    help="data-parallel partitions; >1 trains with the "
                         "DistriOptimizer over the device mesh")
@@ -102,7 +110,10 @@ def configure(opt, args, default_epochs: int, app_name: str):
         opt.set_end_when(optim.max_epoch(args.max_epoch or default_epochs))
     if args.checkpoint:
         opt.set_checkpoint(args.checkpoint, optim.every_epoch(),
-                           isOverwrite=args.overwrite)
+                           isOverwrite=args.overwrite,
+                           keep_last=getattr(args, "ckpt_keep_last", None),
+                           async_write=(True if getattr(args, "ckpt_async",
+                                                        False) else None))
     if args.log_dir:
         from bigdl_tpu.visualization import TrainSummary, ValidationSummary
         name = args.app_name or app_name

@@ -16,7 +16,8 @@ from bigdl_tpu.optim.validation_method import (ValidationMethod,
 from bigdl_tpu.optim.regularizer import (Regularizer, L1Regularizer,
                                          L2Regularizer, L1L2Regularizer)
 from bigdl_tpu.optim.metrics import Metrics
-from bigdl_tpu.optim.optimizer import Optimizer, LocalOptimizer, Checkpoint
+from bigdl_tpu.optim.optimizer import (Optimizer, LocalOptimizer, Checkpoint,
+                                       DivergenceError)
 from bigdl_tpu.optim.evaluator import (Evaluator, Validator, LocalValidator,
                                        DistriValidator, evaluate_dataset)
 from bigdl_tpu.optim.predictor import Predictor
@@ -32,7 +33,8 @@ __all__ = [
     "min_loss", "ValidationMethod", "ValidationResult", "Top1Accuracy",
     "Top5Accuracy", "Loss", "MAE", "TreeNNAccuracy", "Regularizer", "L1Regularizer",
     "L2Regularizer", "L1L2Regularizer", "Metrics", "Optimizer",
-    "LocalOptimizer", "Checkpoint", "Evaluator", "Validator",
+    "LocalOptimizer", "Checkpoint", "DivergenceError", "Evaluator",
+    "Validator",
     "LocalValidator", "DistriValidator", "evaluate_dataset", "Predictor",
     "LocalPredictor",
 ]

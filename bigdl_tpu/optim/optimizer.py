@@ -16,7 +16,9 @@ summary protocol are kept 1:1.
 from __future__ import annotations
 
 import logging
+import math
 import os
+import random
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -30,12 +32,40 @@ from bigdl_tpu.dataset.dataset import AbstractDataSet, LocalDataSet, ShardedData
 from bigdl_tpu.dataset.sample import MiniBatch
 from bigdl_tpu.nn.module import Container, Criterion, Module
 from bigdl_tpu.optim import trigger as triggers
+from bigdl_tpu.utils import chaos as _chaos
 from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.optim.optim_method import OptimMethod, SGD
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation_method import ValidationMethod, ValidationResult
 
 logger = logging.getLogger("bigdl_tpu")
+
+#: injectable for tests (the backoff suite must not really sleep)
+_sleep = time.sleep
+
+
+class DivergenceError(RuntimeError):
+    """Raised by the driver loop after K consecutive non-finite losses —
+    caught by the retry loop, which restores the latest valid snapshot
+    (graceful degradation instead of silent NaN propagation)."""
+
+
+def _retry_backoff(attempt: int, base: float, cap: float,
+                   rand: Optional[float] = None) -> float:
+    """Capped exponential backoff with jitter for the failure-retry loop.
+
+    Attempt ``a`` waits ``min(base * 2**(a-1), cap)`` scaled by a jitter
+    factor in [0.5, 1.0] — a fleet of workers restarting off one failed
+    storage backend must not stampede it in lockstep.  A cap BELOW the
+    base wins (the operator asked for fast retries); a non-positive cap
+    means uncapped.  ``rand`` pins the jitter for tests."""
+    if base <= 0:
+        return 0.0
+    r = rand if rand is not None else random.random()
+    interval = base * (2.0 ** (max(attempt, 1) - 1))
+    if cap > 0:
+        interval = min(interval, cap)
+    return interval * (0.5 + 0.5 * r)
 
 
 def is_writer_process() -> bool:
@@ -98,6 +128,28 @@ def moe_aux_penalty(model: Module, new_mstate, weight: float):
     return weight * sum(aux)
 
 
+def all_finite(*trees) -> jnp.ndarray:
+    """Scalar bool: every float leaf of every tree is finite.  The
+    divergence guard's trace-time predicate — cheap relative to the step
+    (one reduction per leaf, fused by XLA)."""
+    ok = jnp.array(True)
+    for tree in trees:
+        for leaf in jax.tree_util.tree_leaves(tree):
+            leaf = jnp.asarray(leaf)
+            if jnp.issubdtype(leaf.dtype, jnp.floating):
+                ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(leaf)))
+    return ok
+
+
+def select_tree(ok, new_tree, old_tree):
+    """Per-leaf ``where(ok, new, old)`` — the divergence guard's in-step
+    skip: when the step produced a non-finite loss or gradient, every
+    carry keeps its pre-step value (``where(True, new, old)`` is exactly
+    ``new``, so a healthy step is numerically untouched)."""
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(ok, n, o), new_tree, old_tree)
+
+
 def regularization_penalty(module: Module, params) -> jnp.ndarray:
     """Sum per-layer regularizer penalties over the module tree
     (reference applies them in each layer's accGradParameters,
@@ -120,17 +172,19 @@ def regularization_penalty(module: Module, params) -> jnp.ndarray:
 
 class Checkpoint:
     """model.<neval> / optimMethod.<neval> snapshot protocol
-    (reference ``optim/DistriOptimizer.scala:394-416``).
+    (reference ``optim/DistriOptimizer.scala:394-416``), hardened into
+    verified units by :class:`~bigdl_tpu.utils.checkpoint_manager.
+    CheckpointManager`: every snapshot carries a CRC32C manifest plus a
+    commit marker written last, restore scans newest→oldest skipping
+    torn/uncommitted/corrupt snapshots, ``keep_last`` garbage-collects
+    old committed snapshots, and ``async_write`` moves serialization+IO
+    onto a background writer (errors re-raise at the next save and at
+    exit).
 
     ``path`` may be local or any fsspec scheme (``hdfs://``, ``s3://``,
     ``memory://``, …) — the reference checkpoints to HDFS the same way
     (``File.saveToHdfs:106``); listing/joining go through
     ``utils.file_io`` so ``latest()`` resolves remotely too."""
-
-    def __init__(self, path: str, trigger: Trigger, isOverwrite: bool = True):
-        self.path = path
-        self.trigger = trigger
-        self.overwrite = isOverwrite
 
     #: seconds a ``.tmp_bigdl`` temp must sit untouched before the sweep
     #: may reclaim it.  An atomic save holds its temp open for seconds at
@@ -139,46 +193,32 @@ class Checkpoint:
     #: stalled-but-alive writer) — sweeping those would break THEIR rename.
     TEMP_SWEEP_AGE_S = 3600.0
 
+    def __init__(self, path: str, trigger: Trigger, isOverwrite: bool = True,
+                 keep_last: Optional[int] = None,
+                 async_write: Optional[bool] = None):
+        from bigdl_tpu.utils.checkpoint_manager import CheckpointManager
+        self.path = path
+        self.trigger = trigger
+        self.overwrite = isOverwrite
+        self.manager = CheckpointManager(path, keep_last=keep_last,
+                                         async_write=async_write,
+                                         overwrite=isOverwrite)
+        self.manager.TEMP_SWEEP_AGE_S = self.TEMP_SWEEP_AGE_S
+
     def save(self, model: Module, optim: OptimMethod, neval: int) -> None:
-        import time
-        from bigdl_tpu.utils import file_io
-        file_io.makedirs(self.path)
-        # sweep temps orphaned by a hard-killed earlier writer, age-gated:
-        # a recent temp (or one whose store reports no mtime) may be a
-        # concurrent writer's in-flight atomic write and is left alone
-        now = time.time()
-        for f in file_io.listdir(self.path):
-            if ".tmp_bigdl" in f:
-                full = file_io.join(self.path, f)
-                mtime = file_io.modified_time(full)
-                if mtime is None or now - mtime < self.TEMP_SWEEP_AGE_S:
-                    continue
-                try:
-                    file_io.remove(full)
-                except Exception:
-                    pass
-        file_io.save(model, file_io.join(self.path, f"model.{neval}"),
-                     self.overwrite)
-        file_io.save(optim, file_io.join(self.path, f"optimMethod.{neval}"),
-                     self.overwrite)
+        self.manager.save(model, optim, neval)
 
     def latest(self) -> Optional[Tuple[str, str, int]]:
-        from bigdl_tpu.utils import file_io
-        nevals = []
-        for f in file_io.listdir(self.path):
-            # in-flight atomic-write temps are not snapshots (the temp
-            # suffix carries a unique pid/uuid tail — match the marker
-            # anywhere, not just at the end)
-            if f.startswith("model.") and ".tmp_bigdl" not in f:
-                try:
-                    nevals.append(int(f.split(".")[1]))
-                except ValueError:
-                    pass
-        if not nevals:
-            return None
-        n = max(nevals)
-        return (file_io.join(self.path, f"model.{n}"),
-                file_io.join(self.path, f"optimMethod.{n}"), n)
+        """Newest snapshot that is a complete pair, committed, and
+        checksum-clean (``latest_valid`` semantics: one torn write can
+        never brick recovery)."""
+        return self.manager.latest_valid()
+
+    latest_valid = latest
+
+    def join(self, raise_errors: bool = True) -> None:
+        """Drain the async writer; deferred write errors re-raise here."""
+        self.manager.join(raise_errors=raise_errors)
 
 
 class Optimizer:
@@ -245,8 +285,18 @@ class Optimizer:
         return self
 
     def set_checkpoint(self, path: str, trigger: Trigger,
-                       isOverwrite: bool = True) -> "Optimizer":
-        self.checkpoint = Checkpoint(path, trigger, isOverwrite)
+                       isOverwrite: bool = True,
+                       keep_last: Optional[int] = None,
+                       async_write: Optional[bool] = None) -> "Optimizer":
+        """``keep_last``: retain only the N newest committed snapshots
+        (default ``bigdl.checkpoint.keepLast``; 0 keeps all).
+        ``async_write``: serialize+write snapshots on a background thread
+        so the train step never blocks on (possibly remote) IO — writer
+        errors re-raise at the next save and at exit (default
+        ``bigdl.checkpoint.asyncWrite``)."""
+        self.checkpoint = Checkpoint(path, trigger, isOverwrite,
+                                     keep_last=keep_last,
+                                     async_write=async_write)
         return self
 
     def set_validation(self, trigger: Trigger, dataset: AbstractDataSet,
@@ -304,35 +354,84 @@ class Optimizer:
     def optimize(self) -> Module:
         """Train with failure retry (reference
         ``optim/DistriOptimizer.scala:750-816``): on a non-argument error the
-        latest ``model.N``/``optimMethod.N`` snapshot is restored and training
-        resumes, up to ``bigdl.failure.retryTimes`` attempts."""
+        newest VALID ``model.N``/``optimMethod.N`` snapshot is restored and
+        training resumes, up to ``bigdl.failure.retryTimes`` attempts.
+        Waits between attempts follow capped exponential backoff with
+        jitter (:func:`_retry_backoff`), and — mirroring the reference's
+        ``retryNum`` reset — the attempt counter resets whenever training
+        reaches NEW ground (``evalCounter`` beyond any previous
+        attempt's high-water mark), so a long healthy run is never
+        killed by unrelated failures hours apart, while a deterministic
+        failure that replays the same stretch after every rollback still
+        exhausts the budget."""
         from bigdl_tpu.utils import config
         retry_times = config.get_int("bigdl.failure.retryTimes", 5)
-        retry_interval = config.get_float("bigdl.failure.retryTimeInterval",
-                                          120.0)
+        base = config.get_float("bigdl.failure.retryTimeInterval", 120.0)
+        cap = config.get_float("bigdl.failure.maxRetryInterval", 900.0)
         attempt = 0
-        while True:
-            try:
-                return self._optimize()
-            except (ValueError, TypeError, KeyboardInterrupt):
-                # reference: IllegalArgumentException aborts immediately
-                raise
-            except Exception:
-                attempt += 1
-                if attempt >= retry_times:
+        high_water = None   # furthest evalCounter any attempt reached
+        try:
+            while True:
+                try:
+                    result = self._optimize()
+                except (ValueError, TypeError, KeyboardInterrupt):
+                    # reference: IllegalArgumentException aborts immediately
                     raise
-                restored = self._restore_latest_checkpoint()
-                if not restored and self._params_dead():
-                    # the jitted step donates its carries: without a snapshot
-                    # to reload, the in-memory params are gone — retrying
-                    # would fail on deleted buffers, so surface the original
-                    raise
-                logger.exception(
-                    "Training failed (attempt %d/%d); %s and retrying in "
-                    "%.0fs", attempt, retry_times,
-                    "restored latest checkpoint" if restored else
-                    "resuming from last published state", retry_interval)
-                time.sleep(retry_interval)
+                except Exception as e:
+                    cur = self.optim_method.state.get("evalCounter", 0)
+                    if (not isinstance(e, DivergenceError) and
+                            high_water is not None and cur > high_water):
+                        # NEW ground — training got further than any
+                        # previous attempt, so this is a fresh fault, not
+                        # the same one looping (reference retryNum reset
+                        # on state-version advance, :772-776).  The
+                        # baseline must be the high-water mark across
+                        # attempts: replayed ground after a rollback is
+                        # not progress, or a deterministic failure pinned
+                        # one step past the newest snapshot would reset
+                        # the budget every cycle and retry forever.
+                        # Divergence NEVER resets the budget: guard-
+                        # skipped iterations still advance the counters
+                        # (frozen params, moving evalCounter), so a
+                        # persistently-NaN pipeline would otherwise creep
+                        # the high-water mark every restore cycle and
+                        # loop unbounded.
+                        attempt = 0
+                    high_water = cur if high_water is None else max(
+                        high_water, cur)
+                    attempt += 1
+                    if attempt >= retry_times:
+                        raise
+                    restored = self._restore_latest_checkpoint()
+                    if not restored and self._params_dead():
+                        # the jitted step donates its carries: without a
+                        # snapshot to reload, the in-memory params are gone
+                        # — retrying would fail on deleted buffers, so
+                        # surface the original
+                        raise
+                    interval = _retry_backoff(attempt, base, cap)
+                    logger.exception(
+                        "Training failed (attempt %d/%d); %s and retrying "
+                        "in %.1fs", attempt, retry_times,
+                        "restored latest valid checkpoint" if restored else
+                        "resuming from last published state", interval)
+                    _sleep(interval)
+                    continue
+                # clean exit: surface any deferred async-writer error
+                # BEFORE reporting success — a "finished" run whose last
+                # snapshot silently failed to land is a lie
+                if self.checkpoint is not None:
+                    self.checkpoint.join()
+                return result
+        except BaseException:
+            # already unwinding: drain the writer but never let a deferred
+            # write error mask the original failure
+            if self.checkpoint is not None:
+                try:
+                    self.checkpoint.join(raise_errors=False)
+                except Exception:  # pragma: no cover - defensive
+                    pass
+            raise
 
     def _optimize(self) -> Module:
         raise NotImplementedError
@@ -346,19 +445,36 @@ class Optimizer:
         return False
 
     def _restore_latest_checkpoint(self) -> bool:
-        """Reload the newest model.N/optimMethod.N snapshot into the live
-        model/optim shells (reference ``DistriOptimizer.scala:766-788``).
-        Returns False when there is nothing to restore (no checkpoint
-        configured, or no snapshot written yet)."""
+        """Reload the newest VALID model.N/optimMethod.N snapshot into the
+        live model/optim shells (reference ``DistriOptimizer.scala:766-788``
+        hardened): uncommitted, checksum-failing, or pair-incomplete
+        snapshots are skipped, and a snapshot that fails to deserialize
+        falls back to the next-older one.  Returns False when there is
+        nothing to restore (no checkpoint configured, or no valid
+        snapshot written yet)."""
         if self.checkpoint is None:
             return False
-        latest = self.checkpoint.latest()
-        if latest is None:
+        # drain the async writer first: an in-flight snapshot must either
+        # be fully committed or definitively absent before the scan (its
+        # errors are logged, not raised — we are already recovering)
+        self.checkpoint.join(raise_errors=False)
+        if jax.process_count() > 1:
+            # every rank must scan the same committed set: the writer's
+            # drain (above) happens-before any rank lists the store.
+            # Like the trigger-decision symmetry _check_symmetric_config
+            # enforces, multi-host retry assumes SYMMETRIC failure —
+            # every rank fails the same iteration and enters restore
+            # together.  The failure classes this subsystem introduces
+            # hold that invariant by construction: data/step faults
+            # surface identically on all ranks, divergence works off the
+            # pmean'd loss, and writer-only save errors are allgathered
+            # to every rank by _run_checkpoint before anyone raises.
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("bigdl_restore_scan")
+        loaded = self.checkpoint.manager.load_latest()
+        if loaded is None:
             return False
-        from bigdl_tpu.utils import file_io
-        model_path, optim_path, n = latest
-        loaded_model = file_io.load(model_path)
-        loaded_optim = file_io.load(optim_path)
+        loaded_model, loaded_optim, n = loaded
         self.model.params = loaded_model.params
         self.model.state = loaded_model.state
         if isinstance(self.model, Container):
@@ -409,6 +525,9 @@ class Optimizer:
         # stale loss — effectively depth=1 while such a trigger is
         # installed (the user chose stop-on-loss semantics over latency
         # hiding).
+        from bigdl_tpu.utils import config as _config
+        max_bad_steps = _config.get_int("bigdl.divergence.maxBadSteps", 5)
+
         def drain(item, nxt):
             loss_dev, bsz, t0, epoch, recs, neval = item
             loss = float(loss_dev)
@@ -425,6 +544,26 @@ class Optimizer:
                 "Throughput is %.1f records/second. Loss is %.6f.",
                 epoch, recs, epoch_size, neval, bsz, dt / 1e9, throughput,
                 loss)
+            # divergence guard, host side: the in-step guard already kept
+            # the params/slots/state carries at their pre-step values, so
+            # a bad step costs one wasted iteration, not a poisoned model;
+            # here we count consecutive bad steps and escalate to a
+            # restore-from-snapshot once a transient numeric blip looks
+            # like a genuinely diverged trajectory
+            if not math.isfinite(loss):
+                state["consecutiveBadSteps"] += 1
+                logger.warning(
+                    "Non-finite loss/grads (%s) at iteration %d — update "
+                    "skipped (%d consecutive bad step(s); restore after "
+                    "%d)", loss, neval, state["consecutiveBadSteps"],
+                    max_bad_steps)
+                if 0 < max_bad_steps <= state["consecutiveBadSteps"]:
+                    raise DivergenceError(
+                        f"{state['consecutiveBadSteps']} consecutive "
+                        f"non-finite losses (last at iteration {neval}) — "
+                        "restoring the latest valid snapshot")
+            else:
+                state["consecutiveBadSteps"] = 0
             self._summarize_train(loss, throughput, neval)
 
         pipeline = DispatchPipeline(drain)
@@ -494,6 +633,13 @@ class Optimizer:
                     jax.profiler.start_trace(pdir)
                     profiling = profiled = True
                     profile_end = state["neval"] + self._profile_n
+                if _chaos.active():
+                    # chaos harness step-level hooks: a simulated
+                    # preemption raises here (the retry loop absorbs it);
+                    # a nan-loss injection flags this iteration's loss
+                    inject_nan = _chaos.on_step(state["neval"])
+                else:
+                    inject_nan = False
                 t_data = time.time_ns()
                 inputs, targets, bsz = fetch()
                 self.metrics.add("get batch time", time.time_ns() - t_data)
@@ -506,6 +652,8 @@ class Optimizer:
 
                 t0 = time.time_ns()
                 loss_dev = run_step(inputs, targets, hyper, rng)
+                if inject_nan:
+                    loss_dev = float("nan")
                 self.optim_method.step_done()
                 pipeline.push(loss_dev, bsz, t0, state["epoch"],
                               state["recordsProcessedThisEpoch"] + bsz,
@@ -629,16 +777,41 @@ class Optimizer:
 
     def _run_checkpoint(self, state) -> None:
         # every process reaches this point (the trigger decision is
-        # shared), but only the writer touches the filesystem; the
-        # barrier afterwards keeps non-writers from racing ahead into a
-        # restore (or a crash-retry) that would read a half-finished
-        # snapshot set
+        # shared), but only the writer touches the filesystem; the sync
+        # afterwards keeps non-writers from racing ahead into a restore
+        # (or a crash-retry) that would read a half-finished snapshot
+        # set.  A save failure is inherently WRITER-ONLY — re-raising it
+        # on rank 0 alone would send that rank into the retry loop's
+        # restore barrier while its peers sit at this checkpoint sync,
+        # mispairing the collectives — so the error is withheld until
+        # after an allgathered failure flag lets EVERY rank raise
+        # symmetrically and enter restore together.
+        err: Optional[BaseException] = None
         if is_writer_process():
-            self.checkpoint.save(self.model, self.optim_method,
-                                 state["neval"] - 1)
+            try:
+                self.checkpoint.save(self.model, self.optim_method,
+                                     state["neval"] - 1)
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                err = e
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices("bigdl_checkpoint")
+            flag = np.array([0 if err is None else 1], np.int32)
+            # the allgather doubles as the checkpoint barrier
+            gathered = np.asarray(multihost_utils.process_allgather(flag))
+            if gathered.any():
+                # EVERY rank must raise the SAME retryable class: the
+                # writer re-raising the original (which may be a
+                # non-retryable TypeError, e.g. an unpicklable model
+                # attribute) while peers raise RuntimeError would kill
+                # rank 0 instantly and hang the others at the restore
+                # barrier.  A persistent save failure still dies cleanly
+                # — symmetrically, once the retry budget is spent.
+                raise RuntimeError(
+                    "checkpoint write failed on the writer process "
+                    "(rank 0) — restoring the latest valid snapshot on "
+                    "every rank") from err
+        if err is not None:
+            raise err
 
     def _summarize_train(self, loss: float, throughput: float,
                          neval: int) -> None:
@@ -693,7 +866,7 @@ def _yields_minibatches(ds: AbstractDataSet) -> bool:
 # shared state-key conventions (reference DistriOptimizer driverState)
 def _initial_driver_state() -> Dict[str, Any]:
     return {"epoch": 1, "neval": 1, "Loss": None, "score": None,
-            "recordsProcessedThisEpoch": 0}
+            "recordsProcessedThisEpoch": 0, "consecutiveBadSteps": 0}
 
 
 class LocalOptimizer(Optimizer):
@@ -717,6 +890,8 @@ class LocalOptimizer(Optimizer):
 
         precision = self.precision
         aux_weight = self.moe_aux_weight
+        from bigdl_tpu.utils import config
+        guard = config.get_bool("bigdl.divergence.guard", True)
 
         def step(params, slots, mstate, inputs, targets, hyper, rng):
             def loss_fn(p):
@@ -731,6 +906,18 @@ class LocalOptimizer(Optimizer):
                 loss_fn, has_aux=True)(params)
             new_params, new_slots = optim.pure_update(grads, params, slots,
                                                       hyper)
+            if guard:
+                # divergence guard: a non-finite loss/grad step keeps
+                # every carry at its pre-step value.  The returned loss is
+                # poisoned to NaN whenever the step was skipped — a
+                # non-finite GRADIENT under a finite loss must still reach
+                # the driver's bad-step counter, or a permanently
+                # overflowing backward would freeze training silently
+                ok = all_finite(loss, grads)
+                new_params = select_tree(ok, new_params, params)
+                new_slots = select_tree(ok, new_slots, slots)
+                new_mstate = select_tree(ok, new_mstate, mstate)
+                loss = jnp.where(ok, loss, jnp.nan)
             return new_params, new_slots, new_mstate, loss
 
         return jax.jit(step, donate_argnums=(0, 1, 2))

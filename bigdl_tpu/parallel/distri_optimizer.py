@@ -50,9 +50,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from bigdl_tpu.engine import Engine
 from bigdl_tpu.dataset.dataset import ShardedDataSet
 from bigdl_tpu.nn.module import Criterion, Module
-from bigdl_tpu.optim.optimizer import (Optimizer, mixed_precision_forward,
+from bigdl_tpu.optim.optimizer import (Optimizer, all_finite,
+                                       mixed_precision_forward,
                                        moe_aux_penalty,
-                                       regularization_penalty)
+                                       regularization_penalty, select_tree)
 from bigdl_tpu.parallel.all_reduce import AllReduceParameter
 
 logger = logging.getLogger("bigdl_tpu")
@@ -191,6 +192,8 @@ class DistriOptimizer(Optimizer):
 
         precision = self.precision
         aux_weight = self.moe_aux_weight
+        from bigdl_tpu.utils import config
+        guard = config.get_bool("bigdl.divergence.guard", True)
 
         def shard_step(flat_params, slots, mstate, inputs, targets, hyper, rng):
             # distinct dropout masks per shard, like the reference's
@@ -227,6 +230,25 @@ class DistriOptimizer(Optimizer):
             param_shard = arp.local_shard(flat_params, axis)
             new_shard, new_slots = optim.pure_update(grad_shard, param_shard,
                                                      slots, hyper)
+            if guard:
+                # divergence guard: non-finite loss/grad → every shard
+                # keeps its pre-step slice.  The verdict must be GLOBAL
+                # (pmin over the data axis): each device only sees 1/N of
+                # the gradient vector, and replicas applying different
+                # verdicts would silently fork the model
+                ok = jnp.logical_and(all_finite(loss),
+                                     all_finite(grad_shard))
+                ok = lax.pmin(ok.astype(jnp.int32), axis)
+                for extra in (seq_axis, expert_axis):
+                    if extra:   # seq/expert replicas must agree too
+                        ok = lax.pmin(ok, extra)
+                ok = ok.astype(bool)
+                new_shard = select_tree(ok, new_shard, param_shard)
+                new_slots = select_tree(ok, new_slots, slots)
+                new_mstate = select_tree(ok, new_mstate, mstate)
+                # a skipped step must report non-finite to the driver's
+                # bad-step counter even when only the GRADS overflowed
+                loss = jnp.where(ok, loss, jnp.nan)
             # all-gather the updated weights for the next forward
             new_flat = arp.all_gather_weights(new_shard, axis)
 
@@ -530,6 +552,8 @@ class DistriOptimizer(Optimizer):
         optim = self.optim_method
         precision = self.precision
         aux_weight = self.moe_aux_weight
+        from bigdl_tpu.utils import config
+        guard = config.get_bool("bigdl.divergence.guard", True)
 
         def step(params, slots, mstate, inputs, targets, hyper, rng):
             def loss_fn(p):
@@ -544,6 +568,17 @@ class DistriOptimizer(Optimizer):
                 loss_fn, has_aux=True)(params)
             new_params, new_slots = optim.pure_update(grads, params, slots,
                                                       hyper)
+            if guard:
+                # divergence guard (logically-global arrays: XLA's
+                # partitioner makes the finiteness verdict consistent
+                # across every shard without explicit collectives)
+                ok = all_finite(loss, grads)
+                new_params = select_tree(ok, new_params, params)
+                new_slots = select_tree(ok, new_slots, slots)
+                new_mstate = select_tree(ok, new_mstate, mstate)
+                # a skipped step must report non-finite to the driver's
+                # bad-step counter even when only the GRADS overflowed
+                loss = jnp.where(ok, loss, jnp.nan)
             return new_params, new_slots, new_mstate, loss
 
         return jax.jit(step, donate_argnums=(0, 1, 2),

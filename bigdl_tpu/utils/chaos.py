@@ -1,0 +1,180 @@
+"""Chaos harness: config-driven fault injection for the storage layer and
+the driver loop.
+
+Production training stacks prove their recovery paths by injecting the
+failures they claim to survive (the reference proves its retry loop with a
+model that throws on schedule, ``optim/DistriOptimizerSpec.scala:89-99``).
+This module is the TPU-native fault injector: a thin choke point that
+``utils.file_io`` consults on every payload write and the shared ``_drive``
+loop consults on every iteration.  All behaviour is driven by
+``bigdl.chaos.*`` configuration keys so the same injection plan runs
+identically under pytest, a soak script, or a real cluster rehearsal:
+
+==============================  =============================================
+``bigdl.chaos.failWriteAt``     k: the k-th payload write raises
+                                :class:`ChaosError` after writing a partial
+                                prefix (a torn write + crash — the atomic
+                                temp never reaches its final name).
+``bigdl.chaos.truncateWriteAt`` k: the k-th payload write silently drops the
+                                second half of its bytes and "succeeds" —
+                                the worst case: the rename commits a
+                                corrupt object only a checksum can catch.
+``bigdl.chaos.transientWrites`` n: the first n payload writes raise a
+                                transient :class:`ChaosError` and then
+                                recover — exercises the bounded retry in
+                                ``file_io`` (a blip on ``hdfs://``/``s3://``
+                                must not abort a checkpoint).
+``bigdl.chaos.failStepAt``      k: the driver loop raises at iteration k
+                                (simulated preemption mid-training; the
+                                retry-from-snapshot loop must absorb it).
+``bigdl.chaos.nanLossAt``       "k" or "k:m": the driver reports a
+                                non-finite loss for the k-th..m-th driver
+                                iterations OBSERVED by the harness (counted
+                                across retries, so a restore-and-replay
+                                runs past the span and recovers) —
+                                exercises the divergence guard's host-side
+                                counting without poisoning device state.
+==============================  =============================================
+
+Counters are process-local and monotonically increasing from
+:func:`install`.  ``install()``/``uninstall()`` arm and disarm the
+harness; when disarmed (the default) every hook is a no-op behind a single
+attribute check, so production paths pay nothing.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Tuple
+
+
+class ChaosError(IOError):
+    """An injected storage/step fault.  Subclasses ``IOError`` so the
+    production code paths cannot tell it from a real infrastructure
+    failure — that is the point."""
+
+
+class _ChaosState:
+    """One armed injection plan (counters + parsed config)."""
+
+    def __init__(self):
+        from bigdl_tpu.utils import config
+        self.fail_write_at = config.get_int("bigdl.chaos.failWriteAt", 0)
+        self.truncate_write_at = config.get_int(
+            "bigdl.chaos.truncateWriteAt", 0)
+        self.transient_writes = config.get_int(
+            "bigdl.chaos.transientWrites", 0)
+        self.fail_step_at = config.get_int("bigdl.chaos.failStepAt", 0)
+        self.nan_loss_at = _parse_span(
+            config.get_property("bigdl.chaos.nanLossAt"))
+        self.writes = 0
+        self.steps_failed = 0
+        self.steps_seen = 0
+        self.transient_raised = 0
+        self._lock = threading.Lock()
+
+    # ---- storage-layer hooks -------------------------------------------
+
+    def on_write(self, path: str, data: bytes) -> bytes:
+        """Called by ``file_io`` with every payload about to be written.
+        Returns the (possibly corrupted) bytes to write, or raises."""
+        with self._lock:
+            # transient faults count ATTEMPTS, not completed writes: the
+            # retrying caller sees n failures then a clean success
+            if self.transient_raised < self.transient_writes:
+                self.transient_raised += 1
+                raise ChaosError(
+                    f"chaos: transient write failure "
+                    f"{self.transient_raised}/{self.transient_writes} "
+                    f"on {path}")
+            self.writes += 1
+            k = self.writes
+        if k == self.truncate_write_at:
+            # silent torn write: rename will still commit it
+            return data[:max(1, len(data) // 2)]
+        if k == self.fail_write_at:
+            raise _TornWrite(path, data[:max(1, len(data) // 2)])
+        return data
+
+    # ---- driver-loop hooks ---------------------------------------------
+
+    def on_step(self, neval: int) -> bool:
+        """Called by the driver loop at the top of iteration ``neval``.
+        Raises for a simulated preemption; returns True when the loss of
+        this iteration should be reported non-finite."""
+        with self._lock:
+            self.steps_seen += 1
+            seen = self.steps_seen
+        if self.fail_step_at and neval == self.fail_step_at:
+            with self._lock:
+                if self.steps_failed == 0:   # preempt once, not every retry
+                    self.steps_failed += 1
+                    raise ChaosError(
+                        f"chaos: simulated preemption at iteration {neval}")
+        lo, hi = self.nan_loss_at
+        return bool(lo) and lo <= seen <= hi
+
+
+class _TornWrite(ChaosError):
+    """fail-the-k-th-write: carries the partial prefix so the storage
+    layer can leave the torn temp behind (a hard-killed writer does not
+    clean up after itself)."""
+
+    #: a died writer is not a blip — the storage retry must not absorb it
+    fatal = True
+
+    def __init__(self, path: str, partial: bytes):
+        super().__init__(f"chaos: writer died mid-write on {path}")
+        self.partial = partial
+
+
+def _parse_span(value) -> Tuple[int, int]:
+    """``"k"`` -> (k, k); ``"k:m"`` -> (k, m); falsy -> (0, -1)."""
+    if not value:
+        return (0, -1)
+    s = str(value)
+    if ":" in s:
+        lo, hi = s.split(":", 1)
+        return (int(lo), int(hi))
+    k = int(s)
+    return (k, k)
+
+
+_state: Optional[_ChaosState] = None
+
+
+def install() -> None:
+    """Arm the harness from the current ``bigdl.chaos.*`` configuration.
+    Re-installing resets all counters (each test/rehearsal starts a fresh
+    injection plan)."""
+    global _state
+    _state = _ChaosState()
+
+
+def uninstall() -> None:
+    global _state
+    _state = None
+
+
+def active() -> bool:
+    return _state is not None
+
+
+def on_write(path: str, data: bytes) -> bytes:
+    """file_io payload-write hook (identity when disarmed)."""
+    if _state is None:
+        return data
+    return _state.on_write(path, data)
+
+
+def on_step(neval: int) -> bool:
+    """Driver-loop hook; True means "report this iteration's loss as
+    non-finite" (divergence-guard exercise)."""
+    if _state is None:
+        return False
+    return _state.on_step(neval)
+
+
+def write_count() -> int:
+    """Payload writes observed since install (diagnostics for tests)."""
+    return _state.writes if _state is not None else 0

@@ -22,7 +22,20 @@ _DEFAULTS: Dict[str, Any] = {
     "bigdl.localMode": False,
     "bigdl.coreNumber": None,              # discovered from jax
     "bigdl.failure.retryTimes": 5,
-    "bigdl.failure.retryTimeInterval": 120,  # seconds
+    "bigdl.failure.retryTimeInterval": 120,  # base backoff seconds
+    "bigdl.failure.maxRetryInterval": 900,   # backoff cap (exponential+jitter)
+    "bigdl.io.retryTimes": 3,                # remote-fs transient retry budget
+    "bigdl.io.retryInterval": 0.1,           # remote-fs retry base seconds
+    "bigdl.checkpoint.keepLast": 0,          # snapshot retention; 0 = keep all
+    "bigdl.checkpoint.asyncWrite": False,    # background checkpoint writer
+    "bigdl.divergence.guard": True,          # skip non-finite updates in-step
+    "bigdl.divergence.maxBadSteps": 5,       # consecutive bad steps → restore
+    # chaos-injection harness (utils/chaos.py); 0/None = disarmed
+    "bigdl.chaos.failWriteAt": 0,
+    "bigdl.chaos.truncateWriteAt": 0,
+    "bigdl.chaos.transientWrites": 0,
+    "bigdl.chaos.failStepAt": 0,
+    "bigdl.chaos.nanLossAt": None,
     "bigdl.check.singleton": False,
     "bigdl.summary.flushSecs": 2.0,
     "bigdl.compilation.cacheDir": None,    # jax persistent compile cache

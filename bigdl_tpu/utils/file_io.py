@@ -7,18 +7,68 @@ Local paths pickle directly (atomic temp-file + rename).  Remote schemes
 (``hdfs://``, ``s3://``, ``gs://``, …) dispatch through fsspec, which maps
 each scheme to its filesystem client (pyarrow-HDFS, s3fs, …) and raises a
 clear error naming the missing client when one is not installed.
+
+Every payload write funnels through :func:`write_bytes` — the single choke
+point where (a) atomic temp-file + rename semantics live, (b) the chaos
+harness (``utils.chaos``) may inject torn/truncated/transient write faults,
+and (c) the transient-error retry wraps remote operations: a network blip
+on ``hdfs://``/``s3://`` is retried with bounded exponential backoff
+(``bigdl.io.retryTimes`` / ``bigdl.io.retryInterval``) instead of aborting
+a checkpoint.  Non-transient failures (missing files, permission errors,
+exists-with-overwrite-False) are never retried.
 """
 
 from __future__ import annotations
 
+import logging
 import os
 import pickle
 import tempfile
+import time
 import uuid
 from typing import Any
 
+from bigdl_tpu.utils import chaos
+
+logger = logging.getLogger("bigdl_tpu")
+
 _REMOTE_SCHEMES = ("hdfs://", "s3://", "s3a://", "s3n://", "gs://",
                    "abfs://", "http://", "https://", "memory://")
+
+#: injectable for tests (no real sleeping in tier-1)
+_sleep = time.sleep
+
+#: OSError subclasses that indicate a *state* problem, not an
+#: infrastructure blip — retrying cannot help and may mask bugs.
+_NON_TRANSIENT = (FileNotFoundError, FileExistsError, IsADirectoryError,
+                  NotADirectoryError, PermissionError)
+
+
+def _is_transient(e: BaseException) -> bool:
+    if getattr(e, "fatal", False):   # chaos "writer died" simulation
+        return False
+    return (isinstance(e, (OSError, TimeoutError)) and
+            not isinstance(e, _NON_TRANSIENT))
+
+
+def _retrying(fn, *args, op: str = ""):
+    """Run ``fn(*args)`` with a bounded transient-error retry (remote
+    operations only — local filesystems don't blip, they fail)."""
+    from bigdl_tpu.utils import config
+    attempts = max(1, config.get_int("bigdl.io.retryTimes", 3))
+    base = config.get_float("bigdl.io.retryInterval", 0.1)
+    for attempt in range(1, attempts + 1):
+        try:
+            return fn(*args)
+        except Exception as e:
+            if attempt >= attempts or not _is_transient(e):
+                raise
+            delay = base * (2.0 ** (attempt - 1))
+            logger.warning(
+                "transient %s failure (attempt %d/%d, retrying in %.2fs): "
+                "%r", op or getattr(fn, "__name__", "io"), attempt,
+                attempts, delay, e)
+            _sleep(delay)
 
 
 def _is_remote(path: str) -> bool:
@@ -35,7 +85,12 @@ def _dealias(path: str) -> str:
 
 def _fs(path: str):
     """(filesystem, in-fs path) for a remote scheme via fsspec."""
-    import fsspec
+    try:
+        import fsspec
+    except ImportError as e:  # pragma: no cover - fsspec is in the image
+        raise NotImplementedError(
+            f"remote filesystem scheme in {path!r} needs fsspec "
+            "(reference: utils/File.scala:106)") from e
     fs, fpath = fsspec.core.url_to_fs(_dealias(path))
     return fs, fpath
 
@@ -45,7 +100,7 @@ def makedirs(path: str) -> None:
     (reference checkpoints live under an HDFS dir, ``File.scala:106``)."""
     if _is_remote(path):
         fs, p = _fs(path)
-        fs.makedirs(p, exist_ok=True)
+        _retrying(lambda: fs.makedirs(p, exist_ok=True), op="makedirs")
         return
     if path.startswith("file://"):
         path = path[len("file://"):]
@@ -56,10 +111,14 @@ def listdir(path: str):
     """Base names under a local or remote directory; [] when absent."""
     if _is_remote(path):
         fs, p = _fs(path)
-        if not fs.exists(p):
-            return []
-        return [e.rstrip("/").rsplit("/", 1)[-1]
-                for e in fs.ls(p, detail=False)]
+
+        def _ls():
+            if not fs.exists(p):
+                return []
+            return [e.rstrip("/").rsplit("/", 1)[-1]
+                    for e in fs.ls(p, detail=False)]
+
+        return _retrying(_ls, op="listdir")
     if path.startswith("file://"):
         path = path[len("file://"):]
     if not os.path.isdir(path):
@@ -74,42 +133,65 @@ def join(base: str, *parts: str) -> str:
     return os.path.join(base, *parts)
 
 
-def _fsspec_open(path: str, mode: str):
+def size(path: str):
+    """Byte size of a local or remote object, or ``None`` when the store
+    cannot report one.  Lets callers verify a payload against its
+    manifest-recorded length with one stat instead of a full read —
+    truncation (the realistic torn-write mode: the rename still commits
+    a short object) is caught without transferring multi-GB snapshots."""
     try:
-        import fsspec
-    except ImportError as e:  # pragma: no cover - fsspec is in the image
-        raise NotImplementedError(
-            f"remote filesystem scheme in {path!r} needs fsspec "
-            "(reference: utils/File.scala:106)") from e
-    return fsspec.open(_dealias(path), mode)
+        if _is_remote(path):
+            fs, p = _fs(path)
+            return int(_retrying(lambda: fs.size(p), op="size"))
+        if path.startswith("file://"):
+            path = path[len("file://"):]
+        return os.path.getsize(path)
+    except Exception:
+        return None
 
 
-def save(obj: Any, path: str, overwrite: bool = True) -> None:
-    """Serialize ``obj`` to ``path`` (reference ``File.save:67`` /
-    ``saveToHdfs:106``).  Local writes are atomic (temp file + rename)."""
-    if _is_remote(path):
-        fs, p = _fs(path)
-        if not overwrite and fs.exists(p):
-            raise FileExistsError(f"{path} already exists and overwrite is "
-                                  "False (reference File.scala overWrite)")
-        # write-then-rename, mirroring the local atomic path: a crash
-        # mid-write must not leave a truncated snapshot that
-        # Checkpoint.latest() would pick as the newest and retry-load
-        # forever.  The temp name is unique per process: on a shared
-        # store two writers racing on the same destination must never
-        # mv each other's half-written temp
-        tmp = f"{p}.tmp_bigdl.{os.getpid()}.{uuid.uuid4().hex[:8]}"
-        try:
+def _write_bytes_remote(path: str, data: bytes, overwrite: bool) -> None:
+    fs, p = _fs(path)
+    if not overwrite and fs.exists(p):
+        raise FileExistsError(f"{path} already exists and overwrite is "
+                              "False (reference File.scala overWrite)")
+    # write-then-rename, mirroring the local atomic path: a crash
+    # mid-write must not leave a truncated snapshot that restore would
+    # pick as the newest and retry-load forever.  The temp name is
+    # unique per process: on a shared store two writers racing on the
+    # same destination must never mv each other's half-written temp
+    tmp = f"{p}.tmp_bigdl.{os.getpid()}.{uuid.uuid4().hex[:8]}"
+    try:
+        payload = chaos.on_write(path, data)
+    except BaseException as e:
+        partial = getattr(e, "partial", None)
+        if partial is not None:
+            # a "writer died mid-write": the torn temp stays behind,
+            # exactly like a hard-killed process would leave it
             with fs.open(tmp, "wb") as f:
-                pickle.dump(obj, f, protocol=pickle.HIGHEST_PROTOCOL)
-            fs.mv(tmp, p)
-        except BaseException:
-            try:
-                if fs.exists(tmp):
-                    fs.rm(tmp)
-            except Exception:
-                pass
-            raise
+                f.write(partial)
+        raise
+    try:
+        with fs.open(tmp, "wb") as f:
+            f.write(payload)
+        fs.mv(tmp, p)
+    except BaseException:
+        try:
+            if fs.exists(tmp):
+                fs.rm(tmp)
+        except Exception:
+            pass
+        raise
+
+
+def write_bytes(path: str, data: bytes, overwrite: bool = True) -> None:
+    """Atomically write ``data`` to a local or remote path (temp file +
+    rename).  The single payload-write choke point: chaos injection and
+    the remote transient retry both live here."""
+    data = bytes(data)
+    if _is_remote(path):
+        _retrying(_write_bytes_remote, path, data, overwrite,
+                  op="write")
         return
     if path.startswith("file://"):
         path = path[len("file://"):]
@@ -121,13 +203,47 @@ def save(obj: Any, path: str, overwrite: bool = True) -> None:
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp_bigdl_")
     try:
+        payload = chaos.on_write(path, data)
+    except BaseException as e:
+        partial = getattr(e, "partial", None)
+        if partial is not None:
+            with os.fdopen(fd, "wb") as f:
+                f.write(partial)
+        else:
+            os.close(fd)
+            os.unlink(tmp)
+        raise
+    try:
         with os.fdopen(fd, "wb") as f:
-            pickle.dump(obj, f, protocol=pickle.HIGHEST_PROTOCOL)
+            f.write(payload)
         os.replace(tmp, path)
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
+
+
+def read_bytes(path: str) -> bytes:
+    """Read a local or remote object fully into memory."""
+    if _is_remote(path):
+        fs, p = _fs(path)
+
+        def _read():
+            with fs.open(p, "rb") as f:
+                return f.read()
+
+        return _retrying(_read, op="read")
+    if path.startswith("file://"):
+        path = path[len("file://"):]
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def save(obj: Any, path: str, overwrite: bool = True) -> None:
+    """Serialize ``obj`` to ``path`` (reference ``File.save:67`` /
+    ``saveToHdfs:106``).  Atomic on local and remote paths alike."""
+    write_bytes(path, pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL),
+                overwrite)
 
 
 def modified_time(path: str):
@@ -152,8 +268,12 @@ def remove(path: str) -> None:
     sweep orphaned atomic-write temps left by hard-killed writers)."""
     if _is_remote(path):
         fs, p = _fs(path)
-        if fs.exists(p):
-            fs.rm(p)
+
+        def _rm():
+            if fs.exists(p):
+                fs.rm(p)
+
+        _retrying(_rm, op="remove")
         return
     if path.startswith("file://"):
         path = path[len("file://"):]
@@ -163,10 +283,4 @@ def remove(path: str) -> None:
 
 def load(path: str) -> Any:
     """Deserialize from ``path`` (reference ``File.load:162``)."""
-    if _is_remote(path):
-        with _fsspec_open(path, "rb") as f:
-            return pickle.load(f)
-    if path.startswith("file://"):
-        path = path[len("file://"):]
-    with open(path, "rb") as f:
-        return pickle.load(f)
+    return pickle.loads(read_bytes(path))

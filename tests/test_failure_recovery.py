@@ -104,6 +104,145 @@ class TestRetryFromCheckpoint:
             opt.optimize()  # mesh/partition mismatch: no retry loop
 
 
+class TestSnapshotPairing:
+    def test_latest_requires_model_optim_pair(self, tmp_path):
+        """A crash between the ``model.N`` and ``optimMethod.N`` saves
+        leaves a model-only snapshot: ``latest()`` must skip it and hand
+        back the newest COMPLETE pair (regression — the old scan keyed on
+        ``model.*`` alone and restore crashed on the missing optim)."""
+        from bigdl_tpu.optim.optimizer import Checkpoint
+        ckpt = Checkpoint(str(tmp_path), optim.every_epoch())
+        ckpt.save(_mlp(4, 2), optim.SGD(learning_rate=0.1), 3)
+        file_io.save(_mlp(4, 2), str(tmp_path / "model.7"))  # torn: no pair
+        model_path, optim_path, n = ckpt.latest()
+        assert n == 3
+        assert model_path.endswith("model.3")
+        # both halves load
+        file_io.load(model_path)
+        assert file_io.load(optim_path).state is not None
+
+    def test_restore_falls_back_past_unloadable_snapshot(self, tmp_path):
+        """``file_io.load`` failing on the newest snapshot must not kill
+        the retry loop: restore walks to the next-older snapshot."""
+        from bigdl_tpu.optim.optimizer import Checkpoint
+        samples = synthetic_separable(64, 4, n_classes=2)
+        ds = LocalDataSet(samples).transform(SampleToMiniBatch(32))
+        opt = optim.Optimizer.create(_mlp(4, 2), ds, nn.ClassNLLCriterion())
+        method = optim.SGD(learning_rate=0.1)
+        method.state["evalCounter"] = 3
+        opt.set_checkpoint(str(tmp_path), optim.every_epoch())
+        opt.checkpoint.save(opt.model, method, 3)
+        # newest snapshot: a complete legacy pair whose model pickle is
+        # garbage (no manifest, so only the unpickler can catch it)
+        (tmp_path / "model.9").write_bytes(b"\x80\x04 not a pickle")
+        file_io.save(optim.SGD(learning_rate=0.1), str(tmp_path /
+                                                       "optimMethod.9"))
+        assert opt._restore_latest_checkpoint()
+        assert opt.optim_method.state["evalCounter"] == 3
+
+
+class TestRetryBackoff:
+    def test_capped_exponential_with_jitter(self):
+        from bigdl_tpu.optim.optimizer import _retry_backoff
+        # jitter pinned at 1.0: pure capped exponential
+        assert _retry_backoff(1, 2.0, 8.0, rand=1.0) == 2.0
+        assert _retry_backoff(2, 2.0, 8.0, rand=1.0) == 4.0
+        assert _retry_backoff(3, 2.0, 8.0, rand=1.0) == 8.0
+        assert _retry_backoff(9, 2.0, 8.0, rand=1.0) == 8.0   # capped
+        # a cap BELOW the base wins (operator asked for fast retries)...
+        assert _retry_backoff(3, 120.0, 30.0, rand=1.0) == 30.0
+        # ...and a non-positive cap means uncapped
+        assert _retry_backoff(5, 2.0, 0.0, rand=1.0) == 32.0
+        # jitter floor is half the interval
+        assert _retry_backoff(3, 2.0, 8.0, rand=0.0) == 4.0
+        # a zero base (the test fixture's config) never sleeps
+        assert _retry_backoff(5, 0.0, 900.0) == 0.0
+        # random jitter stays within [0.5, 1.0] x interval
+        for _ in range(20):
+            v = _retry_backoff(2, 2.0, 8.0)
+            assert 2.0 <= v <= 4.0
+
+    def test_sleeps_follow_backoff_with_patched_clock(self, tmp_path):
+        """No real sleeping in tier-1: the retry loop's waits go through
+        the injectable ``optimizer._sleep`` and must grow exponentially
+        up to the cap."""
+        from bigdl_tpu.optim import optimizer as optimizer_mod
+
+        class AlwaysFail(Transformer):
+            def __call__(self, it):
+                for _ in it:
+                    raise RuntimeError("permanent failure")
+                yield  # pragma: no cover
+
+        samples = synthetic_separable(64, 4, n_classes=2)
+        ds = (LocalDataSet(samples).transform(SampleToMiniBatch(32))
+              .transform(AlwaysFail()))
+        opt = optim.Optimizer.create(_mlp(4, 2), ds, nn.ClassNLLCriterion())
+        opt.set_end_when(optim.max_epoch(2))
+        config.set_property("bigdl.failure.retryTimes", 4)
+        config.set_property("bigdl.failure.retryTimeInterval", 2.0)
+        config.set_property("bigdl.failure.maxRetryInterval", 4.0)
+        slept = []
+        orig = optimizer_mod._sleep
+        optimizer_mod._sleep = slept.append
+        try:
+            with pytest.raises(RuntimeError, match="permanent failure"):
+                opt.optimize()
+        finally:
+            optimizer_mod._sleep = orig
+            for k in ("bigdl.failure.retryTimes",
+                      "bigdl.failure.maxRetryInterval"):
+                config.clear_property(k)
+        # 4 attempts -> 3 waits; attempt a waits in
+        # [0.5, 1.0] x min(2*2^(a-1), 4)
+        assert len(slept) == 3, slept
+        assert 1.0 <= slept[0] <= 2.0
+        assert 2.0 <= slept[1] <= 4.0
+        assert 2.0 <= slept[2] <= 4.0   # capped at maxRetryInterval
+
+    def test_attempt_counter_resets_on_progress(self, tmp_path):
+        """Mirrors the reference's retryNum reset: failures separated by
+        real training progress must each start a fresh attempt budget —
+        three spaced failures survive a retryTimes=2 budget that two
+        back-to-back failures would exhaust."""
+
+        class FailEvery(Transformer):
+            """Trips once at each configured batch count."""
+
+            def __init__(self, fail_ats):
+                self.fail_ats = set(fail_ats)
+                self.seen = 0
+                self.trips = 0
+
+            def __call__(self, it):
+                for batch in it:
+                    self.seen += 1
+                    if self.seen in self.fail_ats:
+                        self.fail_ats.discard(self.seen)
+                        self.trips += 1
+                        raise RuntimeError("injected repeated failure")
+                    yield batch
+
+        samples = synthetic_separable(128, 4, n_classes=2, seed=3)
+        injector = FailEvery([6, 12, 18])
+        ds = (LocalDataSet(samples).transform(SampleToMiniBatch(32))
+              .transform(injector))
+        opt = optim.Optimizer.create(_mlp(4, 2), ds, nn.ClassNLLCriterion())
+        opt.set_optim_method(optim.SGD(learning_rate=0.5))
+        opt.set_end_when(optim.max_epoch(8))
+        opt.set_checkpoint(str(tmp_path / "ckpt"),
+                           optim.several_iteration(2))
+        config.set_property("bigdl.failure.retryTimes", 2)
+        try:
+            trained = opt.optimize()
+        finally:
+            config.clear_property("bigdl.failure.retryTimes")
+        assert injector.trips == 3, "not every failure fired"
+        acc = Evaluator(trained).test(
+            samples, [optim.Top1Accuracy()], 32)[0][1].final_result()
+        assert acc > 0.9, f"training did not recover: acc={acc}"
+
+
 class TestKillAndResume:
     def test_resumed_run_matches_uninterrupted(self, tmp_path):
         """Train 2 epochs + checkpoint, 'kill', resume from snapshot for 2

@@ -174,16 +174,17 @@ _CKPT_WORKER = textwrap.dedent("""
     from bigdl_tpu.engine import Engine
     Engine.init_distributed(f"127.0.0.1:{port}", nproc, pid)
 
-    # audit every filesystem write this process performs: the
+    # audit every filesystem payload write this process performs (every
+    # persistence path funnels through file_io.write_bytes): the
     # single-writer discipline says rank 1 must never touch the
     # checkpoint or summary stores
     from bigdl_tpu.utils import file_io
     _saves = []
-    _orig_save = file_io.save
-    def _counting_save(obj, path, overwrite=True):
+    _orig_write = file_io.write_bytes
+    def _counting_write(path, data, overwrite=True):
         _saves.append(path)
-        return _orig_save(obj, path, overwrite)
-    file_io.save = _counting_save
+        return _orig_write(path, data, overwrite)
+    file_io.write_bytes = _counting_write
 
     import numpy as np
     import bigdl_tpu.nn as nn
@@ -221,7 +222,12 @@ _CKPT_WORKER = textwrap.dedent("""
     opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion(), mesh=mesh)
     opt.set_optim_method(method)
     opt.set_end_when(optim.max_iteration(4 if phase == "train" else 8))
-    opt.set_checkpoint(ckptdir, optim.several_iteration(2))
+    # train phase exercises ASYNC checkpointing under multi-host: the
+    # write runs on rank 0's background writer while every rank syncs on
+    # the capture barrier; the resume phase then proves the committed
+    # snapshots are restorable by a fresh process group
+    opt.set_checkpoint(ckptdir, optim.several_iteration(2),
+                       async_write=(phase == "train"))
     trained = opt.optimize()
     # the distributed-accumulator metric kind: both ranks must agree on
     # the cross-process aggregate even though their local timings differ
@@ -273,10 +279,13 @@ def test_multi_process_checkpoint_kill_resume(nproc):
             tempfile.TemporaryDirectory() as ckptdir:
         _run_pair(_CKPT_WORKER, [outdir, ckptdir, "train"],
                   "CKPT_WORKER_OK", nproc=nproc)
-        # snapshots exist exactly once, written by rank 0 alone
+        # snapshots exist exactly once, written by rank 0 alone — and
+        # each is a COMMITTED verified unit (manifest + commit marker)
         names = sorted(os.listdir(ckptdir))
         assert "model.1" in names and "model.3" in names, names
         assert "optimMethod.3" in names, names
+        assert "manifest.1" in names and "commit.1" in names, names
+        assert "manifest.3" in names and "commit.3" in names, names
         assert not [n for n in names if ".tmp_bigdl" in n], names
         saves0 = open(os.path.join(outdir, "ck_train_saves0.txt")).read()
         assert saves0.count("model.") == 2 and "optimMethod.3" in saves0
@@ -437,11 +446,11 @@ _RETRY_WORKER = textwrap.dedent("""
     from bigdl_tpu.utils import config, file_io
     config.set_property("bigdl.failure.retryTimeInterval", 0.0)
     _saves = []
-    _orig_save = file_io.save
-    def _counting_save(obj, path, overwrite=True):
+    _orig_write = file_io.write_bytes
+    def _counting_write(path, data, overwrite=True):
         _saves.append(path)
-        return _orig_save(obj, path, overwrite)
-    file_io.save = _counting_save
+        return _orig_write(path, data, overwrite)
+    file_io.write_bytes = _counting_write
 
     import numpy as np
     import bigdl_tpu.nn as nn
