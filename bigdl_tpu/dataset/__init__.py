@@ -10,6 +10,7 @@ from bigdl_tpu.dataset.transformer import (Transformer, ChainedTransformer,
                                            SampleToBatch)
 from bigdl_tpu.dataset.dataset import (AbstractDataSet, LocalDataSet,
                                        ShardedDataSet, DataSet)
+from bigdl_tpu.dataset.ingest import ShardedSeqFileReader, StreamingIngest
 from bigdl_tpu.dataset import image
 from bigdl_tpu.dataset import text
 from bigdl_tpu.dataset import datasets
@@ -17,4 +18,5 @@ from bigdl_tpu.dataset import datasets
 __all__ = ["Sample", "MiniBatch", "PaddingParam", "Transformer",
            "ChainedTransformer", "FuncTransformer", "SampleToMiniBatch",
            "SampleToBatch", "AbstractDataSet", "LocalDataSet",
-           "ShardedDataSet", "DataSet", "image", "text", "datasets"]
+           "ShardedDataSet", "DataSet", "ShardedSeqFileReader",
+           "StreamingIngest", "image", "text", "datasets"]

@@ -204,25 +204,25 @@ class DataSet:
         return ShardedDataSet(records, partition_num)
 
     @staticmethod
-    def seq_file_folder(path: str) -> "LocalDataSet":
+    def seq_file_folder(path: str,
+                        shards: Optional[int] = None) -> "LocalDataSet":
         """Hadoop SequenceFile tree of JPEG records (reference
         ``SeqFileFolder.files``, ``dataset/DataSet.scala:500-558``): every
         ``*.seq`` under ``path``.  Records hold the COMPRESSED bytes
         (ImageNet scale must not decode up-front); a built-in transformer
         decodes to BGR :class:`~bigdl_tpu.dataset.image.LabeledImage`
-        per epoch pass."""
-        import os as _os
-        from bigdl_tpu.dataset.image import BytesToBGRImg, LabeledImageBytes
-        from bigdl_tpu.dataset.seqfile import read_image_seqfile
+        per epoch pass.
 
-        records = []
-        for root, _, files in sorted(_os.walk(path)):
-            for fname in sorted(files):
-                if not fname.endswith(".seq"):
-                    continue
-                for name, label, data in read_image_seqfile(
-                        _os.path.join(root, fname)):
-                    records.append(LabeledImageBytes(name, label, data))
+        Loading streams through
+        :class:`~bigdl_tpu.dataset.ingest.ShardedSeqFileReader`
+        (``shards`` reader threads, default ``bigdl.ingest.shards``) — IO
+        and record parsing of the files overlap, while the record ORDER
+        stays exactly the sorted-walk sequence a sequential sweep yields
+        (the sharded reader's merge contract)."""
+        from bigdl_tpu.dataset.image import BytesToBGRImg
+        from bigdl_tpu.dataset.ingest import ShardedSeqFileReader
+
+        records = list(ShardedSeqFileReader(path, shards=shards))
         return LocalDataSet(records, [BytesToBGRImg()])
 
     @staticmethod

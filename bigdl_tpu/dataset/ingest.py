@@ -1,0 +1,616 @@
+"""Streaming stage-pipelined ingest engine: the real-data hot path.
+
+Reference equivalent: ``dataset/image/MTLabeledBGRImgToBatch.scala:46`` —
+the production ImageNet lesson that host-side batch prep must overlap both
+itself (decode vs assemble) and device compute.  The synchronous
+:class:`~bigdl_tpu.dataset.mt_batch.MTLabeledBGRImgToBatch` executes
+read → decode → assemble serially *per batch* (``pool.map`` is a batch
+barrier, assemble runs while the pool sits idle); BENCH_r05 measured that
+structure at 0.56x of the decode-alone ceiling.  This module removes the
+barriers:
+
+    sharded seqfile readers ──► record ring ──► decode pool ──► ordered
+    decoded window ──► assembler (native pack, GIL-released) ──► batch
+    ring ──► consumer (── engine.BatchPrefetcher keeps N device uploads
+    in flight beyond this point)
+
+Every stage is decoupled by a bounded ring (backpressure, never unbounded
+memory) and instrumented: items, busy seconds, stall seconds split into
+*starve* (waiting for the upstream stage) and *backpressure* (blocked on a
+full downstream ring), plus mean ring occupancy.  ``stats()`` snapshots
+feed ``bench.py --ingest-only`` (``bench_ingest.json``) and the training
+summary layer — the stage with high busy and low stall is the bottleneck.
+
+Determinism contract (the part that makes this usable for training, not
+just benchmarks): crop offsets / flips draw from a CLONE of the caller's
+``RandomGenerator`` stream in strict record order, and each batch carries
+the post-draw RNG state; the clone's position is committed back to the
+caller's stream only when the batch is CONSUMED.  Pipeline read-ahead that
+gets discarded (an epoch rollover replacing the chain) therefore never
+advances the user-visible stream — the pipelined engine reproduces the
+synchronous path's batch sequence bit for bit at every depth setting, and
+epoch rollover / reshuffle stays producer-owned exactly as before
+(``engine.BatchPrefetcher``'s single-drawer contract).  With MULTIPLE
+engines forked from one stream (a multi-shard ``ShardedDataSet``), only
+the first fork commits; the others draw decorrelated deterministic
+per-shard streams (the reference's per-partition RNG model) — sync-path
+bit-parity is a single-engine contract, multi-shard runs are run-to-run
+deterministic.
+
+Configuration (``bigdl.ingest.*``, see ``utils/config.py``):
+
+===============================  =============================================
+``bigdl.ingest.shards``          parallel seqfile reader threads
+``bigdl.ingest.decodeWorkers``   decode pool size (default: host cores)
+``bigdl.ingest.recordRingDepth`` reader → decode record ring depth
+``bigdl.ingest.decodedRingDepth``in-flight decode window (default 2x batch)
+``bigdl.ingest.batchRingDepth``  assembled batches buffered ahead
+``bigdl.ingest.batchesInFlight`` device uploads in flight (BatchPrefetcher)
+===============================  =============================================
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu.dataset.transformer import Transformer
+from bigdl_tpu.utils import config
+
+#: live engines, for the summary layer (weak: an abandoned engine must not
+#: be pinned by the diagnostics that observe it)
+_LIVE: "weakref.WeakSet" = weakref.WeakSet()
+
+_END = object()          # upstream exhausted
+_NO_ITEM = object()      # try_get on an empty ring
+
+_NAME_LOCK = threading.Lock()
+_NAME_SEQ = [0]          # per-process engine naming (ingest0, ingest1, …)
+
+
+class StageStats:
+    """Counters for one pipeline stage.
+
+    ``items``/``busy_s`` measure the stage's own work; ``starve_s`` is time
+    blocked waiting for its upstream ring, ``backpressure_s`` time blocked
+    on a full downstream ring.  A stage whose starve dominates is fed too
+    slowly (look upstream); one whose backpressure dominates is faster than
+    its consumer (look downstream); the bottleneck stage shows near-zero
+    stall and the highest busy fraction."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self.items = 0
+        self.busy_s = 0.0
+        self.starve_s = 0.0
+        self.backpressure_s = 0.0
+        self._occ_sum = 0
+        self._occ_n = 0
+        self._t0 = time.monotonic()
+
+    def add(self, items: int = 0, busy_s: float = 0.0,
+            starve_s: float = 0.0, backpressure_s: float = 0.0) -> None:
+        with self._lock:
+            self.items += items
+            self.busy_s += busy_s
+            self.starve_s += starve_s
+            self.backpressure_s += backpressure_s
+
+    def sample_occupancy(self, depth: int) -> None:
+        with self._lock:
+            self._occ_sum += depth
+            self._occ_n += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            wall = max(time.monotonic() - self._t0, 1e-9)
+            return {
+                "items": self.items,
+                "throughput_per_sec": round(self.items / wall, 1),
+                "busy_s": round(self.busy_s, 3),
+                "starve_s": round(self.starve_s, 3),
+                "backpressure_s": round(self.backpressure_s, 3),
+                "stall_frac": round(
+                    (self.starve_s + self.backpressure_s) / wall, 3),
+                "mean_queue_depth": round(self._occ_sum / self._occ_n, 2)
+                if self._occ_n else 0.0,
+            }
+
+
+class _Ring:
+    """Bounded stage-coupling queue with stall accounting.
+
+    ``put`` charges blocked time to the producing stage's ``backpressure_s``
+    (a full ring means the downstream stage is the bottleneck); ``get``
+    charges the consuming stage's ``starve_s``.  Both poll a stop event so
+    teardown can never deadlock a stage thread."""
+
+    def __init__(self, depth: int, producer: Optional[StageStats] = None,
+                 consumer: Optional[StageStats] = None):
+        self.q: "queue.Queue" = queue.Queue(maxsize=max(1, int(depth)))
+        self._producer = producer
+        self._consumer = consumer
+
+    def put(self, item, stop: Optional[threading.Event]) -> bool:
+        t0 = None
+        while stop is None or not stop.is_set():
+            try:
+                self.q.put(item, timeout=0.05)
+                if t0 is not None and self._producer is not None:
+                    self._producer.add(backpressure_s=time.monotonic() - t0)
+                if self._producer is not None:
+                    self._producer.sample_occupancy(self.q.qsize())
+                return True
+            except queue.Full:
+                if t0 is None:
+                    t0 = time.monotonic()
+        if t0 is not None and self._producer is not None:
+            self._producer.add(backpressure_s=time.monotonic() - t0)
+        return False
+
+    def get(self, stop: Optional[threading.Event]):
+        t0 = None
+        while stop is None or not stop.is_set():
+            try:
+                item = self.q.get(timeout=0.05)
+                if t0 is not None and self._consumer is not None:
+                    self._consumer.add(starve_s=time.monotonic() - t0)
+                return item
+            except queue.Empty:
+                if t0 is None:
+                    t0 = time.monotonic()
+        if t0 is not None and self._consumer is not None:
+            self._consumer.add(starve_s=time.monotonic() - t0)
+        return _NO_ITEM
+
+    def try_get(self):
+        try:
+            return self.q.get_nowait()
+        except queue.Empty:
+            return _NO_ITEM
+
+    def drain(self) -> None:
+        try:
+            while True:
+                self.q.get_nowait()
+        except queue.Empty:
+            pass
+
+
+class ShardedSeqFileReader:
+    """Parallel SequenceFile record source preserving the global order.
+
+    ``shards`` reader threads (``bigdl.ingest.shards``) own the ``*.seq``
+    files round-robin and stream records into per-shard rings; the merge
+    side drains one file at a time in sorted-walk order, so the yielded
+    sequence is byte-identical to a sequential
+    :func:`~bigdl_tpu.dataset.seqfile.read_image_seqfile` sweep — sharding
+    is a latency detail, not an ordering change.  IO and vint/frame parsing
+    for file k+1..k+shards overlap the consumer's handling of file k."""
+
+    def __init__(self, path: str, shards: Optional[int] = None,
+                 ring_depth: Optional[int] = None):
+        if os.path.isdir(path):
+            self.files: List[str] = []
+            for root, _, files in sorted(os.walk(path)):
+                for fname in sorted(files):
+                    if fname.endswith(".seq"):
+                        self.files.append(os.path.join(root, fname))
+        else:
+            self.files = [path]
+        self.shards = max(1, shards if shards is not None
+                          else config.get_int("bigdl.ingest.shards", 2))
+        self.ring_depth = (ring_depth if ring_depth is not None
+                           else config.get_int("bigdl.ingest.recordRingDepth", 256))
+        self.stats = StageStats("seqfile_read")
+
+    def __iter__(self) -> Iterator:
+        from bigdl_tpu.dataset.image import LabeledImageBytes
+        from bigdl_tpu.dataset.seqfile import read_image_seqfile
+
+        if not self.files:
+            return
+        n = min(self.shards, len(self.files))
+        stop = threading.Event()
+        rings = [_Ring(max(1, self.ring_depth // n), producer=self.stats)
+                 for _ in range(n)]
+        file_end = object()
+
+        def reader(si: int) -> None:
+            try:
+                for fi in range(si, len(self.files), n):
+                    t0 = time.monotonic()
+                    for name, label, data in read_image_seqfile(
+                            self.files[fi]):
+                        self.stats.add(items=1,
+                                       busy_s=time.monotonic() - t0)
+                        if not rings[si].put(
+                                LabeledImageBytes(name, label, data), stop):
+                            return
+                        t0 = time.monotonic()
+                    if not rings[si].put(file_end, stop):
+                        return
+            except BaseException as e:  # surfaced on the merge side
+                rings[si].put(e, stop)
+
+        threads = [threading.Thread(target=reader, args=(si,), daemon=True)
+                   for si in range(n)]
+        for t in threads:
+            t.start()
+        try:
+            for fi in range(len(self.files)):
+                ring = rings[fi % n]
+                while True:
+                    item = ring.get(None)
+                    if item is file_end:
+                        break
+                    if isinstance(item, BaseException):
+                        raise item
+                    yield item
+        finally:
+            stop.set()
+            for ring in rings:
+                ring.drain()
+            for t in threads:
+                t.join(timeout=5)
+            for ring in rings:
+                ring.drain()
+
+
+class StreamingIngest(Transformer):
+    """Compressed byte records → MiniBatches, stage-pipelined.
+
+    Drop-in pipelined replacement for
+    :class:`~bigdl_tpu.dataset.mt_batch.MTLabeledBGRImgToBatch` (same
+    constructor surface, same output semantics — asserted bit-identical by
+    ``tests/test_prefetch_determinism.py``), with the per-batch barriers
+    removed:
+
+    - a *reader* thread pulls upstream records into a bounded record ring;
+    - a *decode pool* (``decode_workers`` threads; cv2/PIL JPEG decode
+      releases the GIL) holds a sliding window of in-flight decodes that
+      spans batch boundaries — decode of batch k+1 proceeds while batch k
+      is being packed;
+    - an *assembler* thread consumes decoded images in strict record
+      order, draws crop/flip from the (cloned) RNG stream, and packs full
+      batches with the native std::thread assembler (ctypes releases the
+      GIL for the call, so packing overlaps the pool);
+    - assembled MiniBatches buffer in a bounded *batch ring* the consumer
+      drains, each carrying the RNG state to commit on consumption.
+
+    Ring depths and pool width default from ``bigdl.ingest.*``; constructor
+    arguments override per instance.
+    """
+
+    def __init__(self, batch_size: int, crop: Tuple[int, int] = (224, 224),
+                 mean: Sequence[float] = (104.0, 117.0, 123.0),
+                 std: Sequence[float] = (1.0, 1.0, 1.0),
+                 random_crop: bool = True, hflip: bool = True,
+                 device_normalize: bool = False,
+                 decode_workers: Optional[int] = None,
+                 record_ring_depth: Optional[int] = None,
+                 decoded_ring_depth: Optional[int] = None,
+                 batch_ring_depth: Optional[int] = None,
+                 assemble_threads: Optional[int] = None,
+                 name: Optional[str] = None):
+        if name is None:
+            with _NAME_LOCK:
+                name = f"ingest{_NAME_SEQ[0]}"
+                _NAME_SEQ[0] += 1
+        # distinguishes this engine's summary tags / log lines when more
+        # than one engine is alive (train + validation pipelines, …)
+        self.name = name
+        self.batch_size = batch_size
+        self.crop = crop
+        self.mean, self.std = mean, std
+        self.random_crop, self.hflip = random_crop, hflip
+        self.device_normalize = device_normalize
+        cores = max(1, os.cpu_count() or 1)
+        self.decode_workers = (decode_workers if decode_workers is not None
+                               else config.get_int("bigdl.ingest.decodeWorkers",
+                                                   cores))
+        self.record_ring_depth = (
+            record_ring_depth if record_ring_depth is not None
+            else config.get_int("bigdl.ingest.recordRingDepth", 256))
+        self.decoded_ring_depth = (
+            decoded_ring_depth if decoded_ring_depth is not None
+            else config.get_int("bigdl.ingest.decodedRingDepth",
+                                 2 * batch_size))
+        self.batch_ring_depth = (
+            batch_ring_depth if batch_ring_depth is not None
+            else config.get_int("bigdl.ingest.batchRingDepth", 2))
+        self.assemble_threads = assemble_threads or cores
+        # per-run stage stats: a ShardedDataSet applies ONE transformer
+        # instance to every shard, so several runs can be live at once —
+        # each run appends its own dict and stats() merges them
+        self._active_stats: List[dict] = []
+        self._last_stats: Optional[dict] = None
+
+    # ---- diagnostics ----------------------------------------------------
+
+    def has_active_run(self) -> bool:
+        """True while at least one pipeline run of this engine is live."""
+        return bool(self._active_stats)
+
+    def stats(self) -> dict:
+        """Per-stage snapshots: the merge of every ACTIVE run (multi-shard
+        pipelines sum their counters), else the last finished run."""
+        runs = list(self._active_stats)
+        if not runs and self._last_stats is not None:
+            runs = [self._last_stats]
+        if not runs:
+            return {}
+        if len(runs) == 1:
+            return {name: s.snapshot() for name, s in runs[0].items()}
+        out = {}
+        for name in ("read", "decode", "assemble", "consume"):
+            snaps = [r[name].snapshot() for r in runs if name in r]
+            if not snaps:
+                continue
+            n = len(snaps)
+            out[name] = {
+                "items": sum(s["items"] for s in snaps),
+                "throughput_per_sec": round(
+                    sum(s["throughput_per_sec"] for s in snaps), 1),
+                "busy_s": round(sum(s["busy_s"] for s in snaps), 3),
+                "starve_s": round(sum(s["starve_s"] for s in snaps), 3),
+                "backpressure_s": round(
+                    sum(s["backpressure_s"] for s in snaps), 3),
+                "stall_frac": round(
+                    sum(s["stall_frac"] for s in snaps) / n, 3),
+                "mean_queue_depth": round(
+                    sum(s["mean_queue_depth"] for s in snaps) / n, 2),
+            }
+        return out
+
+    # ---- the pipeline ---------------------------------------------------
+
+    def __call__(self, it: Iterator) -> Iterator:
+        from concurrent.futures import ThreadPoolExecutor
+        from bigdl_tpu.dataset.mt_batch import (MTLabeledBGRImgToBatch,
+                                                _check_crop_fits,
+                                                assemble_batch,
+                                                assemble_batch_u8)
+        from bigdl_tpu.dataset.sample import MiniBatch
+        from bigdl_tpu.utils.random_generator import RandomGenerator
+
+        stats = {name: StageStats(name)
+                 for name in ("read", "decode", "assemble", "consume")}
+        self._active_stats.append(stats)
+        _LIVE.add(self)
+
+        # the caller's stream is CLONED, not handed off: the assembler
+        # draws from the clone in record order, and each batch carries the
+        # clone's post-draw state — committed to the shared instance only
+        # when the consumer takes the batch.  Read-ahead discarded at an
+        # epoch rollover never advances the user-visible stream, so the
+        # pipelined sequence stays bit-identical to the synchronous path
+        # regardless of ring depths or how far ahead the engine ran.
+        #
+        # Multiple engines on ONE stream (a ShardedDataSet applies the
+        # transformer per shard and the driver pulls the shard iterators
+        # alternately): only the FIRST active fork is the stream's
+        # committer — secondaries draw from a deterministically reseeded
+        # fork (decorrelated per-shard augmentation, the reference's
+        # per-partition RNG model, ``dataset/DataSet.scala:262``) and
+        # never commit, so alternating consumption cannot interleave
+        # incoherent positions onto the caller's stream.  Synchronous-path
+        # bit-parity is therefore a SINGLE-engine contract; multi-shard
+        # runs are run-to-run deterministic instead.
+        shared_rng = RandomGenerator.RNG()
+        active_forks = shared_rng.__dict__.setdefault("_ingest_forks", set())
+        # secondary forks are numbered by how many forks are already
+        # active — NOT a global counter, so re-running the same pipeline
+        # derives the identical per-shard seeds
+        fork_rank = len(active_forks)
+        fork_token = object()
+        primary = fork_rank == 0
+        active_forks.add(fork_token)
+        drawer = RandomGenerator(0)
+        drawer.np.set_state(shared_rng.np.get_state())
+        if not primary:
+            # decorrelate the secondary fork: seed from the fork point +
+            # the fork rank, so each shard's stream is distinct but every
+            # run derives the identical sequence
+            mix = int(np.asarray(shared_rng.np.get_state()[1],
+                                 np.uint64).sum())
+            drawer.set_seed((mix ^ (0x9E3779B1 * fork_rank)) % (2 ** 31))
+
+        stop = threading.Event()
+        record_ring = _Ring(self.record_ring_depth,
+                            producer=stats["read"],
+                            consumer=stats["assemble"])
+        batch_ring = _Ring(self.batch_ring_depth,
+                           producer=stats["assemble"],
+                           consumer=stats["consume"])
+        pool = ThreadPoolExecutor(self.decode_workers)
+        ch, cw = self.crop
+
+        def reader() -> None:
+            """Pull upstream records into the record ring.  The upstream
+            iterator draws no host RNG (crop/flip belongs to the assembler;
+            reshuffles to the training driver's producer), so running it on
+            its own thread keeps the single-drawer contract intact."""
+            try:
+                t0 = time.monotonic()
+                for rec in it:
+                    stats["read"].add(items=1,
+                                      busy_s=time.monotonic() - t0)
+                    if not record_ring.put(rec, stop):
+                        return
+                    t0 = time.monotonic()
+                record_ring.put(_END, stop)
+            except BaseException as e:  # surface downstream
+                record_ring.put(e, stop)
+
+        def timed_decode(data: bytes) -> np.ndarray:
+            t0 = time.monotonic()
+            img = MTLabeledBGRImgToBatch._decode(data)
+            stats["decode"].add(items=1, busy_s=time.monotonic() - t0)
+            return img
+
+        def assembler() -> None:
+            pending: "deque" = deque()   # (record, decode future), in order
+            done = [False]
+
+            def fill(block: bool) -> None:
+                """Top up the in-flight decode window.  Blocking only when
+                the window is empty keeps the assembler from stalling on a
+                slow upstream while it still has decoded work to pack."""
+                while not done[0] and len(pending) < self.decoded_ring_depth:
+                    rec = (record_ring.get(stop) if block and not pending
+                           else record_ring.try_get())
+                    if rec is _NO_ITEM:
+                        if block and not pending:
+                            done[0] = True    # stop was set mid-get
+                        return
+                    if rec is _END:
+                        done[0] = True
+                        return
+                    if isinstance(rec, BaseException):
+                        done[0] = True
+                        pending.append((None, rec))
+                        return
+                    pending.append((rec, pool.submit(timed_decode,
+                                                     rec.bytes)))
+
+            imgs: List[np.ndarray] = []
+            recs: List = []
+            offsets: List[Tuple[int, int]] = []
+            flips: List[int] = []
+
+            def emit() -> bool:
+                t0 = time.monotonic()
+                offs = np.asarray(offsets, np.int32).reshape(len(imgs), 2)
+                fl = np.asarray(flips, np.uint8)
+                if self.device_normalize:
+                    x = assemble_batch_u8(imgs, self.crop, offs, fl,
+                                          n_threads=self.assemble_threads)
+                else:
+                    x = assemble_batch(imgs, self.crop, offs, fl,
+                                       self.mean, self.std,
+                                       n_threads=self.assemble_threads)
+                y = np.asarray([r.label for r in recs], np.float32)
+                stats["assemble"].add(items=len(imgs),
+                                      busy_s=time.monotonic() - t0)
+                ok = batch_ring.put(
+                    (MiniBatch(x, y), drawer.np.get_state()), stop)
+                imgs.clear(), recs.clear(), offsets.clear(), flips.clear()
+                return ok
+
+            try:
+                while True:
+                    fill(block=True)
+                    if not pending:
+                        break
+                    rec, fut = pending.popleft()
+                    if rec is None:      # upstream error, in order
+                        raise fut
+                    if fut.done():
+                        img = fut.result()
+                    else:                # wait-on-decode = assemble starve
+                        t0 = time.monotonic()
+                        img = fut.result()
+                        stats["assemble"].add(
+                            starve_s=time.monotonic() - t0)
+                    fill(block=False)    # decode of the NEXT batch proceeds
+                    _check_crop_fits(
+                        [img], self.crop,
+                        describe=lambda _i: (
+                            f"StreamingIngest: record {len(imgs)} of the "
+                            f"current batch (label {rec.label})"))
+                    # crop/flip draws in strict record order — the same
+                    # draw sequence MTLabeledBGRImgToBatch makes, just
+                    # without the batch barrier
+                    h, w = img.shape[:2]
+                    if self.random_crop:
+                        oy = drawer.random_int(0, h - ch + 1)
+                        ox = drawer.random_int(0, w - cw + 1)
+                    else:
+                        oy, ox = (h - ch) // 2, (w - cw) // 2
+                    fl = int(drawer.uniform() < 0.5) if self.hflip else 0
+                    imgs.append(img if img.ndim == 3 else img[:, :, None])
+                    recs.append(rec)
+                    offsets.append((oy, ox))
+                    flips.append(fl)
+                    if len(imgs) == self.batch_size:
+                        if not emit():
+                            return
+                if imgs:
+                    if not emit():
+                        return
+                batch_ring.put(_END, stop)
+            except BaseException as e:  # surface at the consumer
+                batch_ring.put(e, stop)
+
+        reader_t = threading.Thread(target=reader, daemon=True,
+                                    name="ingest-reader")
+        asm_t = threading.Thread(target=assembler, daemon=True,
+                                 name="ingest-assembler")
+        reader_t.start()
+        asm_t.start()
+        try:
+            while True:
+                # blocked time inside get() is charged to consume.starve_s
+                # by the ring itself
+                item = batch_ring.get(None)
+                if item is _END:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                batch, rng_state = item
+                if primary:
+                    # commit the drawn-through position: the caller's
+                    # stream advances exactly as far as the batches it
+                    # actually took
+                    shared_rng.np.set_state(rng_state)
+                stats["consume"].add(items=1)
+                yield batch
+        finally:
+            active_forks.discard(fork_token)
+            for i, run in enumerate(self._active_stats):
+                if run is stats:
+                    del self._active_stats[i]
+                    break
+            self._last_stats = stats
+            stop.set()
+            # cancel queued decodes so teardown never waits on work whose
+            # output nobody will read (mirrors the MT transformer fix)
+            pool.shutdown(wait=False, cancel_futures=True)
+            for ring in (record_ring, batch_ring):
+                ring.drain()
+            reader_t.join(timeout=5)
+            asm_t.join(timeout=5)
+            # a final put can land between the first drain and the join —
+            # drain again so no full batch stays pinned in the ring
+            for ring in (record_ring, batch_ring):
+                ring.drain()
+
+
+def summary_scalars():
+    """(tag, value) pairs for the training summary: per-stage throughput,
+    stall fraction, and ring occupancy of every engine with an ACTIVE run
+    (idle engines from finished pipelines are excluded — their stale final
+    counters must not pollute a later run's series).  Tags always include
+    the engine's ``name`` so the series stays stable when a second engine
+    (a validation pipeline) goes live mid-run."""
+    out = []
+    for eng in sorted((e for e in _LIVE if e.has_active_run()),
+                      key=lambda e: e.name):
+        prefix = f"Ingest/{eng.name}"
+        for stage, snap in eng.stats().items():
+            out.append((f"{prefix}/{stage}/throughput",
+                        snap["throughput_per_sec"]))
+            out.append((f"{prefix}/{stage}/stall_frac", snap["stall_frac"]))
+            if snap["mean_queue_depth"]:
+                out.append((f"{prefix}/{stage}/queue_depth",
+                            snap["mean_queue_depth"]))
+    return out

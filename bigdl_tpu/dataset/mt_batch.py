@@ -243,7 +243,11 @@ class MTLabeledBGRImgToBatch(Transformer):
                 y = np.asarray([r.label for r in recs], np.float32)
                 yield MiniBatch(x, y)
         finally:
-            pool.shutdown(wait=False)
+            # cancel_futures: a consumer exiting mid-batch (or a decode
+            # error propagating out of pool.map) leaves queued decode
+            # futures behind — without cancellation they keep running and
+            # pin their records/outputs after the generator is gone
+            pool.shutdown(wait=False, cancel_futures=True)
 
 
 class Prefetch(Transformer):
@@ -294,6 +298,9 @@ class Prefetch(Transformer):
                 put(e)
 
         t = threading.Thread(target=producer, daemon=True)
+        # kept on the instance for diagnostics/tests: the teardown
+        # contract below (producer joined, queue left empty) is observable
+        self._q, self._producer = q, t
         t.start()
         try:
             while True:
@@ -307,8 +314,20 @@ class Prefetch(Transformer):
             # early exit (break/exception/GeneratorExit): release the
             # producer so it does not pin the upstream iterator forever
             stop.set()
-            try:
-                while True:
-                    q.get_nowait()
-            except queue.Empty:
-                pass
+
+            def drain():
+                try:
+                    while True:
+                        q.get_nowait()
+                except queue.Empty:
+                    pass
+
+            # drain → JOIN → drain: the producer may have passed its stop
+            # check and be blocked in put() when we drain — that put lands
+            # AFTER the first drain and would pin a full batch in memory
+            # forever.  Joining (bounded: the producer exits at its next
+            # stop check once the put lands) and draining again guarantees
+            # nothing stays queued.
+            drain()
+            t.join(timeout=5)
+            drain()

@@ -92,3 +92,46 @@ def load_native() -> Optional[ctypes.CDLL]:
 
 def native_available() -> bool:
     return load_native() is not None
+
+
+#: every entry point the framework dispatches to; a .so missing one of
+#: these is a stale pre-r4 build that silently degrades the ingest path
+REQUIRED_SYMBOLS = ("seqfile_open", "seqfile_next", "seqfile_close",
+                    "seqfile_create", "seqfile_append",
+                    "seqfile_close_writer", "assemble_batch",
+                    "assemble_batch_u8")
+
+
+def check_build() -> "ctypes.CDLL":
+    """CI-facing STRICT build: run ``make -C native`` surfacing compiler
+    errors, load the library, and verify every required symbol — the
+    checked counterpart of :func:`load_native`'s permissive "fall back to
+    numpy" behaviour.  A toolchain-equipped environment that silently
+    benchmarks the numpy fallback (because the build broke or an old .so
+    lacks ``assemble_batch_u8``) reports numbers that are off by an order
+    of magnitude; this fails loudly instead."""
+    global _lib, _tried
+    try:
+        proc = subprocess.run(["make", "-C", _NATIVE_DIR], check=False,
+                              capture_output=True, timeout=300, text=True)
+    except FileNotFoundError as e:
+        raise RuntimeError(f"native build failed: make not found ({e})")
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"native build failed (make -C {_NATIVE_DIR} exited "
+            f"{proc.returncode}):\n{proc.stderr[-2000:]}")
+    with _lock:
+        # force a reload: a permissive load_native() earlier in the
+        # process may have cached a stale (or absent) library
+        _lib, _tried = None, False
+    lib = load_native()
+    if lib is None:
+        raise RuntimeError(
+            f"native build succeeded but {_LIB_PATH} failed to load")
+    missing = [s for s in REQUIRED_SYMBOLS if not hasattr(lib, s)]
+    if missing:
+        raise RuntimeError(
+            f"native library {_LIB_PATH} is missing symbols {missing} — "
+            "stale build? `make -C native clean` and rebuild; the numpy "
+            "fallback would silently mis-measure the ingest path")
+    return lib

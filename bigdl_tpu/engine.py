@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from collections import deque
 from typing import Optional, Sequence
 
@@ -81,20 +82,41 @@ class BatchPrefetcher:
     producer happens to be, which matters for multi-host parity (every
     process must consume the identical sequence).
 
+    Transfer-ahead stage: with ``transfer_ahead`` > 1 (default
+    ``bigdl.ingest.batchesInFlight``, 2) the fetch producer and the
+    ready-wait are SPLIT across two threads so up to N host→device
+    uploads are in flight at once — the fetch thread issues batch k+1's
+    ``device_put`` while the transfer thread is still blocking batch k
+    device-resident.  When compute ≥ transfer, the consuming step then
+    never waits on the link; with ``transfer_ahead`` <= 1 the producer
+    fetches and blocks serially (one upload in flight — the pre-streaming
+    behaviour).  Batch ORDER is unchanged either way (both hops are FIFO
+    queues) and the fetch thread remains the single producer owning epoch
+    rollovers and the RNG stream.
+
     ``depth`` defaults to ``bigdl.prefetch.depth`` (2); 0 disables (the
     call becomes a passthrough).  Exceptions in the producer re-raise at
     the consuming call site.
     """
 
     def __init__(self, fetch, depth: Optional[int] = None,
-                 on_batch=None):
+                 on_batch=None, transfer_ahead: Optional[int] = None):
         import queue
 
         from bigdl_tpu.utils import config
         self.depth = (depth if depth is not None
                       else config.get_int("bigdl.prefetch.depth", 2))
+        self.transfer_ahead = (
+            transfer_ahead if transfer_ahead is not None
+            else config.get_int("bigdl.ingest.batchesInFlight", 2))
         self._fetch = fetch
         self._on_batch = on_batch
+        # transfer-stage counters (ns, GIL-atomic adds): how long the
+        # pipeline spent blocking uploads device-resident vs fetching —
+        # surfaced by bench.py and the driver's end-of-run metrics
+        self.fetch_ns = 0
+        self.block_ns = 0
+        self.batches = 0
         # the producer owns epoch rollovers (reshuffles): it must continue
         # the CONSTRUCTING thread's RNG stream, so a user's set_seed on the
         # main thread keeps governing epoch 2+ shuffles whether or not
@@ -105,21 +127,27 @@ class BatchPrefetcher:
             return
         self._q: "queue.Queue" = queue.Queue(maxsize=self.depth)
         self._stop = threading.Event()
+        self._transfer_thread = None
+        if self.transfer_ahead > 1:
+            # issued-but-not-yet-ready uploads queue here; capacity N-1
+            # plus the one the transfer thread is blocking = N in flight
+            self._issued_q: "queue.Queue" = queue.Queue(
+                maxsize=self.transfer_ahead - 1)
+            self._transfer_thread = threading.Thread(
+                target=self._run_transfer, daemon=True)
+            self._transfer_thread.start()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
     # batches at or above this size are blocked device-resident before
-    # handoff; smaller ones stay async (see _fetch_once)
+    # handoff; smaller ones stay async (see _block_ready)
     READY_BYTES = 4 << 20
 
-    def _fetch_once(self):
-        batch = self._fetch()
-        if self._on_batch is not None:
-            self._on_batch(batch)
+    def _block_ready(self, batch):
         # LARGE batches are handed to the consumer DEVICE-RESIDENT:
         # dispatching a step against an in-flight bulk transfer costs ~10x
         # the step latency on the tunneled backend (measured: 1.9 s vs
-        # 0.16 s for a 77 MB ResNet-50 b128 batch), so the producer
+        # 0.16 s for a 77 MB ResNet-50 b128 batch), so the pipeline
         # absorbs the wait, overlapped with the consumer's dispatches.
         # SMALL batches must NOT block: each block costs a full tunnel
         # round-trip (~60-150 ms), which swamps a small-model step —
@@ -128,25 +156,66 @@ class BatchPrefetcher:
         leaves = jax.tree_util.tree_leaves(batch)
         total = sum(getattr(leaf, "nbytes", 0) for leaf in leaves)
         if total >= self.READY_BYTES:
+            t0 = time.monotonic_ns()
             for leaf in leaves:
                 if hasattr(leaf, "block_until_ready"):
                     leaf.block_until_ready()
+            self.block_ns += time.monotonic_ns() - t0
         return batch
+
+    def _fetch_once(self, block: bool = True):
+        t0 = time.monotonic_ns()
+        batch = self._fetch()
+        if self._on_batch is not None:
+            self._on_batch(batch)
+        self.fetch_ns += time.monotonic_ns() - t0
+        self.batches += 1
+        if block:
+            self._block_ready(batch)
+        return batch
+
+    def _put(self, q, item) -> bool:
+        while not self._stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except Exception:
+                continue
+        return False
 
     def _run(self):
         from bigdl_tpu.utils.random_generator import RandomGenerator
         RandomGenerator.adopt(self._rng)
+        staged = self._transfer_thread is not None
+        out_q = self._issued_q if staged else self._q
         while not self._stop.is_set():
             try:
-                item = (None, self._fetch_once())
+                # staged: hand the batch on with its upload still in
+                # flight — the transfer thread blocks it ready while this
+                # thread fetches (and uploads) the next one
+                item = (None, self._fetch_once(block=not staged))
             except BaseException as e:  # noqa: BLE001 — re-raised at call
                 item = (e, None)
-            while not self._stop.is_set():
+            if not self._put(out_q, item):
+                return
+            if item[0] is not None:
+                return
+
+    def _run_transfer(self):
+        import queue as _queue
+        while not self._stop.is_set():
+            try:
+                item = self._issued_q.get(timeout=0.1)
+            except _queue.Empty:
+                continue
+            err, batch = item
+            if err is None:
                 try:
-                    self._q.put(item, timeout=0.1)
-                    break
-                except Exception:
-                    continue
+                    self._block_ready(batch)
+                except BaseException as e:  # noqa: BLE001 — re-raised
+                    item = (e, None)
+            if not self._put(self._q, item):
+                return
             if item[0] is not None:
                 return
 
@@ -159,12 +228,14 @@ class BatchPrefetcher:
         return batch
 
     def stop(self):
-        """Stop and JOIN the producer: a retry-from-failure restart must
+        """Stop and JOIN the producers: a retry-from-failure restart must
         not race a still-running old producer over the same dataset
         iterators."""
         if self.depth > 0:
             self._stop.set()
             self._thread.join(timeout=10)
+            if self._transfer_thread is not None:
+                self._transfer_thread.join(timeout=10)
 
 
 class _EngineState:

@@ -704,6 +704,23 @@ class Optimizer:
 
         flush_pending()
         publish()
+        # input-pipeline accounting: where the prefetch stages spent their
+        # time (fetch vs blocking uploads device-resident), plus per-stage
+        # ingest counters when a StreamingIngest engine fed the run — the
+        # numbers that say whether a slow run was input-bound
+        if fetch.batches:
+            self.metrics.add("batch fetch time", fetch.fetch_ns)
+            self.metrics.add("transfer block time", fetch.block_ns)
+        from bigdl_tpu.dataset import ingest as _ingest
+        for eng in sorted((e for e in _ingest._LIVE if e.has_active_run()),
+                          key=lambda e: e.name):
+            for stage, snap in eng.stats().items():
+                logger.info(
+                    "Ingest %s stage %s: %d items, %.1f/s, busy %.1fs, "
+                    "starve %.1fs, backpressure %.1fs", eng.name, stage,
+                    snap["items"], snap["throughput_per_sec"],
+                    snap["busy_s"], snap["starve_s"],
+                    snap["backpressure_s"])
         logger.info("Training finished in %.1f s.", time.time() - wall_start)
         return state
 
@@ -821,6 +838,12 @@ class Optimizer:
         self.train_summary.add_scalar("Throughput", throughput, neval)
         self.train_summary.add_scalar(
             "LearningRate", self.optim_method.get_learning_rate(), neval)
+        # streaming-ingest stage counters (throughput / stall fraction /
+        # ring occupancy per stage) when a StreamingIngest engine feeds
+        # this run — the per-stage view that names the bottleneck stage
+        from bigdl_tpu.dataset import ingest as _ingest
+        for tag, value in _ingest.summary_scalars():
+            self.train_summary.add_scalar(tag, value, neval)
 
     # -- factory ----------------------------------------------------------
 

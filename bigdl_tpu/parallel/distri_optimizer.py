@@ -34,6 +34,17 @@ materialization.  Proven by ``tests/test_multihost.py`` (2 OS processes x
 Straggler mitigation (reference ``:192-216,302-330``) is structurally N/A:
 XLA collectives over ICI are bulk-synchronous with no partial participation;
 the API knob on :class:`Optimizer` is kept inert for parity.
+
+Real-data ingest: feed the dataset through
+:class:`~bigdl_tpu.dataset.ingest.StreamingIngest` (the stage-pipelined
+decode/assemble engine) and the driver's ``Engine.BatchPrefetcher``
+transfer-ahead stage keeps ``bigdl.ingest.batchesInFlight`` uploads in
+flight — ``fetch_batch`` issues the ``make_array_from_process_local_data``
+transfer, the transfer thread blocks it device-resident while the next
+fetch's upload is already on the link, and the step consumes only
+pre-transferred batches.  Epoch rollover/reshuffle stays owned by the
+fetch producer and the ingest engine commits RNG draws on consumption, so
+the pipelining changes latency, never the batch sequence.
 """
 
 from __future__ import annotations
@@ -721,9 +732,13 @@ def _global_batch(shard_iters, batch_sharding, mesh, partition_num,
 
 def _cat(parts):
     """Concatenate per-shard activities (arrays or nested lists of arrays)
-    along the batch axis."""
+    along the batch axis.  Single-shard (the 1-partition streaming-ingest
+    case) passes through without the concatenate copy — at b128 ImageNet
+    that is ~19 MB of uint8 per batch saved on the fetch thread."""
     first = parts[0]
     if isinstance(first, (list, tuple)):
         return type(first)(_cat([p[i] for p in parts])
                            for i in range(len(first)))
+    if len(parts) == 1:
+        return np.asarray(first)
     return np.concatenate([np.asarray(p) for p in parts], axis=0)

@@ -40,6 +40,16 @@ _DEFAULTS: Dict[str, Any] = {
     "bigdl.summary.flushSecs": 2.0,
     "bigdl.compilation.cacheDir": None,    # jax persistent compile cache
     "bigdl.pipeline.depth": 8,             # driver-loop dispatch pipeline
+    # streaming ingest engine (dataset/ingest.py): stage-pipelined
+    # real-data path — sharded seqfile readers -> record ring -> decode
+    # pool -> decoded window -> native assembler -> batch ring -> device
+    # transfer-ahead (engine.BatchPrefetcher)
+    "bigdl.ingest.shards": 2,              # parallel seqfile reader threads
+    "bigdl.ingest.decodeWorkers": None,    # decode pool size; None = host cores
+    "bigdl.ingest.recordRingDepth": 256,   # reader -> decode record ring
+    "bigdl.ingest.decodedRingDepth": None, # in-flight decode window; None = 2x batch
+    "bigdl.ingest.batchRingDepth": 2,      # assembled batches buffered ahead
+    "bigdl.ingest.batchesInFlight": 2,     # device uploads in flight (transfer-ahead)
 }
 
 _OVERRIDES: Dict[str, Any] = {}

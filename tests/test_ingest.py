@@ -1,0 +1,270 @@
+"""Streaming ingest engine: pipeline behaviour, teardown, error paths,
+stage counters, and the (slow-marked) soak."""
+
+import io
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.dataset.image import LabeledImageBytes
+from bigdl_tpu.dataset.ingest import (ShardedSeqFileReader, StageStats,
+                                      StreamingIngest, summary_scalars)
+from bigdl_tpu.utils.random_generator import RandomGenerator
+
+
+def _png_records(n=12, hw=(40, 48), seed=3):
+    from PIL import Image
+    rng = np.random.RandomState(seed)
+    recs = []
+    for i in range(n):
+        img = rng.randint(0, 256, size=hw + (3,)).astype(np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(img).save(buf, "PNG")
+        recs.append(LabeledImageBytes(f"r{i}", float(i % 5 + 1),
+                                      buf.getvalue()))
+    return recs
+
+
+class TestStreamingIngest:
+    def test_batches_and_trailing_partial(self):
+        recs = _png_records(n=10)
+        eng = StreamingIngest(4, crop=(32, 32), decode_workers=2)
+        batches = list(eng(iter(recs)))
+        assert [b.size() for b in batches] == [4, 4, 2]
+        assert batches[0].get_input().shape == (4, 3, 32, 32)
+        assert batches[0].get_input().dtype == np.float32
+
+    def test_empty_upstream(self):
+        eng = StreamingIngest(4, crop=(32, 32))
+        assert list(eng(iter([]))) == []
+
+    def test_upstream_error_propagates(self):
+        def gen():
+            yield from _png_records(n=6)
+            raise RuntimeError("upstream boom")
+
+        eng = StreamingIngest(4, crop=(32, 32), decode_workers=2)
+        it = eng(gen())
+        assert next(it).size() == 4
+        with pytest.raises(RuntimeError, match="upstream boom"):
+            list(it)
+
+    def test_decode_error_propagates(self):
+        recs = _png_records(n=8)
+        recs[5] = LabeledImageBytes("bad", 1.0, b"not an image at all")
+        eng = StreamingIngest(4, crop=(32, 32), decode_workers=2)
+        with pytest.raises(Exception):
+            list(eng(iter(recs)))
+
+    def test_undersized_record_raises_named_error(self):
+        recs = _png_records(n=4, hw=(40, 48))
+        recs[2:3] = _png_records(n=1, hw=(20, 48))
+        recs[2].label = 9.0
+        for random_crop in (False, True):
+            eng = StreamingIngest(4, crop=(32, 32),
+                                  random_crop=random_crop, decode_workers=2)
+            with pytest.raises(ValueError, match=r"record 2 .*20x48.*32x32"):
+                list(eng(iter(recs)))
+
+    def test_teardown_joins_threads_and_drains_rings(self):
+        """Abandoning the iterator mid-stream must stop every stage
+        thread (bounded) and leave nothing pinned in the rings."""
+        before = threading.active_count()
+        recs = _png_records(n=8)
+
+        def infinite():
+            while True:
+                yield from recs
+
+        eng = StreamingIngest(4, crop=(32, 32), decode_workers=2,
+                              record_ring_depth=8, decoded_ring_depth=8,
+                              batch_ring_depth=4)
+        it = eng(infinite())
+        for _ in range(3):
+            next(it)
+        it.close()
+        deadline = time.monotonic() + 10
+        while (threading.active_count() > before and
+               time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert threading.active_count() <= before, "stage thread leaked"
+
+    def test_stats_counters_consistent(self):
+        recs = _png_records(n=12)
+        eng = StreamingIngest(4, crop=(32, 32), decode_workers=2)
+        n = sum(b.size() for b in eng(iter(recs)))
+        stats = eng.stats()
+        assert n == 12
+        assert set(stats) == {"read", "decode", "assemble", "consume"}
+        assert stats["read"]["items"] == 12
+        assert stats["decode"]["items"] == 12
+        assert stats["assemble"]["items"] == 12
+        assert stats["consume"]["items"] == 3          # batches
+        for snap in stats.values():
+            assert snap["throughput_per_sec"] >= 0
+            assert snap["busy_s"] >= 0
+            assert snap["starve_s"] >= 0
+            assert snap["backpressure_s"] >= 0
+
+    def test_summary_scalars_surface_live_engines(self):
+        recs = _png_records(n=8)
+        eng = StreamingIngest(4, crop=(32, 32), decode_workers=2)
+        it = eng(iter(recs))
+        next(it)                     # engine is mid-run: scalars surface
+        tags = {t for t, _ in summary_scalars()}
+        it.close()
+        assert any(t == f"Ingest/{eng.name}/decode/throughput"
+                   for t in tags)
+        assert any(t.endswith("/stall_frac") for t in tags)
+        # finished engines drop out of the summary (stale counters must
+        # not pollute a later run's series); stats() still serves them
+        assert not eng.has_active_run()
+        assert all(f"/{eng.name}/" not in t
+                   for t, _ in summary_scalars())
+        assert eng.stats()["decode"]["items"] >= 4
+
+    def test_backpressure_bounds_read_ahead(self):
+        """A tiny batch ring with a slow consumer must hold the reader
+        back (bounded memory), not let it slurp the whole stream."""
+        recs = _png_records(n=8)
+        progress = {"n": 0}
+
+        def counted():
+            for r in recs * 50:                    # 400 records available
+                progress["n"] += 1
+                yield r
+
+        eng = StreamingIngest(4, crop=(32, 32), decode_workers=1,
+                              record_ring_depth=2, decoded_ring_depth=4,
+                              batch_ring_depth=1)
+        it = eng(counted())
+        next(it)
+        time.sleep(0.3)                            # engine runs ahead
+        # bounded by rings: record(2) + window(4) + batches((1+1)*4) + slack
+        assert progress["n"] <= 24, progress["n"]
+        it.close()
+
+
+class TestStageStats:
+    def test_snapshot_fields(self):
+        s = StageStats("x")
+        s.add(items=3, busy_s=0.5, starve_s=0.25, backpressure_s=0.25)
+        s.sample_occupancy(2)
+        s.sample_occupancy(4)
+        snap = s.snapshot()
+        assert snap["items"] == 3
+        assert snap["mean_queue_depth"] == 3.0
+        assert snap["busy_s"] == 0.5
+
+
+class TestShardedSeqFileReader:
+    def test_missing_dir_is_empty(self, tmp_path):
+        assert list(ShardedSeqFileReader(str(tmp_path))) == []
+
+    def test_corrupt_file_raises_on_merge_side(self, tmp_path):
+        from bigdl_tpu.dataset import seqfile
+        good = [(f"k{i}", 1.0, b"v" * 50) for i in range(4)]
+        seqfile.write_image_seqfile(str(tmp_path / "a.seq"), good)
+        seqfile.write_image_seqfile(str(tmp_path / "b.seq"), good)
+        with open(tmp_path / "b.seq", "r+b") as f:
+            f.truncate(60)                          # cut inside a record
+        with pytest.raises(IOError):
+            list(ShardedSeqFileReader(str(tmp_path), shards=2))
+
+    def test_abandonment_stops_reader_threads(self, tmp_path):
+        from bigdl_tpu.dataset import seqfile
+        for fi in range(4):
+            seqfile.write_image_seqfile(
+                str(tmp_path / f"p{fi}.seq"),
+                [(f"k{fi}_{i}", 1.0, b"v" * 2000) for i in range(50)])
+        before = threading.active_count()
+        it = iter(ShardedSeqFileReader(str(tmp_path), shards=3,
+                                       ring_depth=6))
+        next(it)
+        it.close()
+        deadline = time.monotonic() + 10
+        while (threading.active_count() > before and
+               time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert threading.active_count() <= before, "reader thread leaked"
+
+
+class TestMultiEngineOneStream:
+    """Two engines forked from ONE RandomGenerator stream (the multi-shard
+    ShardedDataSet shape, shard iterators pulled alternately): the first
+    fork owns the stream's commits, secondaries draw decorrelated
+    deterministic per-shard streams — alternating consumption must be
+    run-to-run deterministic, never an incoherent interleaving."""
+
+    def _run_once(self):
+        from bigdl_tpu.dataset.dataset import ShardedDataSet
+
+        recs = _png_records(n=24)
+        RandomGenerator.RNG().set_seed(515)
+        eng = StreamingIngest(4, crop=(32, 32), decode_workers=2,
+                              decoded_ring_depth=6)
+        ds = ShardedDataSet(recs, 2).transform(eng)
+        its = [ds.shard_data(p, train=False) for p in (0, 1)]
+        out = []
+        for _ in range(3):           # alternate pulls, like _global_batch
+            for it in its:
+                b = next(it)
+                out.append((b.get_input().copy(), b.get_target().copy()))
+        # the ONE transformer instance runs once per shard: stats() must
+        # merge both live runs, not report just the last-started shard
+        assert eng.has_active_run()
+        assert eng.stats()["consume"]["items"] == 6
+        for it in its:
+            it.close()
+        return out, RandomGenerator.RNG().np.get_state()
+
+    def test_alternating_shard_consumption_is_deterministic(self):
+        (a, sa), (b, sb) = self._run_once(), self._run_once()
+        assert len(a) == len(b) == 6
+        for (xa, ya), (xb, yb) in zip(a, b):
+            np.testing.assert_array_equal(xa, xb)
+            np.testing.assert_array_equal(ya, yb)
+        for s0, s1 in zip(sa, sb):
+            np.testing.assert_array_equal(np.asarray(s0), np.asarray(s1))
+
+    def test_secondary_fork_is_decorrelated(self):
+        """Both shards forking the same state must NOT apply identical
+        crop/flip sequences (correlated augmentation across shards)."""
+        out, _ = self._run_once()
+        # same underlying record content per shard position differs, so
+        # compare the two shards' first batches: they must not be equal
+        # as a whole (decorrelated draws on distinct records)
+        assert not np.array_equal(out[0][0], out[1][0])
+
+
+@pytest.mark.slow
+def test_ingest_soak():
+    """Soak: many epochs of sustained pipelining at adversarially small
+    ring depths — counters stay exact, nothing deadlocks, and the batch
+    stream stays bit-identical to the synchronous path throughout."""
+    from bigdl_tpu.dataset.mt_batch import MTLabeledBGRImgToBatch
+
+    recs = _png_records(n=40)
+    stream = recs * 50                               # 2000 records
+
+    RandomGenerator.RNG().set_seed(11)
+    sync = [(b.get_input().copy(), b.get_target().copy())
+            for b in MTLabeledBGRImgToBatch(8, crop=(32, 32))(iter(stream))]
+
+    RandomGenerator.RNG().set_seed(11)
+    eng = StreamingIngest(8, crop=(32, 32), decode_workers=3,
+                          record_ring_depth=4, decoded_ring_depth=10,
+                          batch_ring_depth=2)
+    got = [(b.get_input().copy(), b.get_target().copy())
+           for b in eng(iter(stream))]
+
+    assert len(got) == len(sync) == 250
+    for (xs, ys), (xg, yg) in zip(sync, got):
+        np.testing.assert_array_equal(xs, xg)
+        np.testing.assert_array_equal(ys, yg)
+    stats = eng.stats()
+    assert stats["decode"]["items"] == 2000
+    assert stats["assemble"]["items"] == 2000
+    assert stats["consume"]["items"] == 250

@@ -7,6 +7,7 @@ prefetching is on (default) or off, and both settings produce the
 identical batch sequence (advisor r3 finding #1)."""
 
 import numpy as np
+import pytest
 
 import bigdl_tpu.nn as nn
 import bigdl_tpu.optim as optim
@@ -45,3 +46,237 @@ def test_seeded_shuffles_identical_with_and_without_prefetch():
     w_sync = _train_weights(0)
     w_prefetch = _train_weights(2)
     np.testing.assert_array_equal(w_sync, w_prefetch)
+
+
+# ---------------------------------------------------------------------------
+# streaming ingest engine vs the synchronous MT path
+# ---------------------------------------------------------------------------
+
+def _jpeg_records(n=24, hw=(40, 48), seed=3):
+    """Losslessly-compressed records (PNG) so pixel parity is exact."""
+    import io
+
+    from PIL import Image
+
+    from bigdl_tpu.dataset.image import LabeledImageBytes
+    rng = np.random.RandomState(seed)
+    recs = []
+    for i in range(n):
+        img = rng.randint(0, 256, size=hw + (3,)).astype(np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(img).save(buf, "PNG")
+        recs.append(LabeledImageBytes(f"r{i}", float(i % 5 + 1),
+                                      buf.getvalue()))
+    return recs
+
+
+def _batches(transformer, recs, seed=20240731):
+    RandomGenerator.RNG().set_seed(seed)
+    out = [(b.get_input().copy(), b.get_target().copy())
+           for b in transformer(iter(recs))]
+    # the post-run RNG position is part of the contract: downstream draws
+    # (an epoch reshuffle) must continue from the same point
+    end_state = RandomGenerator.RNG().np.get_state()
+    return out, end_state
+
+
+def _assert_same(a, b):
+    (batches_a, state_a), (batches_b, state_b) = a, b
+    assert len(batches_a) == len(batches_b)
+    for (xa, ya), (xb, yb) in zip(batches_a, batches_b):
+        np.testing.assert_array_equal(xa, xb)
+        np.testing.assert_array_equal(ya, yb)
+    for sa, sb in zip(state_a, state_b):
+        np.testing.assert_array_equal(np.asarray(sa), np.asarray(sb))
+
+
+# every bigdl.ingest.* depth knob exercised at an extreme and a typical
+# value: (decode workers, record ring, decoded window, batch ring)
+DEPTHS = [(1, 1, 1, 1),        # fully serialized rings
+          (2, 4, 3, 1),        # window smaller than a batch
+          (3, 64, 16, 4),      # deep read-ahead
+          (4, 256, 8, 2)]      # defaults-ish
+
+
+@pytest.mark.parametrize("workers,rec_d,dec_d,batch_d", DEPTHS)
+def test_streaming_engine_bit_identical_to_sync_path(workers, rec_d, dec_d,
+                                                     batch_d):
+    """The pipelined engine must reproduce the synchronous
+    MTLabeledBGRImgToBatch batch sequence BIT-IDENTICALLY — crops, flips,
+    record order, labels, and the caller's post-run RNG position — at
+    every ring-depth setting (pipelining is a latency property, never a
+    semantics change)."""
+    from bigdl_tpu.dataset.ingest import StreamingIngest
+    from bigdl_tpu.dataset.mt_batch import MTLabeledBGRImgToBatch
+
+    recs = _jpeg_records()
+    sync = _batches(MTLabeledBGRImgToBatch(4, crop=(32, 32)), recs)
+    eng = StreamingIngest(4, crop=(32, 32), decode_workers=workers,
+                          record_ring_depth=rec_d, decoded_ring_depth=dec_d,
+                          batch_ring_depth=batch_d)
+    _assert_same(sync, _batches(eng, recs))
+
+
+def test_streaming_engine_honours_config_properties():
+    """Depths set through ``bigdl.ingest.*`` config keys (not constructor
+    args) govern the engine — and stay bit-identical to the sync path."""
+    from bigdl_tpu.dataset.ingest import StreamingIngest
+    from bigdl_tpu.dataset.mt_batch import MTLabeledBGRImgToBatch
+
+    recs = _jpeg_records()
+    sync = _batches(MTLabeledBGRImgToBatch(4, crop=(32, 32)), recs)
+    keys = {"bigdl.ingest.decodeWorkers": 2,
+            "bigdl.ingest.recordRingDepth": 3,
+            "bigdl.ingest.decodedRingDepth": 5,
+            "bigdl.ingest.batchRingDepth": 1}
+    for k, v in keys.items():
+        config.set_property(k, v)
+    try:
+        eng = StreamingIngest(4, crop=(32, 32))
+        assert (eng.decode_workers, eng.record_ring_depth,
+                eng.decoded_ring_depth, eng.batch_ring_depth) == (2, 3, 5, 1)
+        _assert_same(sync, _batches(eng, recs))
+    finally:
+        for k in keys:
+            config.clear_property(k)
+
+
+def test_streaming_engine_device_normalize_layout_identical():
+    """The uint8 device-normalize layout pipelines identically."""
+    from bigdl_tpu.dataset.ingest import StreamingIngest
+    from bigdl_tpu.dataset.mt_batch import MTLabeledBGRImgToBatch
+
+    recs = _jpeg_records()
+    sync = _batches(MTLabeledBGRImgToBatch(4, crop=(32, 32),
+                                           device_normalize=True), recs)
+    got = _batches(StreamingIngest(4, crop=(32, 32), device_normalize=True,
+                                   decode_workers=2, decoded_ring_depth=6),
+                   recs)
+    assert got[0][0][0].dtype == np.uint8
+    _assert_same(sync, got)
+
+
+@pytest.mark.parametrize("shards", [1, 2, 3, 7])
+def test_multi_shard_reader_preserves_record_order(tmp_path, shards):
+    """The sharded seqfile reader must yield records in exactly the
+    sorted-walk order of a sequential sweep, at every shard count."""
+    from bigdl_tpu.dataset import seqfile
+    from bigdl_tpu.dataset.ingest import ShardedSeqFileReader
+
+    rng = np.random.RandomState(0)
+    for fi in range(5):
+        entries = [(f"f{fi}_i{i}", float(i % 3 + 1),
+                    rng.bytes(rng.randint(10, 400))) for i in range(6)]
+        seqfile.write_image_seqfile(str(tmp_path / f"part-{fi:02d}.seq"),
+                                    entries)
+    sequential = [(r.name, r.label, r.bytes)
+                  for r in ShardedSeqFileReader(str(tmp_path), shards=1)]
+    assert len(sequential) == 30
+    sharded = [(r.name, r.label, r.bytes)
+               for r in ShardedSeqFileReader(str(tmp_path), shards=shards)]
+    assert sharded == sequential
+
+
+@pytest.mark.parametrize("workers,rec_d,dec_d,batch_d",
+                         [(1, 1, 1, 1), (3, 64, 16, 4)])
+def test_seqfile_to_batches_pipeline_bit_identical(tmp_path, workers, rec_d,
+                                                   dec_d, batch_d):
+    """End to end: multi-shard seqfile read -> streaming engine equals the
+    sequential read -> synchronous MT path, batch for batch."""
+    from bigdl_tpu.dataset import seqfile
+    from bigdl_tpu.dataset.ingest import (ShardedSeqFileReader,
+                                          StreamingIngest)
+    from bigdl_tpu.dataset.mt_batch import MTLabeledBGRImgToBatch
+
+    recs = _jpeg_records(n=18)
+    for fi in range(3):
+        seqfile.write_image_seqfile(
+            str(tmp_path / f"part-{fi}.seq"),
+            [(r.name, r.label, r.bytes) for r in recs[fi * 6:(fi + 1) * 6]])
+
+    sync = _batches(MTLabeledBGRImgToBatch(4, crop=(32, 32)),
+                    list(ShardedSeqFileReader(str(tmp_path), shards=1)))
+    eng = StreamingIngest(4, crop=(32, 32), decode_workers=workers,
+                          record_ring_depth=rec_d, decoded_ring_depth=dec_d,
+                          batch_ring_depth=batch_d)
+    RandomGenerator.RNG().set_seed(20240731)
+    got = [(b.get_input().copy(), b.get_target().copy())
+           for b in eng(iter(ShardedSeqFileReader(str(tmp_path),
+                                                  shards=3)))]
+    got_state = RandomGenerator.RNG().np.get_state()
+    _assert_same(sync, (got, got_state))
+
+
+def test_abandoned_read_ahead_does_not_advance_caller_rng():
+    """Pipeline read-ahead that the consumer never takes (the epoch-
+    rollover discard) must not move the caller's RNG stream: the committed
+    position reflects CONSUMED batches only, so a depth-8 engine abandoned
+    after 2 batches leaves the stream exactly where the synchronous path
+    does after 2 batches."""
+    from bigdl_tpu.dataset.ingest import StreamingIngest
+    from bigdl_tpu.dataset.mt_batch import MTLabeledBGRImgToBatch
+
+    recs = _jpeg_records(n=32)
+
+    RandomGenerator.RNG().set_seed(99)
+    sync_it = MTLabeledBGRImgToBatch(4, crop=(32, 32))(iter(recs))
+    sync_batches = [next(sync_it), next(sync_it)]
+    sync_state = RandomGenerator.RNG().np.get_state()
+    sync_it.close()
+
+    RandomGenerator.RNG().set_seed(99)
+    eng = StreamingIngest(4, crop=(32, 32), decode_workers=2,
+                          record_ring_depth=64, decoded_ring_depth=16,
+                          batch_ring_depth=4)
+    it = eng(iter(recs))
+    got_batches = [next(it), next(it)]
+    import time
+    time.sleep(0.2)          # let the engine read far ahead
+    it.close()               # discard everything it buffered
+    got_state = RandomGenerator.RNG().np.get_state()
+
+    for s, g in zip(sync_batches, got_batches):
+        np.testing.assert_array_equal(s.get_input(), g.get_input())
+    for sa, sb in zip(sync_state, got_state):
+        np.testing.assert_array_equal(np.asarray(sa), np.asarray(sb))
+
+
+@pytest.mark.parametrize("ingest_depths", [(1, 1, 1, 1), (3, 64, 16, 4)])
+def test_trained_weights_identical_sync_vs_streaming(ingest_depths):
+    """Full training parity across epoch rollovers: momentum SGD over an
+    image pipeline reaches bit-identical weights whether fed by the
+    synchronous MT transformer (prefetch off) or the streaming engine
+    (prefetch + transfer-ahead on) — reshuffles, crops, and flips all
+    follow the same seeded stream."""
+    import jax
+
+    from bigdl_tpu.dataset.ingest import StreamingIngest
+    from bigdl_tpu.dataset.mt_batch import MTLabeledBGRImgToBatch
+
+    recs = _jpeg_records(n=16, hw=(36, 36))
+
+    def train(transformer, prefetch_depth):
+        config.set_property("bigdl.prefetch.depth", prefetch_depth)
+        try:
+            RandomGenerator.RNG().set_seed(4242)
+            ds = LocalDataSet(recs).transform(transformer)
+            model = (nn.Sequential().add(nn.Reshape((3 * 32 * 32,)))
+                     .add(nn.Linear(3 * 32 * 32, 4)).add(nn.LogSoftMax()))
+            model.reset(jax.random.PRNGKey(7))
+            opt = optim.Optimizer.create(model, ds, nn.ClassNLLCriterion())
+            opt.set_optim_method(optim.SGD(learning_rate=0.05,
+                                           momentum=0.9))
+            opt.set_end_when(optim.max_epoch(3))
+            opt.optimize()
+            w, _ = model.get_parameters()
+            return np.asarray(w)
+        finally:
+            config.clear_property("bigdl.prefetch.depth")
+
+    w_sync = train(MTLabeledBGRImgToBatch(4, crop=(32, 32)), 0)
+    workers, rec_d, dec_d, batch_d = ingest_depths
+    w_stream = train(
+        StreamingIngest(4, crop=(32, 32), decode_workers=workers,
+                        record_ring_depth=rec_d, decoded_ring_depth=dec_d,
+                        batch_ring_depth=batch_d), 2)
+    np.testing.assert_array_equal(w_sync, w_stream)
