@@ -870,6 +870,31 @@ def bench_realdata(batch: int = 128, steps: int = 20, warmup: int = 4,
     return max(rate_u8, rate_f32), stages
 
 
+def preflight() -> int:
+    """Static preflight: lint the package (host-sync/dtype/exception/lock
+    rules) and verify the native pipeline build — a broken tree or a
+    missing native symbol fails here in seconds, before any device time
+    is spent."""
+    from bigdl_tpu.analysis.lint import DEFAULT_ALLOWLIST, lint_paths, \
+        load_allowlist
+    pkg = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "bigdl_tpu")
+    findings = lint_paths([pkg], load_allowlist(DEFAULT_ALLOWLIST))
+    for f in findings:
+        _log(str(f))
+    rc = 1 if findings else 0
+    _log(f"preflight: lint {'FAILED' if findings else 'OK'} "
+         f"({len(findings)} finding(s))")
+    try:
+        from bigdl_tpu.dataset import native
+        native.check_build()
+        _log("preflight: native build OK")
+    except Exception as e:
+        _log(f"preflight: native build FAILED: {e}")
+        rc = 1
+    return rc
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=128)
@@ -890,7 +915,14 @@ def main():
                     help="host-only ingest leg: per-stage throughput/stall "
                          "metrics for the streaming engine vs the "
                          "synchronous MT path -> bench_ingest.json")
+    ap.add_argument("--lint-only", action="store_true",
+                    help="preflight only: AST-lint bigdl_tpu/ "
+                         "(bigdl_tpu.analysis.lint) + native.check_build(), "
+                         "no device work — exit 0 iff both pass")
     args = ap.parse_args()
+
+    if args.lint_only:
+        sys.exit(preflight())
 
     if args.ingest_only:
         # no device work at all — do not even init jax's backend

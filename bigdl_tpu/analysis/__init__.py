@@ -1,0 +1,69 @@
+"""Static analysis + sanitizer passes for the JAX training stack.
+
+The Scala reference enforced its contracts (layout, dtype, threading
+discipline) by convention and crashed at runtime when they broke.  The JAX
+rebuild makes the three classic failure modes statically and cheaply
+detectable, so this package turns them into standing checks instead of
+post-mortem archaeology:
+
+1. **Recompile sentinel** (:mod:`~bigdl_tpu.analysis.retrace`) — wraps the
+   fused-step ``jax.jit`` entry points with an abstract-signature hash;
+   after warmup any retrace raises (strict) or logs a structured
+   shape/dtype/weak-type diff (warn), surfaced as ``Analysis/retraces``
+   in TrainSummary.
+2. **Host-sync guard** (:mod:`~bigdl_tpu.analysis.hostsync`) — a context
+   manager around the optimizer hot loop arming JAX transfer guards plus
+   instrumented conversion hooks, so implicit device→host pulls (a stray
+   ``float()`` / ``np.asarray`` on a device value) fail with the offending
+   call-site; intended pulls go through the explicit :func:`host_pull`
+   choke point.
+3. **Module contract checker** (:mod:`~bigdl_tpu.analysis.contracts`) —
+   every ``nn.Module`` may declare an IO contract (ndim, dtype policy);
+   :func:`check_model` walks a model with ``jax.eval_shape`` — zero FLOPs —
+   and reports contract violations, x64/precision promotion drift, and
+   NCHW ops reachable inside an NHWC region.
+4. **AST lint** (:mod:`~bigdl_tpu.analysis.lint`,
+   ``python -m bigdl_tpu.analysis.lint bigdl_tpu``) — rule-based source
+   linter: host syncs in hot-path functions, dtype-dropping ``jnp``
+   factories in forward paths under ``nn/``, bare/swallowed exceptions in
+   ingest threads, and lock-acquisition-order violations in the ring
+   handoffs.  ``tests/test_lint_clean.py`` gates CI on a clean tree.
+
+Modes per pass (``bigdl.analysis.*`` in ``utils/config.py``): ``strict``
+(raise), ``warn`` (log + count), ``off``.
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu.utils import config as _config
+
+_MODES = ("strict", "warn", "off")
+
+
+def pass_mode(key: str, default: str = "warn") -> str:
+    """Resolve a pass's mode from ``bigdl.analysis.<key>``; unknown values
+    degrade to ``off`` rather than crashing a training run over a typo
+    (the typo is still loud: it is logged once)."""
+    mode = str(_config.get_property(f"bigdl.analysis.{key}", default)).lower()
+    if mode not in _MODES:
+        import logging
+        logging.getLogger("bigdl_tpu").warning(
+            "bigdl.analysis.%s=%r is not one of %s — pass disabled",
+            key, mode, _MODES)
+        return "off"
+    return mode
+
+
+from bigdl_tpu.analysis.retrace import (RetraceError, RetraceSentinel,  # noqa: E402
+                                        abstract_signature)
+from bigdl_tpu.analysis.hostsync import (HostSyncError, HostSyncGuard,  # noqa: E402
+                                         allow_host_sync, host_pull)
+from bigdl_tpu.analysis.contracts import (ContractError, ContractReport,  # noqa: E402
+                                          ModuleContract, check_model)
+
+__all__ = [
+    "pass_mode",
+    "RetraceError", "RetraceSentinel", "abstract_signature",
+    "HostSyncError", "HostSyncGuard", "allow_host_sync", "host_pull",
+    "ContractError", "ContractReport", "ModuleContract", "check_model",
+]

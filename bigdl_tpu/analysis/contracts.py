@@ -1,0 +1,256 @@
+"""Module contract checker: shape/dtype/layout static analysis, zero FLOPs.
+
+Every :class:`~bigdl_tpu.nn.module.Module` may declare a
+:class:`ModuleContract` (class attribute ``contract`` or per-instance
+``declare_contract``): the input rank(s) it accepts, its dtype policy, and
+whether its float output is expected to follow its float input dtype.
+:func:`check_model` then walks a model ONCE under ``jax.eval_shape`` — the
+forward runs on abstract values, so a ResNet-50 checks in milliseconds with
+no device work — and reports:
+
+- **contract violations**: an input rank or dtype a module declared it
+  cannot take (the errors that otherwise surface as cryptic XLA shape
+  failures two hours into a run);
+- **promotion drift**: a float output wider than the module's float input
+  (bf16 in → f32 out silently runs the rest of the network at double cost)
+  and any float64/complex128 leaf (x64 drift);
+- **layout violations**: a spatial module configured ``NCHW`` executing
+  inside an NHWC region (or vice versa) — closing the loop on the
+  channels-last conversion in ``nn/layout.py``.
+
+Interception instruments each module instance's ``apply`` for the duration
+of one traced forward, so recorded shapes/dtypes are exactly what the jitted
+training step would see.  ``bigdl.analysis.contracts`` picks strict
+(:class:`ContractError`) / warn / off behaviour for :meth:`ContractReport.
+raise_if_strict`.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger("bigdl_tpu")
+
+
+class ContractError(ValueError):
+    """A model violated a declared module contract (strict mode)."""
+
+
+@dataclass(frozen=True)
+class ModuleContract:
+    """Declarative IO contract for one module class/instance.
+
+    ``input_ndim``: allowed rank(s) of array inputs (None = any).
+    ``dtypes``: "float", "int", "any", or an explicit dtype-name tuple.
+    ``follows_input_dtype``: when True (default for float-to-float compute
+    modules), a float output wider than the widest float input is reported
+    as promotion drift."""
+
+    input_ndim: Optional[Tuple[int, ...]] = None
+    dtypes: Any = "any"
+    follows_input_dtype: bool = True
+
+    def __post_init__(self):
+        nd = self.input_ndim
+        if isinstance(nd, int):
+            object.__setattr__(self, "input_ndim", (nd,))
+        elif nd is not None:
+            object.__setattr__(self, "input_ndim", tuple(nd))
+
+    def allows_dtype(self, dtype) -> bool:
+        # jnp.issubdtype, not np: ml_dtypes' bfloat16 is floating to jax
+        # but alien to numpy's lattice
+        import jax.numpy as jnp
+        if self.dtypes == "any":
+            return True
+        if self.dtypes == "float":
+            return jnp.issubdtype(dtype, jnp.floating)
+        if self.dtypes == "int":
+            return jnp.issubdtype(dtype, jnp.integer)
+        return str(dtype) in tuple(self.dtypes)
+
+
+@dataclass
+class Violation:
+    module: str            # module name (instance .name)
+    kind: str              # "ndim" | "dtype" | "promotion" | "x64" | "layout"
+    detail: str
+
+    def __str__(self):
+        return f"[{self.kind}] {self.module}: {self.detail}"
+
+
+@dataclass
+class ContractReport:
+    violations: List[Violation] = field(default_factory=list)
+    modules_checked: int = 0
+    trace_error: Optional[str] = None
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations and self.trace_error is None
+
+    def by_kind(self, kind: str) -> List[Violation]:
+        return [v for v in self.violations if v.kind == kind]
+
+    def __str__(self):
+        if self.ok:
+            return (f"contract check: {self.modules_checked} modules, "
+                    "no violations")
+        lines = [f"contract check: {self.modules_checked} modules, "
+                 f"{len(self.violations)} violation(s)"]
+        lines += [f"  {v}" for v in self.violations]
+        if self.trace_error:
+            lines.append(f"  trace aborted: {self.trace_error}")
+        return "\n".join(lines)
+
+    def raise_if_strict(self, mode: Optional[str] = None) -> "ContractReport":
+        from bigdl_tpu.analysis import pass_mode
+        mode = mode or pass_mode("contracts")
+        if self.ok or mode == "off":
+            return self
+        if mode == "strict":
+            raise ContractError(str(self))
+        logger.warning("%s", self)
+        return self
+
+
+def _array_leaves(x) -> List:
+    import jax
+    return [l for l in jax.tree_util.tree_leaves(x)
+            if hasattr(l, "shape") and hasattr(l, "dtype")]
+
+
+def _widest_float(leaves):
+    import jax.numpy as jnp
+    import numpy as np
+    widths = [np.dtype(l.dtype).itemsize for l in leaves
+              if jnp.issubdtype(l.dtype, jnp.floating)]
+    return max(widths) if widths else None
+
+
+def check_model(model, sample_input, *, training: bool = False,
+                rng=None, mode: Optional[str] = None) -> ContractReport:
+    """Walk ``model`` over ``sample_input`` with ``jax.eval_shape`` and
+    check every module's declared contract plus the global dtype/layout
+    invariants.  ``sample_input`` may be concrete arrays or
+    ``jax.ShapeDtypeStruct`` trees — either way no FLOPs run.
+
+    Violations are collected even when the trace itself dies (a rank
+    mismatch usually kills the trace a layer later with an opaque XLA
+    error; the report then carries both the contract finding and the trace
+    error)."""
+    import jax
+    import numpy as np
+    from bigdl_tpu.nn.module import Container
+    from bigdl_tpu.nn.layout import NCHWToNHWC, NHWCToNCHW
+
+    model._ensure_init()
+    report = ContractReport()
+    region = {"layout": "NCHW"}    # facade layout at the model boundary
+    instrumented: List[Any] = []
+
+    def _check_inputs(m, inputs) -> None:
+        """Input-side checks run BEFORE the module's apply, so a violation
+        is on record even when the mismatch kills the trace a moment
+        later with an opaque shape error."""
+        report.modules_checked += 1
+        in_leaves = _array_leaves(inputs)
+        contract: Optional[ModuleContract] = getattr(m, "contract", None)
+        if contract is not None:
+            for l in in_leaves:
+                if (contract.input_ndim is not None and
+                        len(l.shape) not in contract.input_ndim):
+                    report.violations.append(Violation(
+                        m.name, "ndim",
+                        f"input rank {len(l.shape)} (shape {tuple(l.shape)}) "
+                        f"not in declared {contract.input_ndim}"))
+                if not contract.allows_dtype(np.dtype(l.dtype)):
+                    report.violations.append(Violation(
+                        m.name, "dtype",
+                        f"input dtype {l.dtype} violates declared policy "
+                        f"{contract.dtypes!r}"))
+        # layout: a spatial op must match the region the boundary
+        # transposes established
+        if getattr(m, "layout_role", "opaque") == "spatial":
+            fmt = getattr(m, "format", "NCHW")
+            if any(len(l.shape) in (3, 4) for l in in_leaves) and \
+                    fmt != region["layout"]:
+                report.violations.append(Violation(
+                    m.name, "layout",
+                    f"{fmt}-configured spatial op inside an "
+                    f"{region['layout']} region — the boundary transposes "
+                    "and the op's data format disagree"))
+
+    def _check_outputs(m, inputs, outputs) -> None:
+        in_leaves = _array_leaves(inputs)
+        out_leaves = _array_leaves(outputs)
+        contract: Optional[ModuleContract] = getattr(m, "contract", None)
+        # x64 drift: any leaf at double width is almost always accidental
+        # promotion (jax_enable_x64 plus a weak-typed python scalar)
+        for l in out_leaves:
+            if str(l.dtype) in ("float64", "complex128"):
+                report.violations.append(Violation(
+                    m.name, "x64",
+                    f"output leaf is {l.dtype} — x64 promotion drift"))
+        # precision promotion: float out wider than float in
+        if contract is None or contract.follows_input_dtype:
+            win, wout = _widest_float(in_leaves), _widest_float(out_leaves)
+            if win is not None and wout is not None and wout > win:
+                report.violations.append(Violation(
+                    m.name, "promotion",
+                    f"float output widens {win * 8}-bit input to "
+                    f"{wout * 8}-bit — promotion drift (a constant or "
+                    "state leaf is pinning a wider dtype)"))
+
+    def _instrument(m) -> None:
+        inner = m.apply
+
+        if isinstance(m, NCHWToNHWC):
+            def wrapped(params, input, state, **kw):
+                out = inner(params, input, state, **kw)
+                region["layout"] = "NHWC"
+                return out
+        elif isinstance(m, NHWCToNCHW):
+            def wrapped(params, input, state, **kw):
+                out = inner(params, input, state, **kw)
+                region["layout"] = "NCHW"
+                return out
+        elif isinstance(m, Container):
+            wrapped = None          # containers only orchestrate children
+        else:
+            def wrapped(params, input, state, **kw):
+                _check_inputs(m, input)
+                out = inner(params, input, state, **kw)
+                _check_outputs(m, input,
+                               out[0] if isinstance(out, tuple) else out)
+                return out
+        if wrapped is not None:
+            # instance attribute shadows the class method for the walk only
+            m.apply = wrapped
+            instrumented.append(m)
+
+    for m in model.modules():
+        _instrument(m)
+    model.clear_jit_cache()
+    try:
+        def fwd(params, x, state, key):
+            out, _ = model.apply(params, x, state, training=training,
+                                 rng=key)
+            return out
+
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        try:
+            jax.eval_shape(fwd, model.params, sample_input, model.state, rng)
+        except (ContractError,):
+            raise
+        except Exception as e:  # the trace died — report what we saw first
+            report.trace_error = f"{type(e).__name__}: {e}"
+    finally:
+        for m in instrumented:
+            m.__dict__.pop("apply", None)
+        model.clear_jit_cache()
+    return report.raise_if_strict(mode)

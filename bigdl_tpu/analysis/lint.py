@@ -1,0 +1,473 @@
+"""Rule-based AST linter for the bigdl_tpu source tree.
+
+Run as ``python -m bigdl_tpu.analysis.lint <path-or-package> [...]``.
+Imports nothing heavy (no jax), so it is safe as a CI / bench preflight.
+
+Rules
+=====
+
+``host-sync-in-hot-path``
+    In hot-loop functions (``drain`` / ``run_step`` / ``shard_step`` /
+    ``step`` under ``optim/``, ``parallel/``, ``engine.py``), calls that
+    force an implicit device→host sync — ``float(x)`` / ``int(x)`` /
+    ``bool(x)`` / ``np.asarray`` / ``np.array`` / ``.item()`` /
+    ``.tolist()`` on non-literal arguments.  Route pulls through
+    ``bigdl_tpu.analysis.host_pull`` (calls wrapping a ``host_pull``
+    result are exempt).
+
+``jnp-dtype-drop``
+    Under ``nn/``, inside forward-path functions (``apply`` and the
+    recurrent forward helpers ``init_hidden`` / ``project_input`` /
+    ``step`` / ``route`` / ``expert_forward``), ``jnp.zeros`` /
+    ``jnp.ones`` / ``jnp.empty`` with no dtype argument: the float32
+    default silently promotes a bf16 forward back to full precision.
+    (``jnp.full`` inherits its fill value's dtype and ``jnp.arange``
+    defaults to integer indices — both excluded.)
+
+``bare-except``
+    ``except:`` with no exception class, anywhere: it swallows
+    ``KeyboardInterrupt``/``SystemExit`` and hides real faults.
+
+``swallowed-exception``
+    In the threaded ingest/engine files (``dataset/ingest.py``,
+    ``engine.py``), an ``except Exception``/``BaseException`` handler
+    whose whole body is ``pass``/``continue``: a worker thread that eats
+    its own failure starves the pipeline with no diagnostic.  Narrow the
+    class (``queue.Full``/``queue.Empty``) or surface the error.
+
+``lock-order``
+    Across ``dataset/ingest.py`` + ``engine.py``, nested ``with <lock>``
+    acquisitions are collected into a lock-order graph (locks identified
+    by attribute/global name); a cycle means two call paths can acquire
+    the same pair of locks in opposite orders — the classic ring-handoff
+    deadlock.
+
+``blocking-under-lock``
+    Same files: a blocking call (``.put(...)`` / ``.get(...)`` /
+    ``.join(...)`` / ``time.sleep`` / ``wait``) while holding a lock —
+    the handoff rings must never be touched under a stage lock.
+
+Silencing: append ``# lint: allow(<rule-name>)`` to the offending line,
+or list ``<relpath>:<rule-name>`` in an allowlist file (one per line,
+``#`` comments) — the CI gate keeps the repo allowlist empty, so every
+grandfathered site is visible in the diff that introduces it.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+HOT_FUNCS = {"drain", "run_step", "shard_step", "step"}
+HOT_SCOPES = (os.path.join("optim", ""), os.path.join("parallel", ""),
+              "engine.py")
+SYNC_BUILTINS = {"float", "int", "bool"}
+SYNC_NP = {"asarray", "array", "float32", "float64"}
+SYNC_METHODS = {"item", "tolist"}
+
+NN_SCOPE = os.path.join("nn", "")
+FORWARD_FUNCS = {"apply", "init_hidden", "project_input", "step", "route",
+                 "expert_forward"}
+DTYPE_DROP_FACTORIES = {"zeros", "ones", "empty"}
+
+THREADED_FILES = (os.path.join("dataset", "ingest.py"), "engine.py")
+BLOCKING_METHODS = {"put", "get", "join", "wait", "sleep", "acquire"}
+#: receivers whose .put/.get actually block (queues/rings) — a dict .get
+#: or os.environ.get under a lock is not a handoff
+_QUEUEISH = re.compile(r"(^q$|_q$|queue|ring)", re.IGNORECASE)
+
+_ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([a-z0-9_,\- ]+)\)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def _inline_allows(source: str) -> Dict[int, Set[str]]:
+    allows: Dict[int, Set[str]] = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _ALLOW_RE.search(text)
+        if m:
+            allows[i] = {r.strip() for r in m.group(1).split(",")}
+    return allows
+
+
+def _call_name(node: ast.Call) -> Optional[str]:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _qualifier(node: ast.Call) -> Optional[str]:
+    f = node.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        return f.value.id
+    return None
+
+
+def _is_literal(node: ast.AST) -> bool:
+    return isinstance(node, (ast.Constant, ast.JoinedStr))
+
+
+def _contains_host_pull(node: ast.AST) -> bool:
+    return any(isinstance(n, ast.Call) and _call_name(n) == "host_pull"
+               for n in ast.walk(node))
+
+
+def _has_dtype_arg(call: ast.Call, positional_slot: int) -> bool:
+    if any(kw.arg == "dtype" for kw in call.keywords):
+        return True
+    return len(call.args) > positional_slot
+
+
+# ---------------------------------------------------------------------------
+# per-file rules
+# ---------------------------------------------------------------------------
+
+def _rule_host_sync(path: str, rel: str, tree: ast.AST) -> List[Finding]:
+    if not (rel.endswith("engine.py") or
+            any(s in rel for s in (os.path.join("optim", ""),
+                                   os.path.join("parallel", "")))):
+        return []
+    out: List[Finding] = []
+
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.hot = 0
+
+        def visit_FunctionDef(self, node):
+            is_hot = node.name in HOT_FUNCS
+            self.hot += is_hot
+            self.generic_visit(node)
+            self.hot -= is_hot
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Call(self, node):
+            if self.hot:
+                name = _call_name(node)
+                qual = _qualifier(node)
+                flagged = None
+                if (isinstance(node.func, ast.Name) and
+                        name in SYNC_BUILTINS and node.args and
+                        not _is_literal(node.args[0])):
+                    flagged = f"{name}(...)"
+                elif qual in ("np", "numpy", "onp") and name in SYNC_NP:
+                    flagged = f"{qual}.{name}(...)"
+                elif (isinstance(node.func, ast.Attribute) and
+                        name in SYNC_METHODS and not node.args):
+                    flagged = f".{name}()"
+                if flagged and not _contains_host_pull(node):
+                    out.append(Finding(
+                        rel, node.lineno, "host-sync-in-hot-path",
+                        f"{flagged} in hot-loop function forces an implicit "
+                        "device→host sync — batch it through "
+                        "bigdl_tpu.analysis.host_pull"))
+            self.generic_visit(node)
+
+    V().visit(tree)
+    return out
+
+
+def _rule_dtype_drop(path: str, rel: str, tree: ast.AST) -> List[Finding]:
+    if NN_SCOPE not in rel:
+        return []
+    out: List[Finding] = []
+
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.fwd = 0
+
+        def visit_FunctionDef(self, node):
+            is_fwd = node.name in FORWARD_FUNCS
+            self.fwd += is_fwd
+            self.generic_visit(node)
+            self.fwd -= is_fwd
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Call(self, node):
+            name = _call_name(node)
+            if (self.fwd and _qualifier(node) == "jnp" and
+                    name in DTYPE_DROP_FACTORIES and
+                    not _has_dtype_arg(node, 1)):
+                out.append(Finding(
+                    rel, node.lineno, "jnp-dtype-drop",
+                    f"jnp.{name} without dtype in a forward path defaults "
+                    "to float32 and silently promotes a reduced-precision "
+                    "forward — pass dtype=<input>.dtype"))
+            self.generic_visit(node)
+
+    V().visit(tree)
+    return out
+
+
+def _handler_swallows(handler: ast.ExceptHandler) -> bool:
+    body = [n for n in handler.body
+            if not (isinstance(n, ast.Expr) and
+                    isinstance(n.value, ast.Constant))]   # docstring-ish
+    return all(isinstance(n, (ast.Pass, ast.Continue)) for n in body)
+
+
+def _rule_exceptions(path: str, rel: str, tree: ast.AST) -> List[Finding]:
+    out: List[Finding] = []
+    threaded = any(rel.endswith(t) for t in THREADED_FILES)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            out.append(Finding(
+                rel, node.lineno, "bare-except",
+                "bare 'except:' swallows KeyboardInterrupt/SystemExit — "
+                "name the exception class"))
+            continue
+        if not threaded:
+            continue
+        t = node.type
+        broad = (isinstance(t, ast.Name) and
+                 t.id in ("Exception", "BaseException"))
+        if broad and _handler_swallows(node):
+            out.append(Finding(
+                rel, node.lineno, "swallowed-exception",
+                f"'except {t.id}: pass/continue' in threaded pipeline code "
+                "eats worker failures silently — narrow the class "
+                "(queue.Full/queue.Empty) or surface the error"))
+    return out
+
+
+# -- lock rules (cross-file graph) ------------------------------------------
+
+_LOCK_HINT = re.compile(r"(_lock|_LOCK|lock)$")
+
+
+def _lock_name(node: ast.AST) -> Optional[str]:
+    """Identity of a lock object by its attribute/global name:
+    ``self._lock`` -> ``<Class>._lock`` is not resolvable statically, so
+    identity is the dotted tail (``_lock``, ``_NAME_LOCK``, ...)."""
+    if isinstance(node, ast.Attribute) and _LOCK_HINT.search(node.attr):
+        base = node.value
+        base_name = base.id if isinstance(base, ast.Name) else "?"
+        return f"{base_name}.{node.attr}"
+    if isinstance(node, ast.Name) and _LOCK_HINT.search(node.id):
+        return node.id
+    return None
+
+
+class _LockVisitor(ast.NodeVisitor):
+    """Collect (outer, inner) lock-acquisition pairs and blocking calls
+    made while a lock is held."""
+
+    def __init__(self, rel: str):
+        self.rel = rel
+        self.held: List[Tuple[str, int]] = []
+        self.edges: List[Tuple[str, str, str, int]] = []   # out, in, file, line
+        self.blocking: List[Finding] = []
+
+    def visit_With(self, node):
+        acquired = []
+        for item in node.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Call):
+                expr = expr.func if isinstance(expr.func, (ast.Attribute,
+                                                           ast.Name)) else expr
+            name = _lock_name(expr)
+            if name:
+                for outer, _ in self.held:
+                    self.edges.append((outer, name, self.rel, node.lineno))
+                self.held.append((name, node.lineno))
+                acquired.append(name)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in acquired:
+            self.held.pop()
+
+    def visit_Call(self, node):
+        if self.held:
+            name = _call_name(node)
+            if (isinstance(node.func, ast.Attribute) and
+                    name in BLOCKING_METHODS and
+                    _lock_name(node.func) is None and
+                    self._blocks(node, name)):
+                outer = self.held[-1][0]
+                self.blocking.append(Finding(
+                    self.rel, node.lineno, "blocking-under-lock",
+                    f".{name}(...) called while holding {outer} — a "
+                    "blocked ring handoff under a stage lock deadlocks "
+                    "the pipeline"))
+        self.generic_visit(node)
+
+    @staticmethod
+    def _blocks(node: ast.Call, name: str) -> bool:
+        """put/get only block on queue/ring receivers (or with an explicit
+        blocking timeout); join/wait/sleep/acquire always do.  The
+        explicitly NON-blocking forms — block=False, timeout=0 — are the
+        safe handoff under a lock and never flag."""
+        if name not in ("put", "get"):
+            return True
+        for kw in node.keywords:
+            if (kw.arg == "block" and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is False):
+                return False
+            if (kw.arg == "timeout" and isinstance(kw.value, ast.Constant)
+                    and kw.value.value == 0):
+                return False
+        if any(kw.arg in ("timeout", "block") for kw in node.keywords):
+            return True
+        recv = node.func.value
+        recv_name = (recv.attr if isinstance(recv, ast.Attribute)
+                     else recv.id if isinstance(recv, ast.Name) else "")
+        return bool(_QUEUEISH.search(recv_name))
+
+
+def _find_lock_cycles(edges) -> List[Finding]:
+    graph: Dict[str, Set[str]] = {}
+    sites: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    for outer, inner, rel, line in edges:
+        if outer == inner:
+            continue
+        graph.setdefault(outer, set()).add(inner)
+        sites.setdefault((outer, inner), (rel, line))
+    out: List[Finding] = []
+    seen_pairs = set()
+    for a in graph:
+        for b in graph[a]:
+            if a in graph.get(b, ()) and (b, a) not in seen_pairs:
+                seen_pairs.add((a, b))
+                rel1, l1 = sites[(a, b)]
+                rel2, l2 = sites[(b, a)]
+                out.append(Finding(
+                    rel1, l1, "lock-order",
+                    f"lock order cycle: {a} -> {b} here but {b} -> {a} at "
+                    f"{rel2}:{l2} — two threads can deadlock on the pair"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def _package_base(path: str) -> str:
+    """Anchor for repo-relative paths: the parent of the TOPMOST package
+    containing ``path``.  This makes ``Finding.path`` (and therefore the
+    path-scoped rules and allowlist keys) invocation-independent —
+    linting ``bigdl_tpu``, ``bigdl_tpu/optim``, or a single
+    ``optim/metrics.py`` all report ``bigdl_tpu/optim/metrics.py``."""
+    anchor = os.path.abspath(path)
+    if os.path.isfile(anchor):
+        anchor = os.path.dirname(anchor)
+    while os.path.exists(os.path.join(anchor, "__init__.py")):
+        anchor = os.path.dirname(anchor)
+    return anchor
+
+
+def _iter_sources(targets: Sequence[str]) -> Iterable[Tuple[str, str]]:
+    """(abs path, package-relative path) for every .py under the targets.
+    A bare package name resolves relative to this file's grandparent (the
+    repo layout), then the cwd."""
+    for t in targets:
+        root = t
+        if not os.path.exists(root):
+            here = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+            cand = os.path.join(here, t)
+            root = cand if os.path.exists(cand) else t
+        base = _package_base(root)
+        if os.path.isfile(root):
+            yield root, os.path.relpath(os.path.abspath(root), base)
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    p = os.path.join(dirpath, fn)
+                    yield p, os.path.relpath(os.path.abspath(p), base)
+
+
+def load_allowlist(path: Optional[str]) -> Set[str]:
+    """``<relpath>:<rule>`` entries; '#' comments and blanks ignored."""
+    if not path or not os.path.exists(path):
+        return set()
+    out = set()
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                out.add(line)
+    return out
+
+
+def lint_paths(targets: Sequence[str],
+               allowlist: Optional[Set[str]] = None) -> List[Finding]:
+    allowlist = allowlist or set()
+    findings: List[Finding] = []
+    lock_edges = []
+    for path, rel in _iter_sources(targets):
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            findings.append(Finding(rel, e.lineno or 0, "syntax",
+                                    f"unparseable: {e.msg}"))
+            continue
+        allows = _inline_allows(source)
+        file_findings = (_rule_host_sync(path, rel, tree) +
+                         _rule_dtype_drop(path, rel, tree) +
+                         _rule_exceptions(path, rel, tree))
+        if any(rel.endswith(t) for t in THREADED_FILES):
+            lv = _LockVisitor(rel)
+            lv.visit(tree)
+            lock_edges.extend(lv.edges)
+            file_findings.extend(lv.blocking)
+        for f in file_findings:
+            if f.rule in allows.get(f.line, ()):
+                continue
+            if f"{f.path}:{f.rule}" in allowlist:
+                continue
+            findings.append(f)
+    for f in _find_lock_cycles(lock_edges):
+        if f"{f.path}:{f.rule}" not in allowlist:
+            findings.append(f)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "lint_allowlist.txt")
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m bigdl_tpu.analysis.lint",
+        description="static lint for host-sync/dtype/exception/lock rules")
+    ap.add_argument("targets", nargs="+",
+                    help="package directories or .py files")
+    ap.add_argument("--allowlist", default=DEFAULT_ALLOWLIST,
+                    help="grandfathered '<relpath>:<rule>' entries "
+                         "(default: the in-repo allowlist, kept empty)")
+    args = ap.parse_args(argv)
+    findings = lint_paths(args.targets, load_allowlist(args.allowlist))
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"\n{len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

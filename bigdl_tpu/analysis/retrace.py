@@ -1,0 +1,196 @@
+"""Recompile sentinel: catch silent ``jax.jit`` retraces of the fused step.
+
+A jitted training step recompiles whenever the abstract signature of its
+arguments changes — a shape drift from an uneven batch, a dtype flip from a
+dropped cast, a weak-type wobble from a Python scalar sneaking into a carry.
+Each retrace costs seconds to minutes of XLA compile time and, when it
+happens every iteration, silently runs training 3x slow with no error.
+
+The sentinel hashes the abstract signature (pytree structure + per-leaf
+shape/dtype/weak-type) of every call to a wrapped step function.  New
+signatures during warmup are compiles and are budgeted
+(``bigdl.analysis.retraceBudget``); after warmup
+(``bigdl.analysis.retraceWarmupSteps`` calls) any unseen signature is a
+retrace event: ``strict`` raises :class:`RetraceError` with a structured
+per-leaf diff against the previous signature, ``warn`` logs the same diff
+and counts it (surfaced as ``Analysis/retraces`` in TrainSummary).
+
+Hashing is host-only metadata work (no device sync): a few hundred
+nanoseconds per leaf, irrelevant next to a training step.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from bigdl_tpu.utils import config
+
+logger = logging.getLogger("bigdl_tpu")
+
+
+class RetraceError(ValueError):
+    """A wrapped jitted function was called with an unseen abstract
+    signature after warmup.  Subclasses ``ValueError`` so the trainer's
+    failure-retry loop treats it as a non-retryable programming error
+    (retrying would just recompile again) instead of restoring a
+    checkpoint and looping."""
+
+
+def _leaf_sig(x) -> Tuple:
+    """(shape, dtype, weak_type) triple of one argument leaf — the part of
+    the abstract value that keys jit's compilation cache."""
+    aval = getattr(x, "aval", None)
+    if aval is not None:          # jax.Array / tracer
+        return (tuple(aval.shape), str(aval.dtype),
+                bool(getattr(aval, "weak_type", False)))
+    if isinstance(x, np.ndarray):
+        return (tuple(x.shape), str(x.dtype), False)
+    if isinstance(x, (bool, int, float, complex)):
+        # python scalars trace as weak-typed 0-d values: the VALUE doesn't
+        # retrace, but the TYPE does (int→float flips the weak dtype)
+        return ((), type(x).__name__, True)
+    # non-array static leaf: identity by repr (strings, None, ...)
+    return ("static", repr(x)[:120], False)
+
+
+def abstract_signature(args: Tuple) -> Tuple[Any, Tuple]:
+    """(treedef, per-leaf signature tuple) for a call's positional args —
+    equal signatures hit the same jit cache entry."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    return treedef, tuple(_leaf_sig(x) for x in leaves)
+
+
+def _signature_paths(args: Tuple) -> List[str]:
+    """Human-readable path per leaf, aligned with the signature tuple."""
+    import jax
+    flat = jax.tree_util.tree_flatten_with_path(args)[0]
+    return [jax.tree_util.keystr(path) for path, _ in flat]
+
+
+def signature_diff(old: Tuple, new: Tuple, paths: List[str]) -> List[str]:
+    """Per-leaf delta lines between two signatures (shape / dtype /
+    weak-type changes named explicitly — the reader should not have to
+    eyeball two tuples)."""
+    old_td, old_sig = old
+    new_td, new_sig = new
+    lines: List[str] = []
+    if old_td != new_td:
+        lines.append(f"argument tree structure changed: {old_td} -> {new_td}")
+    n = min(len(old_sig), len(new_sig))
+    for i in range(n):
+        o, nw = old_sig[i], new_sig[i]
+        if o == nw:
+            continue
+        what = []
+        if o[0] != nw[0]:
+            what.append("shape")
+        if o[1] != nw[1]:
+            what.append("dtype")
+        if o[2] != nw[2]:
+            what.append("weak-type")
+        path = paths[i] if i < len(paths) else f"leaf[{i}]"
+        lines.append(
+            f"  {path}: {o[0]} {o[1]}{' weak' if o[2] else ''} -> "
+            f"{nw[0]} {nw[1]}{' weak' if nw[2] else ''} "
+            f"[{', '.join(what) or 'static'}]")
+    if len(old_sig) != len(new_sig):
+        lines.append(f"  leaf count changed: {len(old_sig)} -> {len(new_sig)}")
+    return lines
+
+
+class RetraceSentinel:
+    """Signature-tracking wrapper around one jitted step function.
+
+    ``wrap(fn)`` returns a callable with identical behaviour plus
+    bookkeeping: ``calls``, ``signatures`` (distinct abstract signatures
+    seen), ``retraces`` (post-warmup events), ``compiles_in_warmup``, and
+    ``last_diff`` (the structured delta of the most recent event).
+    """
+
+    def __init__(self, name: str, mode: Optional[str] = None,
+                 warmup_steps: Optional[int] = None,
+                 budget: Optional[int] = None):
+        from bigdl_tpu.analysis import pass_mode
+        self.name = name
+        self.mode = mode if mode is not None else pass_mode("retrace")
+        self.warmup_steps = (warmup_steps if warmup_steps is not None else
+                             config.get_int("bigdl.analysis.retraceWarmupSteps",
+                                            2))
+        self.budget = (budget if budget is not None else
+                       config.get_int("bigdl.analysis.retraceBudget", 2))
+        self.calls = 0
+        self.retraces = 0
+        self.compiles_in_warmup = 0
+        self._seen = {}            # (treedef, sig) key -> first-seen call no.
+        self._last = None          # last (treedef, sig)
+        self._last_args_paths: List[str] = []
+        self.last_diff: List[str] = []
+
+    @classmethod
+    def from_config(cls, name: str) -> Optional["RetraceSentinel"]:
+        from bigdl_tpu.analysis import pass_mode
+        mode = pass_mode("retrace")
+        if mode == "off":
+            return None
+        return cls(name, mode=mode)
+
+    # -- observation ------------------------------------------------------
+
+    def observe(self, args: Tuple) -> Optional[List[str]]:
+        """Record one call.  Returns the structured diff when the call is a
+        post-warmup retrace (or a warmup compile beyond the budget), else
+        None."""
+        self.calls += 1
+        key = abstract_signature(args)
+        hkey = (key[0], key[1])
+        if hkey in self._seen:
+            self._last = key
+            self._last_args_paths = []
+            return None
+        first = not self._seen
+        prev, prev_paths = self._last, self._last_args_paths
+        self._seen[hkey] = self.calls
+        self._last = key
+        self._last_args_paths = _signature_paths(args)
+        if first:
+            self.compiles_in_warmup += 1
+            return None
+        in_warmup = self.calls <= self.warmup_steps
+        if in_warmup and len(self._seen) <= max(1, self.budget):
+            self.compiles_in_warmup += 1
+            return None
+        paths = prev_paths or self._last_args_paths
+        diff = signature_diff(prev, key, paths) if prev is not None else [
+            "first signature unavailable"]
+        self.last_diff = diff
+        self.retraces += 1
+        return diff
+
+    # -- wrapping ---------------------------------------------------------
+
+    def wrap(self, fn):
+        def wrapped(*args):
+            diff = self.observe(args)
+            if diff is not None:
+                msg = (
+                    f"{self.name}: jitted step retraced at call "
+                    f"{self.calls} (signature #{len(self._seen)}, warmup="
+                    f"{self.warmup_steps}, budget={self.budget}) — "
+                    "signature delta:\n" + "\n".join(diff) +
+                    "\nA post-warmup retrace recompiles the fused step "
+                    "every occurrence; stabilize the argument signature "
+                    "(pad uneven batches, pin dtypes, keep hyper-parameter "
+                    "scalars dynamic).  Silence with "
+                    "bigdl.analysis.retrace=off.")
+                if self.mode == "strict":
+                    raise RetraceError(msg)
+                logger.warning("%s", msg)
+            return fn(*args)
+
+        wrapped.sentinel = self
+        wrapped.__wrapped__ = fn
+        return wrapped

@@ -100,10 +100,16 @@ class BatchPrefetcher:
     """
 
     def __init__(self, fetch, depth: Optional[int] = None,
-                 on_batch=None, transfer_ahead: Optional[int] = None):
+                 on_batch=None, transfer_ahead: Optional[int] = None,
+                 guard=None):
         import queue
 
         from bigdl_tpu.utils import config
+        #: optional host-sync guard (bigdl_tpu.analysis) armed around the
+        #: user fetch callable — the guard's hooks are thread-local, so
+        #: the trainer's hot-loop arming cannot see work that runs HERE
+        #: on the producer thread; arming at the call site closes that
+        self._guard = guard
         self.depth = (depth if depth is not None
                       else config.get_int("bigdl.prefetch.depth", 2))
         self.transfer_ahead = (
@@ -165,7 +171,11 @@ class BatchPrefetcher:
 
     def _fetch_once(self, block: bool = True):
         t0 = time.monotonic_ns()
-        batch = self._fetch()
+        if self._guard is not None:
+            with self._guard.armed():
+                batch = self._fetch()
+        else:
+            batch = self._fetch()
         if self._on_batch is not None:
             self._on_batch(batch)
         self.fetch_ns += time.monotonic_ns() - t0
@@ -175,11 +185,12 @@ class BatchPrefetcher:
         return batch
 
     def _put(self, q, item) -> bool:
+        import queue as _queue
         while not self._stop.is_set():
             try:
                 q.put(item, timeout=0.1)
                 return True
-            except Exception:
+            except _queue.Full:
                 continue
         return False
 
@@ -270,7 +281,9 @@ class Engine:
             try:
                 import jax
                 jax.config.update("jax_platforms", "cpu")
-            except Exception:
+            except Exception:  # lint: allow(swallowed-exception)
+                # best-effort: a backend already initialized keeps
+                # whatever platform it pinned
                 pass
 
     @staticmethod

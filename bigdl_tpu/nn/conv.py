@@ -21,6 +21,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from bigdl_tpu.analysis.contracts import ModuleContract
 from bigdl_tpu.nn.module import Module
 from bigdl_tpu.nn import init as init_methods
 from bigdl_tpu import ops
@@ -30,6 +31,8 @@ class SpatialConvolution(Module):
     """2-D convolution (reference ``nn/SpatialConvolution.scala:42``)."""
 
     layout_role = "spatial"
+    #: image maps in, float compute (bigdl_tpu.analysis contract checker)
+    contract = ModuleContract(input_ndim=(3, 4), dtypes="float")
 
     def __init__(self, n_input_plane: int, n_output_plane: int,
                  kernel_w: int, kernel_h: int,

@@ -17,6 +17,7 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 
+from bigdl_tpu.analysis.contracts import ModuleContract
 from bigdl_tpu.nn.module import Module
 from bigdl_tpu.nn import init as init_methods
 
@@ -37,6 +38,8 @@ class Linear(Module):
 
     #: "column"/"row" Megatron tag; None = not tensor-parallel
     _tp = None
+    #: float matmul input (any rank; the trailing dim contracts with W)
+    contract = ModuleContract(dtypes="float")
     #: mesh-axis name for the explicit shard_map tp path
     model_parallel = None
 

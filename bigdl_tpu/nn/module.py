@@ -138,6 +138,21 @@ class Module:
     #                :meth:`set_format`.
     layout_role = "opaque"
 
+    # ---- declarable IO contract (bigdl_tpu.analysis.contracts) ----------
+    # A ModuleContract (input rank(s), dtype policy, promotion expectation)
+    # that the static contract checker verifies with jax.eval_shape — no
+    # FLOPs.  Class attribute for layer families (conv/pool/BN declare
+    # theirs), instance attribute via declare_contract for one-offs.
+    contract = None
+
+    def declare_contract(self, **kwargs) -> "Module":
+        """Attach a per-instance IO contract, e.g.
+        ``m.declare_contract(input_ndim=(2, 3), dtypes="float")`` —
+        checked by :func:`bigdl_tpu.analysis.check_model`."""
+        from bigdl_tpu.analysis.contracts import ModuleContract
+        self.contract = ModuleContract(**kwargs)
+        return self
+
     def __init__(self, name: Optional[str] = None):
         self.name = name or f"{type(self).__name__}_{next(Module._name_seq)}"
         self.train_mode: bool = True

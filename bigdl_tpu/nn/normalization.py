@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bigdl_tpu.analysis.contracts import ModuleContract
 from bigdl_tpu.nn.module import Module
 from bigdl_tpu import ops
 
@@ -28,6 +29,7 @@ class BatchNormalization(Module):
 
     _reduce_axes = (0,)
     _param_shape_ndim = 2
+    contract = ModuleContract(input_ndim=(2,), dtypes="float")
 
     def __init__(self, n_output: int, eps: float = 1e-5, momentum: float = 0.1,
                  affine: bool = True, init_weight=None, init_bias=None,
@@ -105,6 +107,7 @@ class SpatialBatchNormalization(BatchNormalization):
     TF-import and TPU-preferred activation layout)."""
 
     layout_role = "spatial"
+    contract = ModuleContract(input_ndim=(3, 4), dtypes="float")
 
     def __init__(self, n_output, eps=1e-5, momentum=0.1, affine=True,
                  init_weight=None, init_bias=None, init_running_mean=None,

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from bigdl_tpu.analysis.contracts import ModuleContract
 from bigdl_tpu.nn.module import Module
 from bigdl_tpu import ops
 
@@ -16,6 +17,7 @@ class SpatialMaxPooling(Module):
     """2-D max pooling (reference ``nn/SpatialMaxPooling.scala``)."""
 
     layout_role = "spatial"
+    contract = ModuleContract(input_ndim=(3, 4), dtypes="float")
 
     def __init__(self, kw: int, kh: int, dw: int = None, dh: int = None,
                  pad_w: int = 0, pad_h: int = 0, format: str = "NCHW",
@@ -54,6 +56,7 @@ class SpatialAveragePooling(Module):
     """2-D average pooling (reference ``nn/SpatialAveragePooling.scala``)."""
 
     layout_role = "spatial"
+    contract = ModuleContract(input_ndim=(3, 4), dtypes="float")
 
     def __init__(self, kw: int, kh: int, dw: int = 1, dh: int = 1,
                  pad_w: int = 0, pad_h: int = 0,

@@ -106,7 +106,10 @@ class RnnCell(Cell):
                 "bias": _uniform(k3, (self.hidden_size,), stdv)}
 
     def init_hidden(self, params, batch_shape):
-        return jnp.zeros(tuple(batch_shape) + (self.hidden_size,))
+        # follow the (possibly bf16-cast) parameter dtype: an f32 hidden
+        # state would promote every recurrent matmul of a bf16 forward
+        return jnp.zeros(tuple(batch_shape) + (self.hidden_size,),
+                         dtype=params["w_hh"].dtype)
 
     def project_input(self, params, x, training=False, rng=None):
         return x @ params["w_ih"] + params["bias"]
@@ -152,7 +155,8 @@ class LSTM(Cell):
                 "bias": _uniform(k3, (4 * H,), stdv)}
 
     def init_hidden(self, params, batch_shape):
-        z = jnp.zeros(tuple(batch_shape) + (self.hidden_size,))
+        z = jnp.zeros(tuple(batch_shape) + (self.hidden_size,),
+                      dtype=params["w_hh"].dtype)
         return (z, z)
 
     def project_input(self, params, x, training=False, rng=None):
@@ -230,7 +234,8 @@ class GRU(Cell):
                 "b_hh": _uniform(k4, (3 * H,), stdv)}
 
     def init_hidden(self, params, batch_shape):
-        return jnp.zeros(tuple(batch_shape) + (self.hidden_size,))
+        return jnp.zeros(tuple(batch_shape) + (self.hidden_size,),
+                         dtype=params["w_hh"].dtype)
 
     def project_input(self, params, x, training=False, rng=None):
         if training and self.p > 0 and rng is not None:
@@ -309,7 +314,7 @@ class ConvLSTMPeephole(Cell):
             raise RuntimeError("ConvLSTMPeephole hidden spatial shape unknown "
                                "before the first forward")
         shape = tuple(batch_shape) + (self.output_size,) + self._spatial_shape
-        z = jnp.zeros(shape)
+        z = jnp.zeros(shape, dtype=params["w_hh"].dtype)
         return (z, z)
 
     def project_input(self, params, x, training=False, rng=None):
@@ -529,8 +534,10 @@ class BinaryTreeLSTM(Module):
         c_leaf = i * u
         h_leaf = o * jnp.tanh(c_leaf)
 
-        h_buf = jnp.concatenate([h_leaf, jnp.zeros((B, tree.shape[1], H))], 1)
-        c_buf = jnp.concatenate([c_leaf, jnp.zeros((B, tree.shape[1], H))], 1)
+        h_buf = jnp.concatenate(
+            [h_leaf, jnp.zeros((B, tree.shape[1], H), dtype=h_leaf.dtype)], 1)
+        c_buf = jnp.concatenate(
+            [c_leaf, jnp.zeros((B, tree.shape[1], H), dtype=c_leaf.dtype)], 1)
 
         def body(carry, node):
             h_buf, c_buf, idx = carry

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bigdl_tpu.analysis.hostsync import host_pull
 from bigdl_tpu.engine import DispatchPipeline
 from bigdl_tpu.engine import to_device as _to_device
 from bigdl_tpu.dataset.dataset import AbstractDataSet
@@ -137,7 +138,10 @@ def evaluate_dataset(model: Module, dataset,
         # a full device round-trip (bigdl.pipeline.depth, default 8)
         def drain(item, _nxt):
             out_dev, tgt = item
-            out = np.asarray(out_dev)
+            # ONE explicit device_get per validation step: every metric
+            # then works on host arrays — N methods cost one pull, not N
+            # implicit ones (and none per method inside apply)
+            out = host_pull(out_dev, what="validation outputs")
             for i, m in enumerate(methods):
                 r = m.apply(out, tgt)
                 totals[i] = r if totals[i] is None else totals[i] + r

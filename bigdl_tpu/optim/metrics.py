@@ -4,6 +4,13 @@ Reference equivalent: ``optim/Metrics.scala:31`` — named counters backed by
 Spark accumulators (local / aggregated-distributed / per-node list).  Here a
 process-local dict with the same set/add/get surface; the distributed trainer
 aggregates per-shard values before recording.
+
+Hot-path contract: :meth:`add` accepts DEVICE scalars without coercion —
+a ``float(device_value)`` per call would be one blocking device round-trip
+per iteration, exactly the implicit host sync the analysis pass forbids.
+Device values are parked as-is and pulled in ONE explicit ``device_get``
+when a reader (:meth:`get` / :meth:`aggregated` / :meth:`summary`) actually
+needs host numbers.
 """
 
 from __future__ import annotations
@@ -12,31 +19,84 @@ import threading
 from typing import Dict, List, Tuple, Union
 
 
+def _is_device_value(v) -> bool:
+    """True for jax device arrays (anything carrying an abstract value);
+    plain python/numpy scalars convert for free and are folded eagerly."""
+    return hasattr(v, "aval")
+
+
 class Metrics:
     def __init__(self):
         self._scalar: Dict[str, Tuple[float, int]] = {}   # value, parallelism
         self._lists: Dict[str, List[float]] = {}
+        self._pending: Dict[str, list] = {}   # device scalars, not yet pulled
         self._lock = threading.Lock()
+        # serializes flushes and resets: the blocking device pull happens
+        # outside _lock (a reader must not stall hot-loop adds for a device
+        # round-trip), so without this a set() could slip between a flush's
+        # swap-out and fold-in and have pre-reset values folded on top of
+        # it, and a second reader could observe the transient gap
+        self._flush_lock = threading.Lock()
 
     def set(self, name: str, value: Union[float, List[float]],
             parallelism: int = 1) -> None:
-        with self._lock:
+        with self._flush_lock, self._lock:
             if isinstance(value, (list, tuple)):
                 self._lists[name] = list(value)
             else:
+                self._pending.pop(name, None)
                 self._scalar[name] = (float(value), parallelism)
 
+    #: parked device scalars per name before they are compacted into one
+    #: on-device sum (an async dispatch, never a sync) — bounds live
+    #: buffers on long runs that are only read at the end
+    COMPACT_AT = 256
+
     def add(self, name: str, value: float) -> None:
+        if _is_device_value(value):
+            # accumulate on device: park the scalar un-synced; one batched
+            # pull happens at read time (get/aggregated/summary)
+            with self._lock:
+                lst = self._pending.setdefault(name, [])
+                lst.append(value)
+                if len(lst) >= self.COMPACT_AT:
+                    import jax.numpy as jnp
+                    self._pending[name] = [jnp.sum(jnp.stack(lst))]
+            return
         with self._lock:
-            if name in self._lists:
-                self._lists[name].append(float(value))
-            elif name in self._scalar:
-                v, p = self._scalar[name]
-                self._scalar[name] = (v + float(value), p)
-            else:
-                self._scalar[name] = (float(value), 1)
+            self._add_host(name, float(value))
+
+    def _add_host(self, name: str, value: float) -> None:
+        """Fold one host float in (caller holds the lock)."""
+        if name in self._lists:
+            self._lists[name].append(value)
+        elif name in self._scalar:
+            v, p = self._scalar[name]
+            self._scalar[name] = (v + value, p)
+        else:
+            self._scalar[name] = (value, 1)
+
+    def _flush_pending(self) -> None:
+        """Pull every parked device scalar in one explicit device_get and
+        fold the host values in.  The blocking pull happens OUTSIDE
+        ``_lock`` (a reader must not stall a concurrent hot-loop ``add``
+        for a device round-trip); ``_flush_lock`` keeps the whole
+        swap-out → pull → fold-in atomic w.r.t. other readers and
+        ``set`` resets."""
+        with self._flush_lock:
+            with self._lock:
+                if not self._pending:
+                    return
+                pending, self._pending = self._pending, {}
+            from bigdl_tpu.analysis.hostsync import host_pull
+            pulled = host_pull(pending, what="metrics flush")
+            with self._lock:
+                for name, values in pulled.items():
+                    for v in values:
+                        self._add_host(name, float(v))
 
     def get(self, name: str):
+        self._flush_pending()
         with self._lock:
             if name in self._scalar:
                 v, p = self._scalar[name]
@@ -54,6 +114,7 @@ class Metrics:
         process must call it with the same name."""
         from bigdl_tpu.engine import allgather_sum
 
+        self._flush_pending()
         with self._lock:
             v, p = self._scalar.get(name, (0.0, 0))
         total_v, total_p = allgather_sum([v, float(p)])
@@ -62,6 +123,7 @@ class Metrics:
         return float(total_v / total_p)
 
     def summary(self, unit: str = "s", scale: float = 1e9) -> str:
+        self._flush_pending()
         with self._lock:
             parts = [f"{k}: {v / p / scale} {unit}"
                      for k, (v, p) in self._scalar.items()]

@@ -250,6 +250,8 @@ class Optimizer:
         self._profile_dir: Optional[str] = None
         self._profile_start: int = 10
         self._profile_n: int = 3
+        #: recompile sentinel wrapped around the fused step (analysis pass 1)
+        self._retrace_sentinel = None
 
     # -- fluent setters (reference Optimizer.scala fluent API) ------------
 
@@ -436,6 +438,23 @@ class Optimizer:
     def _optimize(self) -> Module:
         raise NotImplementedError
 
+    def _arm_retrace(self, step_fn, label: str):
+        """Wrap a fused jitted step with the recompile sentinel
+        (``bigdl.analysis.retrace``): post-warmup signature drift raises
+        (strict) or logs a structured shape/dtype/weak-type diff (warn),
+        surfaced as ``Analysis/retraces`` in TrainSummary.  Host-driven
+        feval methods (LBFGS) are not jitted per-step, so they pass
+        through unwrapped."""
+        if getattr(self.optim_method, "requires_feval", False):
+            return step_fn
+        from bigdl_tpu.analysis.retrace import RetraceSentinel
+        sentinel = RetraceSentinel.from_config(
+            f"{type(self).__name__}[{label}]")
+        if sentinel is None:
+            return step_fn
+        self._retrace_sentinel = sentinel
+        return sentinel.wrap(step_fn)
+
     def _params_dead(self) -> bool:
         """True if any live model parameter buffer was donated-and-deleted
         by a partially-completed jitted step."""
@@ -528,9 +547,13 @@ class Optimizer:
         from bigdl_tpu.utils import config as _config
         max_bad_steps = _config.get_int("bigdl.divergence.maxBadSteps", 5)
 
+        from bigdl_tpu.analysis.hostsync import host_pull
+
         def drain(item, nxt):
             loss_dev, bsz, t0, epoch, recs, neval = item
-            loss = float(loss_dev)
+            # the ONE intended device→host pull of the hot loop, through
+            # the explicit choke point (permitted while the guard is armed)
+            loss = float(host_pull(loss_dev, what="iteration loss"))
             # per-iteration wall time = interval to the NEXT dispatch (the
             # flush happens up to depth-1 dispatches later, so "now - t0"
             # would overstate it depth-fold)
@@ -594,7 +617,35 @@ class Optimizer:
                 fetched["records"] = 0
                 reset_epoch()
 
-        fetch = BatchPrefetcher(fetch_batch, on_batch=on_batch)
+        # host-sync sanitizer (analysis pass 2): implicit device→host pulls
+        # inside the fetch→step→dispatch region fail with their call-site
+        # (strict) or log-once-and-count (warn).  The host-driven feval
+        # path (LBFGS line search) pulls by design and is exempt.
+        from bigdl_tpu.analysis.hostsync import NULL_GUARD, HostSyncGuard
+        if getattr(self.optim_method, "requires_feval", False):
+            hot_guard = NULL_GUARD
+        else:
+            hot_guard = HostSyncGuard.from_config()
+        # bigdl.analysis.hotLoopScope: "iteration" sanitizes fetch+step,
+        # "step" only the dispatch region (for exotic fetch transformers
+        # that pull device values by design)
+        scope = str(_config.get_property("bigdl.analysis.hotLoopScope",
+                                         "iteration"))
+        fetch_guard = hot_guard if scope == "iteration" else NULL_GUARD
+        # per-run baseline: the global sync counter survives across runs
+        # in one process; TrainSummary must chart THIS run's syncs
+        if hot_guard.enabled:
+            from bigdl_tpu.analysis.hostsync import STATS as _hs_stats
+            self._hostsync_base = _hs_stats.snapshot()["implicit"]
+        else:
+            self._hostsync_base = None
+        # the guard's hooks are thread-local: the producer thread runs the
+        # actual fetch under bigdl.prefetch.depth > 0, so the prefetcher
+        # arms the fetch guard AT the fetch call site (the in-loop arming
+        # below covers only the synchronous depth=0 path and the dequeue)
+        fetch = BatchPrefetcher(
+            fetch_batch, on_batch=on_batch,
+            guard=fetch_guard if fetch_guard.enabled else None)
         profiling = False
         profiled = False   # the window fires once, even across resumes
 
@@ -640,24 +691,27 @@ class Optimizer:
                     inject_nan = _chaos.on_step(state["neval"])
                 else:
                     inject_nan = False
-                t_data = time.time_ns()
-                inputs, targets, bsz = fetch()
-                self.metrics.add("get batch time", time.time_ns() - t_data)
+                with fetch_guard.armed():
+                    t_data = time.time_ns()
+                    inputs, targets, bsz = fetch()
+                    self.metrics.add("get batch time",
+                                     time.time_ns() - t_data)
 
-                self.optim_method.state["epoch"] = state["epoch"]
-                hyper = self.optim_method.hyper()
-                rng = (jax.random.PRNGKey(rng_counter) if stochastic else
-                       jax.random.PRNGKey(0))
-                rng_counter += 1
+                with hot_guard.armed():
+                    self.optim_method.state["epoch"] = state["epoch"]
+                    hyper = self.optim_method.hyper()
+                    rng = (jax.random.PRNGKey(rng_counter) if stochastic else
+                           jax.random.PRNGKey(0))
+                    rng_counter += 1
 
-                t0 = time.time_ns()
-                loss_dev = run_step(inputs, targets, hyper, rng)
-                if inject_nan:
-                    loss_dev = float("nan")
-                self.optim_method.step_done()
-                pipeline.push(loss_dev, bsz, t0, state["epoch"],
-                              state["recordsProcessedThisEpoch"] + bsz,
-                              state["neval"])
+                    t0 = time.time_ns()
+                    loss_dev = run_step(inputs, targets, hyper, rng)
+                    if inject_nan:
+                        loss_dev = float("nan")
+                    self.optim_method.step_done()
+                    pipeline.push(loss_dev, bsz, t0, state["epoch"],
+                                  state["recordsProcessedThisEpoch"] + bsz,
+                                  state["neval"])
 
                 state["recordsProcessedThisEpoch"] += bsz
 
@@ -838,6 +892,19 @@ class Optimizer:
         self.train_summary.add_scalar("Throughput", throughput, neval)
         self.train_summary.add_scalar(
             "LearningRate", self.optim_method.get_learning_rate(), neval)
+        # sanitizer counters: post-warmup retraces of the fused step and
+        # implicit host syncs caught in the hot loop THIS RUN — a healthy
+        # run charts both flat at zero.  Independent gates: either pass
+        # can be off while the other still reports.
+        if self._retrace_sentinel is not None:
+            self.train_summary.add_scalar(
+                "Analysis/retraces", self._retrace_sentinel.retraces, neval)
+        if getattr(self, "_hostsync_base", None) is not None:
+            from bigdl_tpu.analysis.hostsync import STATS as _hs_stats
+            self.train_summary.add_scalar(
+                "Analysis/implicit_host_syncs",
+                _hs_stats.snapshot()["implicit"] - self._hostsync_base,
+                neval)
         # streaming-ingest stage counters (throughput / stall fraction /
         # ring occupancy per stage) when a StreamingIngest engine feeds
         # this run — the per-stage view that names the bottleneck stage
@@ -980,7 +1047,7 @@ class LocalOptimizer(Optimizer):
                  "slots": self.optim_method.slots(model.params)}
         self.optim_method.state.setdefault("epoch", 1)
         if self._step_fn is None:
-            self._step_fn = self._build_step()
+            self._step_fn = self._arm_retrace(self._build_step(), "local")
 
         it = {"data": None}
 

@@ -9,6 +9,7 @@ from typing import Iterable, List
 
 import numpy as np
 
+from bigdl_tpu.analysis.hostsync import host_pull
 from bigdl_tpu.engine import DispatchPipeline
 from bigdl_tpu.dataset.dataset import AbstractDataSet
 from bigdl_tpu.dataset.sample import Sample
@@ -64,8 +65,13 @@ class Predictor:
             # pipelined like evaluate_dataset: bounded in-flight batches
             # (unbounded dispatch would pin every output in device memory)
             outs: List[np.ndarray] = []
-            pipeline = DispatchPipeline(
-                lambda item, _nxt: outs.append(np.asarray(item[0])))
+
+            def drain(item, _nxt):
+                # one explicit device_get per batch (the same choke-point
+                # discipline as evaluate_dataset's drain)
+                outs.append(host_pull(item[0], what="predict outputs"))
+
+            pipeline = DispatchPipeline(drain)
             for batch in self._batches(dataset, batch_size):
                 pipeline.push(fwd(_to_device(batch.get_input())))
             pipeline.flush()

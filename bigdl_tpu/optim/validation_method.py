@@ -57,6 +57,8 @@ class ValidationMethod:
 
 
 def _squeeze_logits(output) -> np.ndarray:
+    # the evaluator hands metrics HOST arrays (one explicit device_get per
+    # validation step); this asarray is a free view, not a device sync
     out = np.asarray(output)
     if out.ndim == 1:
         out = out[None, :]
@@ -101,8 +103,12 @@ class Loss(ValidationMethod):
         self.criterion = criterion
 
     def apply(self, output, target) -> ValidationResult:
-        loss = float(self.criterion.apply(jnp.asarray(output),
-                                          jnp.asarray(target)))
+        # the criterion computes on device; the result comes back through
+        # the explicit choke point instead of an implicit float() sync
+        from bigdl_tpu.analysis.hostsync import host_pull
+        loss = float(host_pull(self.criterion.apply(jnp.asarray(output),
+                                                    jnp.asarray(target)),
+                               what="Loss validation metric"))
         n = np.asarray(target).reshape(-1).shape[0]
         return ValidationResult(loss * n, n, self.name)
 

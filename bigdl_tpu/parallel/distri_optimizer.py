@@ -344,7 +344,8 @@ class DistriOptimizer(Optimizer):
         self.optim_method.state.setdefault("epoch", 1)
 
         if self._step_fn is None:
-            self._step_fn = self._build_step(arp)
+            self._step_fn = self._arm_retrace(self._build_step(arp),
+                                              "shard_map")
 
         # batch dim co-shards over expert when present (tokens follow the
         # all_to_all dispatch axis); time (dim 1) over seq
@@ -507,8 +508,10 @@ class DistriOptimizer(Optimizer):
             slot_sh = self._map_over_slots(
                 lambda x, s: NamedSharding(mesh, s), carry["slots"],
                 slot_specs)
-            self._step_fn = self._build_gspmd_step(
-                out_shardings=(param_sh, slot_sh, rep, rep))
+            self._step_fn = self._arm_retrace(
+                self._build_gspmd_step(
+                    out_shardings=(param_sh, slot_sh, rep, rep)),
+                "gspmd")
 
         batch_sharding = NamedSharding(mesh, P("data"))
         local_ids = local_data_partitions(mesh)

@@ -443,7 +443,7 @@ class PipelineOptimizer(Optimizer):
             self._slot_specs = slot_per_param
         self.optim_method.state.setdefault("epoch", 1)
         if self._step_fn is None:
-            self._step_fn = self._build_step()
+            self._step_fn = self._arm_retrace(self._build_step(), "pipeline")
 
         batch_sharding = NamedSharding(
             mesh, P(self.data_axis) if self.data_axis else P())

@@ -50,6 +50,14 @@ _DEFAULTS: Dict[str, Any] = {
     "bigdl.ingest.decodedRingDepth": None, # in-flight decode window; None = 2x batch
     "bigdl.ingest.batchRingDepth": 2,      # assembled batches buffered ahead
     "bigdl.ingest.batchesInFlight": 2,     # device uploads in flight (transfer-ahead)
+    # static-analysis / sanitizer passes (bigdl_tpu/analysis): each pass is
+    # "strict" (raise), "warn" (log + count), or "off"
+    "bigdl.analysis.retrace": "warn",      # recompile sentinel on fused steps
+    "bigdl.analysis.retraceWarmupSteps": 2,  # calls treated as warmup compiles
+    "bigdl.analysis.retraceBudget": 2,     # distinct signatures allowed in warmup
+    "bigdl.analysis.hostSync": "warn",     # implicit device→host pulls in hot loop
+    "bigdl.analysis.hotLoopScope": "iteration",  # sanitize fetch+step, or "step"
+    "bigdl.analysis.contracts": "warn",    # module contract checker strictness
 }
 
 _OVERRIDES: Dict[str, Any] = {}

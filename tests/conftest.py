@@ -34,6 +34,24 @@ def _seed():
 
 
 @pytest.fixture(autouse=True)
+def _sanitizers_armed():
+    """Arm analysis passes 1-2 in STRICT mode for every tier-1 test: a
+    post-warmup retrace of any fused step raises RetraceError, and an
+    implicit device→host sync inside the optimizer hot loop raises
+    HostSyncError with its call-site.  This makes the sanitizers a
+    standing CI contract — any change that reintroduces signature drift
+    or a stray float()/np.asarray in the hot loop fails the suite, not a
+    production run three weeks later."""
+    from bigdl_tpu.utils import config
+
+    config.set_property("bigdl.analysis.retrace", "strict")
+    config.set_property("bigdl.analysis.hostSync", "strict")
+    yield
+    config.clear_property("bigdl.analysis.retrace")
+    config.clear_property("bigdl.analysis.hostSync")
+
+
+@pytest.fixture(autouse=True)
 def _hang_guard(request):
     """Per-test hard timeout without pytest-timeout (not installed in
     this image): SIGALRM fails the test at 1200 s — generous enough for

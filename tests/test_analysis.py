@@ -1,0 +1,583 @@
+"""Unit tests for the analysis/sanitizer subsystem (ISSUE 4 tentpole):
+host-sync guard, module contract checker, AST lint rules, and the
+device-scalar Metrics hot path."""
+
+import os
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.analysis import (ContractError, HostSyncError, check_model,
+                                host_pull)
+from bigdl_tpu.analysis.hostsync import (STATS, HostSyncGuard, NULL_GUARD,
+                                         allow_host_sync)
+from bigdl_tpu.analysis.lint import (Finding, lint_paths, load_allowlist,
+                                     main as lint_main)
+
+
+# ---------------------------------------------------------------------------
+# host-sync guard
+# ---------------------------------------------------------------------------
+
+class TestHostSyncGuard:
+    def test_implicit_float_raises_with_call_site(self):
+        guard = HostSyncGuard("strict")
+        x = jnp.ones(()) * 3
+        with guard.armed():
+            with pytest.raises(HostSyncError) as ei:
+                float(x)
+        msg = str(ei.value)
+        assert "__float__" in msg
+        assert "test_analysis.py" in msg          # the offending call-site
+        assert "host_pull" in msg                 # the suggested fix
+
+    def test_implicit_bool_and_int_raise(self):
+        guard = HostSyncGuard("strict")
+        x = jnp.ones(())
+        with guard.armed():
+            with pytest.raises(HostSyncError):
+                bool(x > 0)
+            with pytest.raises(HostSyncError):
+                int(x)
+
+    def test_item_and_tolist_raise(self):
+        guard = HostSyncGuard("strict")
+        x = jnp.arange(3)
+        with guard.armed():
+            with pytest.raises(HostSyncError):
+                x[0].item()
+            with pytest.raises(HostSyncError):
+                x.tolist()
+
+    def test_host_pull_is_the_permitted_choke_point(self):
+        guard = HostSyncGuard("strict")
+        x = jnp.ones((4,))
+        before = STATS.snapshot()["explicit_pulls"]
+        with guard.armed():
+            out = host_pull({"a": x, "b": x * 2}, what="test")
+        assert isinstance(out["a"], np.ndarray)
+        np.testing.assert_allclose(out["b"], 2.0)
+        assert STATS.snapshot()["explicit_pulls"] == before + 1
+
+    def test_allow_host_sync_escape_hatch(self):
+        guard = HostSyncGuard("strict")
+        x = jnp.ones(())
+        with guard.armed():
+            with allow_host_sync():
+                assert float(x) == 1.0
+
+    def test_outside_armed_region_everything_is_free(self):
+        x = jnp.ones(())
+        assert float(x) == 1.0
+        assert bool(x > 0)
+
+    def test_warn_mode_counts_instead_of_raising(self):
+        guard = HostSyncGuard("warn")
+        x = jnp.ones(())
+        before = STATS.snapshot()["implicit"]
+        with guard.armed():
+            assert float(x) == 1.0
+        assert STATS.snapshot()["implicit"] == before + 1
+
+    def test_armed_is_thread_local(self):
+        import threading
+        guard = HostSyncGuard("strict")
+        x = jnp.ones(())
+        seen = {}
+
+        def other():
+            seen["v"] = float(x)      # unguarded thread: free
+
+        with guard.armed():
+            t = threading.Thread(target=other)
+            t.start()
+            t.join()
+        assert seen["v"] == 1.0
+
+    def test_null_guard_is_free(self):
+        x = jnp.ones(())
+        with NULL_GUARD.armed():
+            assert float(x) == 1.0
+
+
+class TestHotLoopIntegration:
+    def test_training_loop_strict_clean_and_stray_float_caught(self):
+        """The fixture arms strict mode: a 3-step run must be sync-clean,
+        and a hot-loop stray float() injected via a poisoned optim method
+        must be caught with a diagnostic."""
+        from bigdl_tpu.dataset import Sample
+        from bigdl_tpu.dataset.dataset import LocalDataSet
+        from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+        from bigdl_tpu.optim import SGD
+        from bigdl_tpu.optim import trigger as triggers
+        from bigdl_tpu.optim.optimizer import LocalOptimizer
+
+        rng = np.random.RandomState(0)
+        samples = [Sample(rng.randn(4).astype(np.float32),
+                          np.array([1.0], np.float32)) for _ in range(16)]
+
+        def build(method):
+            m = (nn.Sequential().add(nn.Linear(4, 2)).add(nn.LogSoftMax()))
+            m.reset(jax.random.PRNGKey(0))
+            opt = LocalOptimizer(
+                m, LocalDataSet(samples).transform(SampleToMiniBatch(8)),
+                nn.ClassNLLCriterion())
+            opt.set_optim_method(method)
+            opt.set_end_when(triggers.max_iteration(3))
+            return opt
+
+        before = STATS.snapshot()["implicit"]
+        build(SGD(learning_rate=0.1)).optimize()
+        assert STATS.snapshot()["implicit"] == before, \
+            "the fused-step hot loop performed an implicit host sync"
+
+        class StrayFloatSGD(SGD):
+            """Deliberately pulls a device value in hyper() — the classic
+            implicit sync a refactor sneaks into the hot loop."""
+
+            def hyper(self):
+                h = super().hyper()
+                h["lr"] = float(jnp.asarray(h["lr"]) * 1)   # device→host!
+                return h
+
+        with pytest.raises(HostSyncError) as ei:
+            build(StrayFloatSGD(learning_rate=0.1)).optimize()
+        assert "__float__" in str(ei.value)
+
+    def test_fetch_path_sanitized_on_producer_thread(self):
+        """The guard's hooks are thread-local and the ACTUAL fetch runs on
+        the BatchPrefetcher producer thread — a stray float(device) in a
+        fetch transformer must still be caught in strict mode with
+        prefetching enabled (the default)."""
+        from bigdl_tpu.dataset import Sample
+        from bigdl_tpu.dataset.dataset import LocalDataSet
+        from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+        from bigdl_tpu.optim import SGD
+        from bigdl_tpu.optim import trigger as triggers
+        from bigdl_tpu.optim.optimizer import LocalOptimizer
+
+        rng = np.random.RandomState(0)
+        samples = [Sample(rng.randn(4).astype(np.float32),
+                          np.array([1.0], np.float32)) for _ in range(16)]
+        m = nn.Sequential().add(nn.Linear(4, 2)).add(nn.LogSoftMax())
+        m.reset(jax.random.PRNGKey(0))
+        opt = LocalOptimizer(
+            m, LocalDataSet(samples).transform(SampleToMiniBatch(8)),
+            nn.ClassNLLCriterion())
+        opt.set_optim_method(SGD(learning_rate=0.1))
+        opt.set_end_when(triggers.max_iteration(3))
+
+        orig = opt.dataset.data
+
+        def poisoned_data(*a, **kw):
+            for batch in orig(*a, **kw):
+                float(jnp.asarray(1.0))           # device pull in fetch
+                yield batch
+
+        opt.dataset.data = poisoned_data
+        with pytest.raises(HostSyncError):
+            opt.optimize()
+
+    def test_retrace_counter_reaches_train_summary(self):
+        """Analysis/retraces must land in TrainSummary scalars."""
+        from bigdl_tpu.dataset import Sample
+        from bigdl_tpu.dataset.dataset import LocalDataSet
+        from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+        from bigdl_tpu.optim import SGD
+        from bigdl_tpu.optim import trigger as triggers
+        from bigdl_tpu.optim.optimizer import LocalOptimizer
+
+        class Capture:
+            def __init__(self):
+                self.tags = {}
+
+            def add_scalar(self, tag, value, step):
+                self.tags.setdefault(tag, []).append(value)
+                return self
+
+            def save_parameters_due(self, state):
+                return False
+
+        rng = np.random.RandomState(0)
+        samples = [Sample(rng.randn(4).astype(np.float32),
+                          np.array([1.0], np.float32)) for _ in range(16)]
+        m = nn.Sequential().add(nn.Linear(4, 2)).add(nn.LogSoftMax())
+        m.reset(jax.random.PRNGKey(0))
+        opt = LocalOptimizer(
+            m, LocalDataSet(samples).transform(SampleToMiniBatch(8)),
+            nn.ClassNLLCriterion())
+        opt.set_optim_method(SGD(learning_rate=0.1))
+        opt.set_end_when(triggers.max_iteration(3))
+        cap = Capture()
+        opt.set_train_summary(cap)
+        opt.optimize()
+        assert cap.tags["Analysis/retraces"] == [0, 0, 0]
+        # per-run DELTA, independent of process-lifetime counter state
+        assert cap.tags["Analysis/implicit_host_syncs"] == [0, 0, 0]
+
+    def test_host_sync_scalar_independent_of_retrace_pass(self):
+        """Analysis/implicit_host_syncs must report even with the retrace
+        pass off (the two passes gate independently)."""
+        from bigdl_tpu.dataset import Sample
+        from bigdl_tpu.dataset.dataset import LocalDataSet
+        from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+        from bigdl_tpu.optim import SGD
+        from bigdl_tpu.optim import trigger as triggers
+        from bigdl_tpu.optim.optimizer import LocalOptimizer
+        from bigdl_tpu.utils import config
+
+        class Capture:
+            def __init__(self):
+                self.tags = {}
+
+            def add_scalar(self, tag, value, step):
+                self.tags.setdefault(tag, []).append(value)
+                return self
+
+            def save_parameters_due(self, state):
+                return False
+
+        config.set_property("bigdl.analysis.retrace", "off")
+        try:
+            rng = np.random.RandomState(0)
+            samples = [Sample(rng.randn(4).astype(np.float32),
+                              np.array([1.0], np.float32))
+                       for _ in range(16)]
+            m = nn.Sequential().add(nn.Linear(4, 2)).add(nn.LogSoftMax())
+            m.reset(jax.random.PRNGKey(0))
+            opt = LocalOptimizer(
+                m, LocalDataSet(samples).transform(SampleToMiniBatch(8)),
+                nn.ClassNLLCriterion())
+            opt.set_optim_method(SGD(learning_rate=0.1))
+            opt.set_end_when(triggers.max_iteration(2))
+            cap = Capture()
+            opt.set_train_summary(cap)
+            opt.optimize()
+            assert opt._retrace_sentinel is None
+            assert "Analysis/retraces" not in cap.tags
+            assert cap.tags["Analysis/implicit_host_syncs"] == [0, 0]
+        finally:
+            config.set_property("bigdl.analysis.retrace", "strict")
+
+
+# ---------------------------------------------------------------------------
+# module contract checker
+# ---------------------------------------------------------------------------
+
+def _convnet():
+    m = (nn.Sequential()
+         .add(nn.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1))
+         .add(nn.ReLU())
+         .add(nn.SpatialMaxPooling(2, 2, 2, 2))
+         .add(nn.View([8 * 4 * 4]))
+         .add(nn.Linear(8 * 16, 10)))
+    m.reset(jax.random.PRNGKey(0))
+    return m
+
+
+class TestContractChecker:
+    def test_clean_model_reports_ok(self):
+        rep = check_model(_convnet(), jnp.zeros((2, 3, 8, 8)), mode="off")
+        assert rep.ok
+        assert rep.modules_checked >= 5
+
+    def test_abstract_input_works(self):
+        """The walk runs under eval_shape — a ShapeDtypeStruct (no data at
+        all) checks the same contracts as concrete arrays."""
+        rep = check_model(_convnet(),
+                          jax.ShapeDtypeStruct((2, 3, 8, 8), jnp.float32),
+                          mode="off")
+        assert rep.ok
+
+    def test_int_input_violates_conv_dtype_contract(self):
+        rep = check_model(_convnet(), jnp.zeros((2, 3, 8, 8), jnp.int32),
+                          mode="off")
+        assert any(v.kind == "dtype" and "SpatialConvolution" in v.module
+                   for v in rep.violations)
+
+    def test_declared_ndim_violation(self):
+        m = nn.Sequential().add(nn.Linear(4, 2))
+        m[0].declare_contract(input_ndim=(2,), dtypes="float")
+        m.reset(jax.random.PRNGKey(0))
+        rep = check_model(m, jnp.zeros((2, 3, 4)), mode="off")
+        assert any(v.kind == "ndim" for v in rep.violations)
+
+    def test_promotion_drift_flagged(self):
+        """bf16 activations hitting an f32-pinning module must be reported
+        as promotion drift."""
+        class F32Pin(nn.Module):
+            layout_role = "agnostic"
+
+            def apply(self, params, input, state, training=False, rng=None):
+                return input + jnp.ones(input.shape[-1:], jnp.float32), state
+
+        m = nn.Sequential().add(F32Pin())
+        m.reset(jax.random.PRNGKey(0))
+        rep = check_model(m, jnp.zeros((2, 4), jnp.bfloat16), mode="off")
+        assert any(v.kind == "promotion" for v in rep.violations)
+
+    def test_nchw_op_inside_nhwc_region_flagged(self):
+        """Closing the loop on PR 1: a spatial module left NCHW-configured
+        inside the channels-last region is a layout violation."""
+        from bigdl_tpu.nn.layout import to_channels_last
+        m = to_channels_last(_convnet())
+        rep = check_model(m, jnp.zeros((2, 3, 8, 8)), mode="off")
+        assert rep.ok, str(rep)
+        # sabotage: re-point one interior conv back to NCHW without moving
+        # the boundary transposes
+        conv = m.find_modules(nn.SpatialConvolution)[0]
+        conv.format = "NCHW"
+        rep2 = check_model(m, jnp.zeros((2, 3, 8, 8)), mode="off")
+        assert any(v.kind == "layout" for v in rep2.violations)
+
+    def test_strict_mode_raises(self):
+        with pytest.raises(ContractError):
+            check_model(_convnet(), jnp.zeros((2, 3, 8, 8), jnp.int32),
+                        mode="strict")
+
+    def test_restores_apply_after_walk(self):
+        m = _convnet()
+        check_model(m, jnp.zeros((2, 3, 8, 8)), mode="off")
+        assert "apply" not in m[0].__dict__
+        out = m.forward(jnp.zeros((2, 3, 8, 8)))
+        assert out.shape == (2, 10)
+
+
+# ---------------------------------------------------------------------------
+# AST lint rules
+# ---------------------------------------------------------------------------
+
+_SNIPPET_SEQ = iter(range(10 ** 6))
+
+
+def _lint_snippet(tmp_path, rel, source):
+    root = tmp_path / f"snippet{next(_SNIPPET_SEQ)}"   # isolated per call
+    p = root / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(source))
+    return lint_paths([str(root)])
+
+
+class TestLintRules:
+    def test_host_sync_in_hot_path(self, tmp_path):
+        findings = _lint_snippet(tmp_path, "optim/opt.py", """
+            def drain(item, nxt):
+                loss = float(item[0])
+                n = item[0].item()
+            def harmless(x):
+                return float(x)
+        """)
+        rules = [f.rule for f in findings]
+        assert rules.count("host-sync-in-hot-path") == 2
+        assert all(f.line in (3, 4) for f in findings)
+
+    def test_host_pull_wrapped_calls_exempt(self, tmp_path):
+        findings = _lint_snippet(tmp_path, "optim/opt.py", """
+            def drain(item, nxt):
+                loss = float(host_pull(item[0], what="loss"))
+        """)
+        assert findings == []
+
+    def test_jnp_dtype_drop_in_forward_path(self, tmp_path):
+        findings = _lint_snippet(tmp_path, "nn/layer.py", """
+            import jax.numpy as jnp
+            class C:
+                def apply(self, params, input, state):
+                    pad = jnp.zeros((4,))
+                    ok = jnp.zeros((4,), jnp.float32)
+                    kw = jnp.ones((4,), dtype=input.dtype)
+                    idx = jnp.arange(4)
+                    return pad
+                def _init_params(self, rng):
+                    return {"w": jnp.zeros((4,))}
+        """)
+        assert [f.rule for f in findings] == ["jnp-dtype-drop"]
+        assert findings[0].line == 5
+
+    def test_bare_except_anywhere(self, tmp_path):
+        findings = _lint_snippet(tmp_path, "utils/x.py", """
+            def f():
+                try:
+                    g()
+                except:
+                    pass
+        """)
+        assert [f.rule for f in findings] == ["bare-except"]
+
+    def test_swallowed_exception_in_threaded_files_only(self, tmp_path):
+        src = """
+            def worker():
+                try:
+                    g()
+                except Exception:
+                    pass
+        """
+        assert [f.rule for f in _lint_snippet(tmp_path, "dataset/ingest.py",
+                                              src)] == ["swallowed-exception"]
+        assert _lint_snippet(tmp_path, "utils/other.py", src) == []
+
+    def test_lock_order_cycle_detected(self, tmp_path):
+        findings = _lint_snippet(tmp_path, "engine.py", """
+            def a(self):
+                with self._alpha_lock:
+                    with self._beta_lock:
+                        work()
+            def b(self):
+                with self._beta_lock:
+                    with self._alpha_lock:
+                        work()
+        """)
+        assert any(f.rule == "lock-order" for f in findings)
+
+    def test_consistent_lock_order_clean(self, tmp_path):
+        findings = _lint_snippet(tmp_path, "engine.py", """
+            def a(self):
+                with self._alpha_lock:
+                    with self._beta_lock:
+                        work()
+            def b(self):
+                with self._alpha_lock:
+                    with self._beta_lock:
+                        other()
+        """)
+        assert findings == []
+
+    def test_blocking_under_lock(self, tmp_path):
+        findings = _lint_snippet(tmp_path, "dataset/ingest.py", """
+            def handoff(self):
+                with self._lock:
+                    self.out_ring.put(item, stop)
+            def fine(self):
+                with self._lock:
+                    self.counts.get("x", 0)
+        """)
+        assert [f.rule for f in findings] == ["blocking-under-lock"]
+        assert findings[0].line == 4
+
+    def test_nonblocking_forms_under_lock_are_clean(self, tmp_path):
+        findings = _lint_snippet(tmp_path, "dataset/ingest.py", """
+            def handoff(self):
+                with self._lock:
+                    self.out_ring.put(item, block=False)
+                    self.in_ring.get(timeout=0)
+        """)
+        assert findings == []
+
+    def test_inline_allow_silences(self, tmp_path):
+        findings = _lint_snippet(tmp_path, "optim/opt.py", """
+            def drain(item, nxt):
+                loss = float(item[0])  # lint: allow(host-sync-in-hot-path)
+        """)
+        assert findings == []
+
+    def test_allowlist_silences_by_path_and_rule(self, tmp_path):
+        p = tmp_path / "optim" / "opt.py"
+        p.parent.mkdir(parents=True)
+        p.write_text("def drain(i, n):\n    return float(i[0])\n")
+        (found,) = lint_paths([str(tmp_path)])
+        allow = tmp_path / "allow.txt"
+        allow.write_text(f"# comment\n{found.path}:{found.rule}\n")
+        assert lint_paths([str(tmp_path)],
+                          load_allowlist(str(allow))) == []
+
+    def test_single_file_target_keeps_package_relative_paths(self, tmp_path):
+        """Linting one file must apply the same path-scoped rules and
+        produce the same Finding.path keys as linting the package — rel
+        paths anchor at the topmost package, not the cwd."""
+        pkg = tmp_path / "mypkg"
+        (pkg / "optim").mkdir(parents=True)
+        (pkg / "__init__.py").write_text("")
+        (pkg / "optim" / "__init__.py").write_text("")
+        bad = pkg / "optim" / "opt.py"
+        bad.write_text("def drain(i, n):\n    return float(i[0])\n")
+        whole = [(f.path, f.line, f.rule) for f in lint_paths([str(pkg)])]
+        single = [(f.path, f.line, f.rule) for f in lint_paths([str(bad)])]
+        assert single == whole
+        assert single == [(os.path.join("mypkg", "optim", "opt.py"), 2,
+                           "host-sync-in-hot-path")]
+
+    def test_cli_exit_codes(self, tmp_path, capsys):
+        bad = tmp_path / "optim" / "opt.py"
+        bad.parent.mkdir(parents=True)
+        bad.write_text("def drain(i, n):\n    return float(i[0])\n")
+        assert lint_main([str(tmp_path)]) == 1
+        bad.write_text("def drain(i, n):\n    return i[0]\n")
+        assert lint_main([str(tmp_path)]) == 0
+
+
+# ---------------------------------------------------------------------------
+# Metrics: device scalars accumulate without per-call float()
+# ---------------------------------------------------------------------------
+
+class TestMetricsDeviceScalars:
+    def test_add_device_scalar_defers_the_pull(self):
+        from bigdl_tpu.optim.metrics import Metrics
+        m = Metrics()
+        guard = HostSyncGuard("strict")
+        with guard.armed():
+            # adds inside the sanitized hot loop must not sync
+            for i in range(5):
+                m.add("loss", jnp.asarray(float(i)))
+        assert m.get("loss") == 10.0              # one pull, at read time
+
+    def test_mixed_host_and_device_values(self):
+        from bigdl_tpu.optim.metrics import Metrics
+        m = Metrics()
+        m.add("t", 1.0)
+        m.add("t", jnp.asarray(2.0))
+        m.add("t", 3)
+        assert m.get("t") == 6.0
+
+    def test_set_clears_pending(self):
+        from bigdl_tpu.optim.metrics import Metrics
+        m = Metrics()
+        m.add("t", jnp.asarray(5.0))
+        m.set("t", 1.0)
+        assert m.get("t") == 1.0
+
+    def test_summary_flushes(self):
+        from bigdl_tpu.optim.metrics import Metrics
+        m = Metrics()
+        m.add("x", jnp.asarray(2e9))
+        assert "x: 2.0 s" in m.summary()
+
+    def test_pending_compacts_on_device(self):
+        """A long write-only run must not park one live buffer per add:
+        past COMPACT_AT the parked scalars fold into one on-device sum
+        (an async dispatch, never a host sync)."""
+        from bigdl_tpu.optim.metrics import Metrics
+        m = Metrics()
+        guard = HostSyncGuard("strict")
+        with guard.armed():
+            for i in range(m.COMPACT_AT * 2 + 7):
+                m.add("t", jnp.asarray(1.0))
+            assert len(m._pending["t"]) < m.COMPACT_AT
+        assert m.get("t") == m.COMPACT_AT * 2 + 7
+
+
+# ---------------------------------------------------------------------------
+# config keys
+# ---------------------------------------------------------------------------
+
+class TestAnalysisConfig:
+    def test_defaults_present(self):
+        from bigdl_tpu.utils import config
+        known = config.known_properties()
+        for key in ("bigdl.analysis.retrace", "bigdl.analysis.hostSync",
+                    "bigdl.analysis.contracts", "bigdl.analysis.hotLoopScope",
+                    "bigdl.analysis.retraceWarmupSteps",
+                    "bigdl.analysis.retraceBudget"):
+            assert key in known, key
+
+    def test_unknown_mode_degrades_to_off(self):
+        from bigdl_tpu.analysis import pass_mode
+        from bigdl_tpu.utils import config
+        config.set_property("bigdl.analysis.retrace", "shout")
+        try:
+            assert pass_mode("retrace") == "off"
+        finally:
+            config.clear_property("bigdl.analysis.retrace")

@@ -1,0 +1,161 @@
+"""Recompile-sentinel coverage across all three fused training steps.
+
+The sentinel wraps the jitted step of each trainer (local, shard_map
+data-parallel, GSPMD tensor-parallel); a 3-step run must report ZERO
+post-warmup retraces and exactly one abstract signature, and a
+deliberately drifting signature must be caught with a structured
+shape/dtype diff (ISSUE 4 acceptance criteria)."""
+
+import jax
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.analysis.retrace import (RetraceError, RetraceSentinel,
+                                        abstract_signature)
+from bigdl_tpu.engine import Engine
+from bigdl_tpu.dataset import Sample
+from bigdl_tpu.dataset.dataset import LocalDataSet, ShardedDataSet
+from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+from bigdl_tpu.optim import SGD
+from bigdl_tpu.optim import trigger as triggers
+from bigdl_tpu.optim.optimizer import LocalOptimizer
+from bigdl_tpu.parallel import DistriOptimizer
+
+N_DEV = 8
+
+
+def _samples(n=32, din=4):
+    rng = np.random.RandomState(0)
+    return [Sample(rng.randn(din).astype(np.float32),
+                   np.array([1 + i % 2], np.float32)) for i in range(n)]
+
+
+def _mlp(seed=0):
+    m = (nn.Sequential().add(nn.Linear(4, 8)).add(nn.Tanh())
+         .add(nn.Linear(8, 2)).add(nn.LogSoftMax()))
+    m.reset(jax.random.PRNGKey(seed))
+    return m
+
+
+def _tp_mlp(seed=0):
+    from bigdl_tpu.parallel.tensor_parallel import (column_parallel,
+                                                    row_parallel)
+    m = (nn.Sequential().add(column_parallel(nn.Linear(4, 8))).add(nn.Tanh())
+         .add(row_parallel(nn.Linear(8, 2))).add(nn.LogSoftMax()))
+    m.reset(jax.random.PRNGKey(seed))
+    return m
+
+
+def _assert_stable(opt, expect_calls=3):
+    sent = opt._retrace_sentinel
+    assert sent is not None, "sentinel must be armed by the conftest fixture"
+    assert sent.calls == expect_calls
+    assert sent.retraces == 0, f"post-warmup retraces: {sent.last_diff}"
+    assert len(sent._seen) == 1, "the fused step must hold ONE signature"
+
+
+class TestFusedStepsStayStable:
+    def test_local_step_zero_retraces(self):
+        opt = LocalOptimizer(
+            _mlp(), LocalDataSet(_samples()).transform(SampleToMiniBatch(8)),
+            nn.ClassNLLCriterion())
+        opt.set_optim_method(SGD(learning_rate=0.1))
+        opt.set_end_when(triggers.max_iteration(3))
+        opt.optimize()
+        _assert_stable(opt)
+
+    def test_shard_map_step_zero_retraces(self):
+        ds = ShardedDataSet(_samples(), partition_num=N_DEV).transform(
+            SampleToMiniBatch(16, N_DEV))
+        opt = DistriOptimizer(_mlp(1), ds, nn.ClassNLLCriterion())
+        opt.set_optim_method(SGD(learning_rate=0.1))
+        opt.set_end_when(triggers.max_iteration(3))
+        opt.optimize()
+        _assert_stable(opt)
+
+    def test_gspmd_step_zero_retraces(self):
+        mesh = Engine.create_mesh((4, 2), ("data", "model"))
+        ds = ShardedDataSet(_samples(), partition_num=4).transform(
+            SampleToMiniBatch(16, 4))
+        opt = DistriOptimizer(_tp_mlp(2), ds, nn.ClassNLLCriterion(),
+                              mesh=mesh)
+        opt.set_optim_method(SGD(learning_rate=0.1))
+        opt.set_end_when(triggers.max_iteration(3))
+        opt.optimize()
+        _assert_stable(opt)
+
+
+class TestSignatureDriftIsCaught:
+    def test_local_drifting_batch_raises_with_diff(self):
+        """A batch whose shape drifts after warmup must raise RetraceError
+        naming the drifted leaf."""
+        opt = LocalOptimizer(
+            _mlp(3), LocalDataSet(_samples()).transform(SampleToMiniBatch(8)),
+            nn.ClassNLLCriterion())
+        opt.set_optim_method(SGD(learning_rate=0.1))
+        opt.set_end_when(triggers.max_iteration(3))
+        opt.optimize()
+        sent = opt._retrace_sentinel
+        import jax.numpy as jnp
+        drifted = jnp.zeros((12, 4))              # batch 8 -> 12
+        targets = jnp.ones((12,))
+        with pytest.raises(RetraceError) as ei:
+            opt._step_fn(opt.model.params, opt.optim_method._slots,
+                         opt.model.state, drifted, targets,
+                         opt.optim_method.hyper(), jax.random.PRNGKey(0))
+        msg = str(ei.value)
+        assert "shape" in msg and "(8, 4)" in msg and "(12, 4)" in msg
+        assert sent.retraces == 1
+
+    def test_dtype_drift_named_in_diff(self):
+        import jax.numpy as jnp
+        s = RetraceSentinel("t", mode="strict", warmup_steps=1, budget=1)
+        f = s.wrap(lambda x: x)
+        f(jnp.zeros((4,), jnp.float32))
+        with pytest.raises(RetraceError) as ei:
+            f(jnp.zeros((4,), jnp.bfloat16))
+        assert "dtype" in str(ei.value)
+        assert "float32" in str(ei.value) and "bfloat16" in str(ei.value)
+
+    def test_weak_type_drift_named_in_diff(self):
+        import jax.numpy as jnp
+        s = RetraceSentinel("t", mode="strict", warmup_steps=1, budget=1)
+        f = s.wrap(lambda x: x)
+        f(jnp.float32(1.0) * jnp.zeros(()))       # strong f32
+        with pytest.raises(RetraceError) as ei:
+            f(1.0)                                # weak python scalar
+        assert "weak-type" in str(ei.value)
+
+    def test_warn_mode_counts_without_raising(self):
+        import jax.numpy as jnp
+        s = RetraceSentinel("t", mode="warn", warmup_steps=1, budget=1)
+        f = s.wrap(lambda x: x)
+        f(jnp.zeros((2,)))
+        f(jnp.zeros((3,)))
+        f(jnp.zeros((4,)))
+        f(jnp.zeros((2,)))                        # seen before: no event
+        assert s.retraces == 2
+        assert s.calls == 4
+
+    def test_warmup_budget_tolerates_expected_compiles(self):
+        import jax.numpy as jnp
+        s = RetraceSentinel("t", mode="strict", warmup_steps=2, budget=2)
+        f = s.wrap(lambda x: x)
+        f(jnp.zeros((2,)))
+        f(jnp.zeros((3,)))                        # 2nd compile inside budget
+        assert s.retraces == 0 and s.compiles_in_warmup == 2
+
+
+class TestAbstractSignature:
+    def test_equal_signatures_for_equal_avals(self):
+        import jax.numpy as jnp
+        a = abstract_signature((jnp.zeros((2, 3)), {"lr": 0.1}))
+        b = abstract_signature((jnp.ones((2, 3)), {"lr": 0.5}))
+        assert a == b                             # values never retrace
+
+    def test_structure_change_detected(self):
+        import jax.numpy as jnp
+        a = abstract_signature(({"x": jnp.zeros(2)},))
+        b = abstract_signature(({"x": jnp.zeros(2), "y": jnp.zeros(2)},))
+        assert a != b
