@@ -20,6 +20,7 @@ __version__ = "0.1.0"
 
 from bigdl_tpu.engine import Engine
 
+from bigdl_tpu import telemetry
 from bigdl_tpu import nn
 from bigdl_tpu import optim
 from bigdl_tpu import dataset

@@ -15,6 +15,16 @@ Rules
     ``bigdl_tpu.analysis.host_pull`` (calls wrapping a ``host_pull``
     result are exempt).
 
+``raw-clock-in-hot-path``
+    In hot-loop functions (``drain`` / ``run_step`` / ``shard_step`` /
+    ``step``) anywhere outside the telemetry package, direct reads of a
+    raw timer — ``time.time()`` / ``time.time_ns()`` /
+    ``time.perf_counter[_ns]()`` / ``time.monotonic[_ns]()``.  The
+    telemetry clock (``bigdl_tpu.telemetry.clock_ns``) is the ONE hot-
+    path timer: every duration lands on a single monotonic timeline, so
+    span traces, step decomposition, and subsystem counters always
+    compare.
+
 ``jnp-dtype-drop``
     Under ``nn/``, inside forward-path functions (``apply`` and the
     recurrent forward helpers ``init_hidden`` / ``project_input`` /
@@ -68,6 +78,10 @@ HOT_SCOPES = (os.path.join("optim", ""), os.path.join("parallel", ""),
 SYNC_BUILTINS = {"float", "int", "bool"}
 SYNC_NP = {"asarray", "array", "float32", "float64"}
 SYNC_METHODS = {"item", "tolist"}
+
+RAW_CLOCKS = {"time", "time_ns", "perf_counter", "perf_counter_ns",
+              "monotonic", "monotonic_ns"}
+TELEMETRY_SCOPE = os.path.join("telemetry", "")
 
 NN_SCOPE = os.path.join("nn", "")
 FORWARD_FUNCS = {"apply", "init_hidden", "project_input", "step", "route",
@@ -177,6 +191,41 @@ def _rule_host_sync(path: str, rel: str, tree: ast.AST) -> List[Finding]:
                         f"{flagged} in hot-loop function forces an implicit "
                         "device→host sync — batch it through "
                         "bigdl_tpu.analysis.host_pull"))
+            self.generic_visit(node)
+
+    V().visit(tree)
+    return out
+
+
+def _rule_raw_clock(path: str, rel: str, tree: ast.AST) -> List[Finding]:
+    """Raw ``time.*`` reads in hot-loop functions: the telemetry clock
+    is the one timer (the telemetry package itself is the clock's home
+    and is exempt)."""
+    if TELEMETRY_SCOPE in rel:
+        return []
+    out: List[Finding] = []
+
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.hot = 0
+
+        def visit_FunctionDef(self, node):
+            is_hot = node.name in HOT_FUNCS
+            self.hot += is_hot
+            self.generic_visit(node)
+            self.hot -= is_hot
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Call(self, node):
+            if (self.hot and _qualifier(node) == "time" and
+                    _call_name(node) in RAW_CLOCKS):
+                out.append(Finding(
+                    rel, node.lineno, "raw-clock-in-hot-path",
+                    f"time.{_call_name(node)}() in a hot-loop function — "
+                    "measure with bigdl_tpu.telemetry.clock_ns (or a "
+                    "telemetry.span) so every hot-path duration shares "
+                    "one monotonic timeline"))
             self.generic_visit(node)
 
     V().visit(tree)
@@ -426,6 +475,7 @@ def lint_paths(targets: Sequence[str],
             continue
         allows = _inline_allows(source)
         file_findings = (_rule_host_sync(path, rel, tree) +
+                         _rule_raw_clock(path, rel, tree) +
                          _rule_dtype_drop(path, rel, tree) +
                          _rule_exceptions(path, rel, tree))
         if any(rel.endswith(t) for t in THREADED_FILES):

@@ -61,6 +61,7 @@ from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from bigdl_tpu import telemetry
 from bigdl_tpu.dataset.transformer import Transformer
 from bigdl_tpu.utils import config
 
@@ -230,8 +231,9 @@ class ShardedSeqFileReader:
                     t0 = time.monotonic()
                     for name, label, data in read_image_seqfile(
                             self.files[fi]):
-                        self.stats.add(items=1,
-                                       busy_s=time.monotonic() - t0)
+                        t1 = time.monotonic()
+                        self.stats.add(items=1, busy_s=t1 - t0)
+                        telemetry.add_span_s("ingest/seqfile_read", t0, t1)
                         if not rings[si].put(
                                 LabeledImageBytes(name, label, data), stop):
                             return
@@ -241,7 +243,8 @@ class ShardedSeqFileReader:
             except BaseException as e:  # surfaced on the merge side
                 rings[si].put(e, stop)
 
-        threads = [threading.Thread(target=reader, args=(si,), daemon=True)
+        threads = [threading.Thread(target=reader, args=(si,), daemon=True,
+                                    name=f"ingest-seqread{si}")
                    for si in range(n)]
         for t in threads:
             t.start()
@@ -431,7 +434,8 @@ class StreamingIngest(Transformer):
         batch_ring = _Ring(self.batch_ring_depth,
                            producer=stats["assemble"],
                            consumer=stats["consume"])
-        pool = ThreadPoolExecutor(self.decode_workers)
+        pool = ThreadPoolExecutor(self.decode_workers,
+                                  thread_name_prefix="ingest-decode")
         ch, cw = self.crop
 
         def reader() -> None:
@@ -442,8 +446,9 @@ class StreamingIngest(Transformer):
             try:
                 t0 = time.monotonic()
                 for rec in it:
-                    stats["read"].add(items=1,
-                                      busy_s=time.monotonic() - t0)
+                    t1 = time.monotonic()
+                    stats["read"].add(items=1, busy_s=t1 - t0)
+                    telemetry.add_span_s("ingest/read", t0, t1)
                     if not record_ring.put(rec, stop):
                         return
                     t0 = time.monotonic()
@@ -454,7 +459,9 @@ class StreamingIngest(Transformer):
         def timed_decode(data: bytes) -> np.ndarray:
             t0 = time.monotonic()
             img = MTLabeledBGRImgToBatch._decode(data)
-            stats["decode"].add(items=1, busy_s=time.monotonic() - t0)
+            t1 = time.monotonic()
+            stats["decode"].add(items=1, busy_s=t1 - t0)
+            telemetry.add_span_s("ingest/decode", t0, t1)
             return img
 
         def assembler() -> None:
@@ -499,8 +506,10 @@ class StreamingIngest(Transformer):
                                        self.mean, self.std,
                                        n_threads=self.assemble_threads)
                 y = np.asarray([r.label for r in recs], np.float32)
-                stats["assemble"].add(items=len(imgs),
-                                      busy_s=time.monotonic() - t0)
+                t1 = time.monotonic()
+                stats["assemble"].add(items=len(imgs), busy_s=t1 - t0)
+                telemetry.add_span_s("ingest/assemble", t0, t1,
+                                     {"batch": len(imgs)})
                 ok = batch_ring.put(
                     (MiniBatch(x, y), drawer.np.get_state()), stop)
                 imgs.clear(), recs.clear(), offsets.clear(), flips.clear()
@@ -614,3 +623,9 @@ def summary_scalars():
                 out.append((f"{prefix}/{stage}/queue_depth",
                             snap["mean_queue_depth"]))
     return out
+
+
+# the engine's scalars flow through the telemetry registry's single flush
+# path: the driver's one emission loop pulls this provider instead of
+# special-casing the ingest module (tags unchanged — Ingest/<name>/...)
+telemetry.REGISTRY.register_provider("ingest", summary_scalars)

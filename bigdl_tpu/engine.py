@@ -23,6 +23,8 @@ from typing import Optional, Sequence
 import jax
 import numpy as np
 
+from bigdl_tpu import telemetry
+
 
 class DispatchPipeline:
     """Bounded queue of in-flight device results with async device→host
@@ -140,9 +142,11 @@ class BatchPrefetcher:
             self._issued_q: "queue.Queue" = queue.Queue(
                 maxsize=self.transfer_ahead - 1)
             self._transfer_thread = threading.Thread(
-                target=self._run_transfer, daemon=True)
+                target=self._run_transfer, daemon=True,
+                name="prefetch-transfer")
             self._transfer_thread.start()
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="prefetch-fetch")
         self._thread.start()
 
     # batches at or above this size are blocked device-resident before
@@ -162,15 +166,18 @@ class BatchPrefetcher:
         leaves = jax.tree_util.tree_leaves(batch)
         total = sum(getattr(leaf, "nbytes", 0) for leaf in leaves)
         if total >= self.READY_BYTES:
-            t0 = time.monotonic_ns()
+            t0 = telemetry.clock_ns()
             for leaf in leaves:
                 if hasattr(leaf, "block_until_ready"):
                     leaf.block_until_ready()
-            self.block_ns += time.monotonic_ns() - t0
+            t1 = telemetry.clock_ns()
+            self.block_ns += t1 - t0
+            telemetry.add_span("prefetch/transfer", t0, t1,
+                               {"bytes": total})
         return batch
 
     def _fetch_once(self, block: bool = True):
-        t0 = time.monotonic_ns()
+        t0 = telemetry.clock_ns()
         if self._guard is not None:
             with self._guard.armed():
                 batch = self._fetch()
@@ -178,8 +185,10 @@ class BatchPrefetcher:
             batch = self._fetch()
         if self._on_batch is not None:
             self._on_batch(batch)
-        self.fetch_ns += time.monotonic_ns() - t0
+        t1 = telemetry.clock_ns()
+        self.fetch_ns += t1 - t0
         self.batches += 1
+        telemetry.add_span("prefetch/fetch", t0, t1)
         if block:
             self._block_ready(batch)
         return batch

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bigdl_tpu import telemetry
 from bigdl_tpu.engine import DispatchPipeline
 from bigdl_tpu.engine import to_device as _to_device
 from bigdl_tpu.dataset.dataset import AbstractDataSet, LocalDataSet, ShardedDataSet
@@ -252,6 +253,14 @@ class Optimizer:
         self._profile_n: int = 3
         #: recompile sentinel wrapped around the fused step (analysis pass 1)
         self._retrace_sentinel = None
+        #: the unwrapped jitted step (the sentinel hides .lower) — the
+        #: telemetry FLOPs estimate lowers THIS
+        self._raw_step_fn = None
+        #: fused-step FLOPs from cost_analysis (bigdl.telemetry.mfu)
+        self._step_flops: Optional[float] = None
+        self._want_step_flops = False
+        #: per-run step-time decomposition (bigdl_tpu.telemetry)
+        self._step_account = None
 
     # -- fluent setters (reference Optimizer.scala fluent API) ------------
 
@@ -445,6 +454,7 @@ class Optimizer:
         surfaced as ``Analysis/retraces`` in TrainSummary.  Host-driven
         feval methods (LBFGS) are not jitted per-step, so they pass
         through unwrapped."""
+        self._raw_step_fn = step_fn
         if getattr(self.optim_method, "requires_feval", False):
             return step_fn
         from bigdl_tpu.analysis.retrace import RetraceSentinel
@@ -454,6 +464,40 @@ class Optimizer:
             return step_fn
         self._retrace_sentinel = sentinel
         return sentinel.wrap(step_fn)
+
+    def _estimate_step_flops(self, args: Tuple) -> None:
+        """One-shot FLOPs estimate of the fused step from the lowered
+        HLO's ``cost_analysis()`` — a re-trace + lower, never a second
+        XLA compile (array args become ``ShapeDtypeStruct``s, so no
+        device data moves).  Enabled by ``bigdl.telemetry.mfu``; the
+        drain logs achieved TFLOP/s (or MFU against
+        ``bigdl.telemetry.peakTflops``) alongside the throughput line."""
+        self._want_step_flops = False
+        fn = self._raw_step_fn
+        if fn is None or not hasattr(fn, "lower"):
+            return
+        try:
+            def spec(x):
+                if hasattr(x, "shape") and hasattr(x, "dtype"):
+                    return jax.ShapeDtypeStruct(x.shape, x.dtype)
+                return x
+
+            specs = jax.tree_util.tree_map(spec, args)
+            self._step_flops = telemetry.step_flops(fn.lower(*specs))
+            if self._step_flops:
+                logger.info("Fused step cost estimate: %.3f GFLOP/step",
+                            self._step_flops / 1e9)
+        except Exception as e:  # diagnostics must never fail a train step
+            logger.debug("fused-step FLOPs estimate unavailable: %s", e)
+
+    def _probe_step_flops(self, inputs, targets, hyper, rng) -> None:
+        """One-shot driver-side FLOPs probe: trainers that can reproduce
+        their step's full argument tuple install ``_cost_args_fn``; the
+        others simply have no MFU estimate."""
+        self._want_step_flops = False
+        args_fn = getattr(self, "_cost_args_fn", None)
+        if args_fn is not None:
+            self._estimate_step_flops(args_fn(inputs, targets, hyper, rng))
 
     def _params_dead(self) -> bool:
         """True if any live model parameter buffer was donated-and-deleted
@@ -530,6 +574,39 @@ class Optimizer:
         rng_counter = state["neval"] - 1
         wall_start = time.time()
 
+        from bigdl_tpu.utils import config as _config
+
+        # -- telemetry: arm the tracer if configured, name the driver lane,
+        # and start a fresh per-run step account.  Per-run gauges from a
+        # previous optimize() in this process are dropped so a run that no
+        # longer produces them cannot re-chart stale values.
+        telemetry.maybe_arm_from_config()
+        if telemetry.tracing_enabled():
+            telemetry.name_thread("driver")
+            # per-run timeline: a second optimize() in this process must
+            # export only its own spans (rings stay registered, events
+            # and the trace epoch reset)
+            telemetry.reset_tracer()
+        telemetry.REGISTRY.drop_prefix("Telemetry/")
+        telemetry.REGISTRY.drop_prefix("Analysis/")
+        step_account = telemetry.StepAccount(
+            window=_config.get_int("bigdl.telemetry.percentileWindow", 512),
+            detector=telemetry.SlowStepDetector(
+                _config.get_float("bigdl.telemetry.slowStepFactor", 0.0),
+                warmup=_config.get_int("bigdl.telemetry.slowStepWarmup", 5),
+                cooldown=_config.get_int("bigdl.telemetry.slowStepCooldown",
+                                         50)))
+        self._step_account = step_account
+        log_every = max(1, _config.get_int("bigdl.telemetry.logEveryN", 1))
+        slow_profile_dir = _config.get_property(
+            "bigdl.telemetry.profileOnSlowStep")
+        #: one-shot jax.profiler capture requested by the slow-step detector
+        slow_req = {"due": False, "captured": False}
+        self._want_step_flops = (_config.get_bool("bigdl.telemetry.mfu",
+                                                  False)
+                                 and self._step_flops is None)
+        peak_tflops = _config.get_float("bigdl.telemetry.peakTflops", 0.0)
+
         # Dispatch pipeline: iteration i's loss is read (a blocking device
         # round-trip — expensive when the chip sits behind a network
         # tunnel) only after up to ``bigdl.pipeline.depth`` further
@@ -544,29 +621,48 @@ class Optimizer:
         # stale loss — effectively depth=1 while such a trigger is
         # installed (the user chose stop-on-loss semantics over latency
         # hiding).
-        from bigdl_tpu.utils import config as _config
         max_bad_steps = _config.get_int("bigdl.divergence.maxBadSteps", 5)
 
         from bigdl_tpu.analysis.hostsync import host_pull
 
         def drain(item, nxt):
-            loss_dev, bsz, t0, epoch, recs, neval = item
+            loss_dev, bsz, t0, epoch, recs, neval, parts = item
             # the ONE intended device→host pull of the hot loop, through
             # the explicit choke point (permitted while the guard is armed)
-            loss = float(host_pull(loss_dev, what="iteration loss"))
+            with telemetry.span("driver/host_wait"):
+                t_pull = telemetry.clock_ns()
+                loss = float(host_pull(loss_dev, what="iteration loss"))
+                pull_ns = telemetry.clock_ns() - t_pull
+            t_book = telemetry.clock_ns()
             # per-iteration wall time = interval to the NEXT dispatch (the
             # flush happens up to depth-1 dispatches later, so "now - t0"
             # would overstate it depth-fold)
-            next_t0 = nxt[2] if nxt is not None else time.time_ns()
+            next_t0 = nxt[2] if nxt is not None else telemetry.clock_ns()
             dt = max(next_t0 - t0, 1)
             self.metrics.add("computing time for each node", dt)
             state["Loss"] = loss
             throughput = bsz / max(dt / 1e9, 1e-9)
-            logger.info(
-                "[Epoch %d %d/%d][Iteration %d] Train %d in %.4f seconds. "
-                "Throughput is %.1f records/second. Loss is %.6f.",
-                epoch, recs, epoch_size, neval, bsz, dt / 1e9, throughput,
-                loss)
+            mfu_note = ""
+            if self._step_flops:
+                tflops = self._step_flops / max(dt / 1e9, 1e-9) / 1e12
+                telemetry.gauge("Telemetry/tflops", summary=True).set(tflops)
+                if peak_tflops > 0:
+                    telemetry.gauge("Telemetry/mfu", summary=True).set(
+                        tflops / peak_tflops)
+                    mfu_note = (f" MFU is "
+                                f"{100 * tflops / peak_tflops:.1f}%.")
+                else:
+                    mfu_note = f" Achieved {tflops:.3f} TFLOP/s."
+            # bigdl.telemetry.logEveryN rate-limits the per-iteration log
+            # line (default 1 = the reference protocol, unchanged); the
+            # skipped path formats nothing
+            if neval % log_every == 0:
+                logger.info(
+                    "[Epoch %d %d/%d][Iteration %d] Train %d in %.4f "
+                    "seconds. Throughput is %.1f records/second. Loss is "
+                    "%.6f.%s",
+                    epoch, recs, epoch_size, neval, bsz, dt / 1e9,
+                    throughput, loss, mfu_note)
             # divergence guard, host side: the in-step guard already kept
             # the params/slots/state carries at their pre-step values, so
             # a bad step costs one wasted iteration, not a poisoned model;
@@ -587,7 +683,39 @@ class Optimizer:
                         "restoring the latest valid snapshot")
             else:
                 state["consecutiveBadSteps"] = 0
-            self._summarize_train(loss, throughput, neval)
+            # step-time decomposition: data-wait / compute / host-pull /
+            # bookkeeping measured, the signed residual is unaccounted —
+            # the five always sum to the wall interval exactly.  The wall
+            # interval t0(i) -> t0(i+1) contains THIS iteration's dispatch
+            # and bookkeeping but the NEXT iteration's fetch, so the
+            # data-wait share comes from the next item's measured parts
+            # (a stalled fetch lands on the same interval whose wall time
+            # it inflated); the final flushed interval contains no fetch.
+            data_ns = nxt[6][0] if nxt is not None else 0.0
+            fired = step_account.account(
+                dt, data_wait=data_ns, compute=parts[1], host_pull=pull_ns,
+                bookkeeping=parts[2] + (telemetry.clock_ns() - t_book))
+            if fired:
+                telemetry.instant("driver/slow_step", iteration=neval,
+                                  step_ms=round(dt / 1e6, 3))
+                logger.warning(
+                    "Slow step at iteration %d: %.1f ms (> %.1f ms = "
+                    "k x EMA); %d anomaly window(s) this run", neval,
+                    dt / 1e6, step_account.detector.threshold() / 1e6,
+                    step_account.detector.fired)
+                if slow_profile_dir:
+                    # every process requests its own (process-local)
+                    # profiler capture — like the scheduled window, one
+                    # capture per host; only the timeline dump is a
+                    # single-writer artifact
+                    slow_req["due"] = True
+                    if is_writer_process() and telemetry.tracing_enabled():
+                        os.makedirs(str(slow_profile_dir), exist_ok=True)
+                        telemetry.export_chrome_trace(os.path.join(
+                            str(slow_profile_dir),
+                            f"slowstep_{neval}_timeline.json"))
+            with telemetry.span("driver/summary"):
+                self._summarize_train(loss, throughput, neval)
 
         pipeline = DispatchPipeline(drain)
         flush_pending = pipeline.flush
@@ -684,6 +812,23 @@ class Optimizer:
                     jax.profiler.start_trace(pdir)
                     profiling = profiled = True
                     profile_end = state["neval"] + self._profile_n
+                if (slow_req["due"] and not slow_req["captured"] and
+                        not profiling and not self._profile_dir):
+                    # on-demand capture requested by the slow-step
+                    # detector: one jax.profiler window over the next
+                    # iteration (once per run; a user-scheduled
+                    # set_trace_profile window always wins the session)
+                    slow_req["due"] = False
+                    slow_req["captured"] = True
+                    pdir = os.path.join(str(slow_profile_dir),
+                                        "slowstep_profile")
+                    if jax.process_count() > 1:
+                        pdir = os.path.join(
+                            pdir, f"process_{jax.process_index()}")
+                    self._profile_dir = pdir
+                    jax.profiler.start_trace(pdir)
+                    profiling = True
+                    profile_end = state["neval"] + 1
                 if _chaos.active():
                     # chaos harness step-level hooks: a simulated
                     # preemption raises here (the retry loop absorbs it);
@@ -692,10 +837,11 @@ class Optimizer:
                 else:
                     inject_nan = False
                 with fetch_guard.armed():
-                    t_data = time.time_ns()
-                    inputs, targets, bsz = fetch()
-                    self.metrics.add("get batch time",
-                                     time.time_ns() - t_data)
+                    with telemetry.span("driver/fetch"):
+                        t_data = telemetry.clock_ns()
+                        inputs, targets, bsz = fetch()
+                        data_wait_ns = telemetry.clock_ns() - t_data
+                    self.metrics.add("get batch time", data_wait_ns)
 
                 with hot_guard.armed():
                     self.optim_method.state["epoch"] = state["epoch"]
@@ -704,14 +850,24 @@ class Optimizer:
                            jax.random.PRNGKey(0))
                     rng_counter += 1
 
-                    t0 = time.time_ns()
-                    loss_dev = run_step(inputs, targets, hyper, rng)
+                    if self._want_step_flops:
+                        self._probe_step_flops(inputs, targets, hyper, rng)
+                    t0 = telemetry.clock_ns()
+                    with telemetry.span("driver/device_step"):
+                        loss_dev = run_step(inputs, targets, hyper, rng)
+                        dispatch_ns = telemetry.clock_ns() - t0
                     if inject_nan:
                         loss_dev = float("nan")
+                    t_book = telemetry.clock_ns()
                     self.optim_method.step_done()
+                    # decomposition parts measured at dispatch time; the
+                    # drain adds its own host-pull/bookkeeping shares when
+                    # the interval retires
+                    parts = (data_wait_ns, dispatch_ns,
+                             telemetry.clock_ns() - t_book)
                     pipeline.push(loss_dev, bsz, t0, state["epoch"],
                                   state["recordsProcessedThisEpoch"] + bsz,
-                                  state["neval"])
+                                  state["neval"], parts)
 
                 state["recordsProcessedThisEpoch"] += bsz
 
@@ -736,17 +892,21 @@ class Optimizer:
                                  lambda s: False)(state))
                 if v_due or c_due or p_due:
                     flush_pending()   # ordered log lines before validation
-                    publish()
+                    with telemetry.span("driver/publish"):
+                        publish()
                     if v_due:
-                        self._run_validation(state)
+                        with telemetry.span("driver/validation"):
+                            self._run_validation(state)
                     if c_due:
-                        self._run_checkpoint(state)
+                        with telemetry.span("driver/checkpoint"):
+                            self._run_checkpoint(state)
                     if p_due and is_writer_process():
                         # weight histograms (reference
                         # DistriOptimizer:426-456); the due-decision is
                         # shared (all processes publish), the write is not
-                        self.train_summary.save_parameters(
-                            self.model, state["neval"] - 1)
+                        with telemetry.span("driver/param_histograms"):
+                            self.train_summary.save_parameters(
+                                self.model, state["neval"] - 1)
         finally:
             # a run ending (or failing) inside the window must still close
             # the trace — an unterminated xplane capture is unreadable —
@@ -775,8 +935,49 @@ class Optimizer:
                     snap["items"], snap["throughput_per_sec"],
                     snap["busy_s"], snap["starve_s"],
                     snap["backpressure_s"])
+        # where the step time went, one line (the full series is in the
+        # Telemetry/* scalars and the telemetry.json snapshot)
+        acct = step_account.summary()
+        if acct.get("steps"):
+            logger.info(
+                "Step time decomposition over %d steps (mean %.1f ms): "
+                "data-wait %.0f%%, compute %.0f%%, host-pull %.0f%%, "
+                "bookkeeping %.0f%%, unaccounted %.0f%%; p50/p95/p99 "
+                "%.1f/%.1f/%.1f ms; %d slow step(s)",
+                acct["steps"], acct["mean_step_ms"],
+                100 * acct["data_wait_frac"], 100 * acct["compute_frac"],
+                100 * acct["host_pull_frac"],
+                100 * acct["bookkeeping_frac"],
+                100 * acct["unaccounted_frac"], acct.get("p50_ms", 0.0),
+                acct.get("p95_ms", 0.0), acct.get("p99_ms", 0.0),
+                acct["slow_steps"])
+        self._export_telemetry(step_account)
         logger.info("Training finished in %.1f s.", time.time() - wall_start)
         return state
+
+    def _export_telemetry(self, step_account) -> None:
+        """End-of-run telemetry artifacts (writer process only): the
+        Chrome trace timeline (``bigdl.telemetry.tracePath``) and the
+        registry snapshot (``bigdl.telemetry.snapshotPath`` — a directory
+        gets ``telemetry.json`` inside it)."""
+        from bigdl_tpu.utils import config as _config
+        if not is_writer_process():
+            return
+        trace_path = _config.get_property("bigdl.telemetry.tracePath")
+        if trace_path and telemetry.tracing_enabled():
+            telemetry.export_chrome_trace(str(trace_path))
+            logger.info("Telemetry timeline written to %s", trace_path)
+        snap_path = _config.get_property("bigdl.telemetry.snapshotPath")
+        if snap_path:
+            import json
+            snap_path = str(snap_path)
+            if os.path.isdir(snap_path):
+                snap_path = os.path.join(snap_path, "telemetry.json")
+            snap = telemetry.REGISTRY.snapshot()
+            snap["step_summary"] = step_account.summary()
+            with open(snap_path, "w") as f:
+                json.dump(snap, f, indent=1, sort_keys=True)
+            logger.info("Telemetry snapshot written to %s", snap_path)
 
     def _check_symmetric_config(self) -> None:
         """Multi-host guard: the publish/validation sync points contain
@@ -892,24 +1093,28 @@ class Optimizer:
         self.train_summary.add_scalar("Throughput", throughput, neval)
         self.train_summary.add_scalar(
             "LearningRate", self.optim_method.get_learning_rate(), neval)
-        # sanitizer counters: post-warmup retraces of the fused step and
-        # implicit host syncs caught in the hot loop THIS RUN — a healthy
-        # run charts both flat at zero.  Independent gates: either pass
-        # can be off while the other still reports.
+        # sanitizer counters route through the telemetry registry with
+        # their historical tags: post-warmup retraces of the fused step
+        # and implicit host syncs caught in the hot loop THIS RUN — a
+        # healthy run charts both flat at zero.  Independent gates:
+        # either pass can be off while the other still reports.
         if self._retrace_sentinel is not None:
-            self.train_summary.add_scalar(
-                "Analysis/retraces", self._retrace_sentinel.retraces, neval)
+            telemetry.gauge("Analysis/retraces", summary=True).set(
+                self._retrace_sentinel.retraces)
         if getattr(self, "_hostsync_base", None) is not None:
             from bigdl_tpu.analysis.hostsync import STATS as _hs_stats
-            self.train_summary.add_scalar(
-                "Analysis/implicit_host_syncs",
-                _hs_stats.snapshot()["implicit"] - self._hostsync_base,
-                neval)
-        # streaming-ingest stage counters (throughput / stall fraction /
-        # ring occupancy per stage) when a StreamingIngest engine feeds
-        # this run — the per-stage view that names the bottleneck stage
-        from bigdl_tpu.dataset import ingest as _ingest
-        for tag, value in _ingest.summary_scalars():
+            telemetry.gauge("Analysis/implicit_host_syncs",
+                            summary=True).set(
+                _hs_stats.snapshot()["implicit"] - self._hostsync_base)
+        # THE one emission loop: every summary-flagged registry metric
+        # (Analysis/* above, the Telemetry/* decomposition gauges) plus
+        # every registered provider (the streaming-ingest engine's
+        # per-stage Ingest/* scalars) — one naming scheme, one flush path
+        scalars = telemetry.summary_scalars()
+        acct = self._step_account
+        if acct is not None and acct.steps:
+            scalars += acct.percentile_scalars()
+        for tag, value in scalars:
             self.train_summary.add_scalar(tag, value, neval)
 
     # -- factory ----------------------------------------------------------
@@ -1066,6 +1271,12 @@ class LocalOptimizer(Optimizer):
                                    carry["mstate"], inputs, targets,
                                    hyper, rng)
             return loss
+
+        # telemetry MFU probe: the fused step's full argument tuple, for
+        # the one-shot cost_analysis lowering (bigdl.telemetry.mfu)
+        self._cost_args_fn = lambda inputs, targets, hyper, rng: (
+            carry["params"], carry["slots"], carry["mstate"], inputs,
+            targets, hyper, rng)
 
         def publish():
             self._publish(carry["params"], carry["slots"], carry["mstate"])

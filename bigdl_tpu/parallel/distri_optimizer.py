@@ -411,6 +411,12 @@ class DistriOptimizer(Optimizer):
                                    hyper, rng)
             return loss
 
+        # telemetry MFU probe (bigdl.telemetry.mfu): the fused sharded
+        # step's argument tuple for the one-shot cost_analysis lowering
+        self._cost_args_fn = lambda inputs, targets, hyper, rng: (
+            carry["flat"], carry["slots"], carry["mstate"], inputs,
+            targets, hyper, rng)
+
         def publish():
             # slots leave the device in the same per-parameter pytree format
             # every host-side consumer (checkpoint resume, OptimMethod.update,
@@ -532,6 +538,12 @@ class DistriOptimizer(Optimizer):
                                    carry["mstate"], inputs, targets,
                                    hyper, rng)
             return loss
+
+        # telemetry MFU probe (bigdl.telemetry.mfu): the GSPMD step's
+        # argument tuple for the one-shot cost_analysis lowering
+        self._cost_args_fn = lambda inputs, targets, hyper, rng: (
+            carry["params"], carry["slots"], carry["mstate"], inputs,
+            targets, hyper, rng)
 
         from bigdl_tpu.parallel.all_reduce import (gather_to_host,
                                                    replicate_tree)

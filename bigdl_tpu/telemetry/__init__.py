@@ -1,0 +1,78 @@
+"""Runtime telemetry: span tracing, step-time decomposition, metrics.
+
+The reference's observability was a throughput log line per iteration
+(``optim/DistriOptimizer.scala:293-297``); the rebuild's driver-centric
+loop needs to answer *where the step time goes* without printf
+archaeology.  Three pillars, one package:
+
+1. **Span tracer** (:mod:`~bigdl_tpu.telemetry.tracer`) —
+   ``with telemetry.span("optim/device_step"): ...`` writes to per-thread
+   ring buffers; :func:`export_chrome_trace` merges the driver hot loop,
+   every ``StreamingIngest`` stage thread, the ``BatchPrefetcher``
+   fetch/transfer threads, and the async checkpoint writer into one
+   Perfetto-loadable timeline.  Free when disarmed; allocation-light and
+   device-value-free when armed (the strict host-sync guard stays green
+   over traced runs).
+2. **Step-time decomposition** (:mod:`~bigdl_tpu.telemetry.step_stats`)
+   — every optimizer step is accounted into data-wait / compute /
+   host-pull / bookkeeping plus an explicit signed ``unaccounted``
+   residual, surfaced as ``Telemetry/*`` TrainSummary scalars with
+   rolling p50/p95/p99 latency; a slow-step detector (step > k·EMA) can
+   trigger an on-demand ``jax.profiler`` capture and a timeline dump.
+3. **Metrics registry** (:mod:`~bigdl_tpu.telemetry.metrics`) —
+   counters/gauges/histograms with labeled names, ONE summary flush path
+   (the driver's single emission loop), a per-run ``telemetry.json``
+   snapshot, and a Prometheus text dump.  The pre-existing ``Ingest/*``
+   and ``Analysis/*`` scalars route through it with unchanged tags.
+
+Configuration (``bigdl.telemetry.*`` in ``utils/config.py``); the
+knob table lives in ``docs/programming-guide/optimization.md``.
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu.telemetry.tracer import (add_span, add_span_s, arm, clock_ns,
+                                        disarm, events, export_chrome_trace,
+                                        instant, maybe_arm_from_config,
+                                        name_thread, span, tracing_enabled)
+from bigdl_tpu.telemetry.tracer import reset as reset_tracer
+from bigdl_tpu.telemetry.metrics import (Counter, Gauge, Histogram,
+                                         MetricsRegistry, REGISTRY)
+from bigdl_tpu.telemetry.step_stats import (PARTS, SlowStepDetector,
+                                            StepAccount, WindowedPercentiles,
+                                            step_flops)
+
+
+def counter(name, labels=None, summary=False, help=""):
+    """Shorthand for ``REGISTRY.counter(...)``."""
+    return REGISTRY.counter(name, labels=labels, summary=summary, help=help)
+
+
+def gauge(name, labels=None, summary=False, help=""):
+    """Shorthand for ``REGISTRY.gauge(...)``."""
+    return REGISTRY.gauge(name, labels=labels, summary=summary, help=help)
+
+
+def histogram(name, labels=None, summary=False, help="", window=512):
+    """Shorthand for ``REGISTRY.histogram(...)``."""
+    return REGISTRY.histogram(name, labels=labels, summary=summary,
+                              help=help, window=window)
+
+
+def summary_scalars():
+    """The one flush path: every chartable ``(tag, value)`` pair."""
+    return REGISTRY.summary_scalars()
+
+
+__all__ = [
+    # tracer
+    "span", "instant", "add_span", "add_span_s", "clock_ns", "arm",
+    "disarm", "tracing_enabled", "maybe_arm_from_config", "name_thread",
+    "events", "export_chrome_trace", "reset_tracer",
+    # metrics
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
+    "counter", "gauge", "histogram", "summary_scalars",
+    # step stats
+    "PARTS", "StepAccount", "WindowedPercentiles", "SlowStepDetector",
+    "step_flops",
+]

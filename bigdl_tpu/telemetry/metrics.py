@@ -1,0 +1,322 @@
+"""Metrics registry: one naming scheme, one flush path for every counter.
+
+Before this module each subsystem invented its own surface — ad-hoc
+``stats()`` dicts in the ingest engine, raw attributes on the
+prefetcher, module-global counters in ``analysis`` — and the driver loop
+grew one bespoke emission loop per subsystem.  The registry gives them a
+single home:
+
+- :class:`Counter` (monotonic), :class:`Gauge` (set-to-latest), and
+  :class:`Histogram` (count/sum/min/max plus exact percentiles over a
+  bounded sample window), each with optional ``{label: value}`` labels;
+- metrics created with ``summary=True`` are charted: the driver's ONE
+  emission loop (``Optimizer._summarize_train``) walks
+  :meth:`MetricsRegistry.summary_scalars` and writes each pair as a
+  TrainSummary scalar under its registry name — which is therefore the
+  TensorBoard tag, so the documented metric table (``docs/programming-
+  guide/visualization.md``) is the single source of naming truth;
+- subsystems whose values are snapshots of live state (the ingest
+  engine's per-stage throughput) register a *provider* callable instead
+  of pushing, and the same emission loop pulls it;
+- :meth:`snapshot` serializes everything to the per-run
+  ``telemetry.json``; :meth:`prometheus_text` renders the same data as a
+  Prometheus text-format dump for scrape-style collection.
+
+Thread-safety: one registry lock around the name table; each metric
+carries its own lock so hot-path ``inc``/``observe`` from stage threads
+never contend on the registry itself.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+import threading
+from collections import deque
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+
+def _label_key(name: str, labels: Optional[dict]) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    """Prometheus metric names cannot carry ``/``-style paths; fold every
+    illegal character to ``_`` (``Ingest/read/throughput`` →
+    ``Ingest_read_throughput``)."""
+    return _PROM_BAD.sub("_", name)
+
+
+class _Metric:
+    kind = "metric"
+
+    def __init__(self, name: str, labels: Optional[dict], summary: bool,
+                 help: str = ""):
+        self.name = name
+        self.labels = dict(labels) if labels else None
+        self.summary = summary
+        self.help = help
+        self._lock = threading.Lock()
+
+
+class Counter(_Metric):
+    """Monotonically increasing count (items decoded, slow steps, …)."""
+
+    kind = "counter"
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._value = 0.0
+
+    def inc(self, value: float = 1.0) -> None:
+        with self._lock:
+            self._value += value
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Gauge(_Metric):
+    """Set-to-latest value (ring occupancy, current decomposition ms)."""
+
+    kind = "gauge"
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, value: float = 1.0) -> None:
+        with self._lock:
+            self._value += value
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Histogram(_Metric):
+    """Streaming distribution: exact count/sum/min/max over the full
+    stream plus exact percentiles over the most recent ``window``
+    observations (the rolling-window estimator the step-latency
+    p50/p95/p99 ride on — see :class:`~bigdl_tpu.telemetry.step_stats.
+    WindowedPercentiles` for the standalone form)."""
+
+    kind = "histogram"
+
+    def __init__(self, name, labels=None, summary=False, help="",
+                 window: int = 512):
+        super().__init__(name, labels, summary, help)
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self._window: deque = deque(maxlen=max(1, int(window)))
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            self.count += 1
+            self.sum += value
+            self.min = min(self.min, value)
+            self.max = max(self.max, value)
+            self._window.append(value)
+
+    def percentile(self, q: float) -> float:
+        """Exact percentile (numpy's linear interpolation) over the
+        retained window; NaN before the first observation."""
+        import numpy as np
+        with self._lock:
+            if not self._window:
+                return float("nan")
+            return float(np.percentile(np.asarray(self._window), q))
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self.sum / self.count if self.count else 0.0
+
+    def stats(self) -> dict:
+        with self._lock:
+            if not self.count:
+                return {"count": 0, "sum": 0.0}
+            out = {"count": self.count, "sum": self.sum,
+                   "min": self.min, "max": self.max,
+                   "mean": self.sum / self.count}
+        for q in (50, 95, 99):
+            out[f"p{q}"] = self.percentile(q)
+        return out
+
+
+class MetricsRegistry:
+    """The process-wide metric table (module singleton ``REGISTRY``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: "Dict[str, _Metric]" = {}
+        self._providers: "Dict[str, Callable[[], Iterable[Tuple[str, float]]]]" = {}
+
+    # ---- creation (get-or-create, keyed on name + labels) ---------------
+
+    def _get_or_create(self, cls, name: str, labels: Optional[dict],
+                       summary: bool, help: str, **kw) -> _Metric:
+        key = _label_key(name, labels)
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = cls(name, labels=labels, summary=summary, help=help,
+                        **kw)
+                self._metrics[key] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {key!r} already registered as {m.kind}, "
+                    f"requested {cls.kind}")
+            if summary:
+                m.summary = True
+            return m
+
+    def counter(self, name: str, labels: Optional[dict] = None,
+                summary: bool = False, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, labels, summary, help)
+
+    def gauge(self, name: str, labels: Optional[dict] = None,
+              summary: bool = False, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, labels, summary, help)
+
+    def histogram(self, name: str, labels: Optional[dict] = None,
+                  summary: bool = False, help: str = "",
+                  window: int = 512) -> Histogram:
+        return self._get_or_create(Histogram, name, labels, summary, help,
+                                   window=window)
+
+    # ---- providers -------------------------------------------------------
+
+    def register_provider(
+            self, name: str,
+            fn: Callable[[], Iterable[Tuple[str, float]]]) -> None:
+        """Register a pull-mode scalar source: ``fn()`` yields
+        ``(tag, value)`` pairs when the summary loop (or a snapshot)
+        asks.  Re-registering a name replaces the provider (module
+        reloads in tests)."""
+        with self._lock:
+            self._providers[name] = fn
+
+    def unregister_provider(self, name: str) -> None:
+        with self._lock:
+            self._providers.pop(name, None)
+
+    # ---- the one flush path ---------------------------------------------
+
+    def summary_scalars(self) -> List[Tuple[str, float]]:
+        """Every chartable ``(tag, value)`` pair: summary-flagged metrics
+        (labels folded into the tag) followed by every provider's pairs.
+        THE single emission loop in the driver iterates exactly this."""
+        with self._lock:
+            metrics = list(self._metrics.items())
+            providers = list(self._providers.values())
+        out: List[Tuple[str, float]] = []
+        for key, m in metrics:
+            if m.summary:
+                out.append((key, m.value))
+        for fn in providers:
+            out.extend(fn())
+        return out
+
+    def snapshot(self) -> dict:
+        """JSON-serializable dump of the whole registry (the per-run
+        ``telemetry.json`` artifact).  Round-trips through
+        ``json.dumps``/``loads`` unchanged."""
+        with self._lock:
+            metrics = list(self._metrics.items())
+            providers = list(self._providers.items())
+        counters, gauges, histograms = {}, {}, {}
+        for key, m in metrics:
+            if isinstance(m, Histogram):
+                histograms[key] = m.stats()
+            elif isinstance(m, Counter):
+                counters[key] = m.value
+            else:
+                gauges[key] = m.value
+        provided = {}
+        for name, fn in providers:
+            provided.update({tag: float(v) for tag, v in fn()})
+        return {"counters": counters, "gauges": gauges,
+                "histograms": histograms, "provided": provided}
+
+    def write_snapshot(self, path: str) -> dict:
+        snap = self.snapshot()
+        with open(path, "w") as f:
+            json.dump(snap, f, indent=1, sort_keys=True)
+        return snap
+
+    def prometheus_text(self) -> str:
+        """The registry in Prometheus exposition text format (names
+        sanitized, labels rendered as ``{k="v"}``); histograms emit
+        ``_count``/``_sum`` plus quantile gauges."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+            providers = list(self._providers.items())
+        lines: List[str] = []
+
+        def fmt(name, labels, value):
+            if labels:
+                inner = ",".join(f'{_prom_name(k)}="{labels[k]}"'
+                                 for k in sorted(labels))
+                return f"{name}{{{inner}}} {value}"
+            return f"{name} {value}"
+
+        for m in metrics:
+            pname = _prom_name(m.name)
+            if m.help:
+                lines.append(f"# HELP {pname} {m.help}")
+            if isinstance(m, Histogram):
+                lines.append(f"# TYPE {pname} summary")
+                st = m.stats()
+                for q in (50, 95, 99):
+                    pq = st.get(f"p{q}")
+                    if pq is not None and not math.isnan(pq):
+                        labels = dict(m.labels or {})
+                        labels["quantile"] = f"0.{q}"
+                        lines.append(fmt(pname, labels, pq))
+                lines.append(fmt(f"{pname}_count", m.labels, st["count"]))
+                lines.append(fmt(f"{pname}_sum", m.labels, st["sum"]))
+            else:
+                lines.append(f"# TYPE {pname} {m.kind}")
+                lines.append(fmt(pname, m.labels, m.value))
+        for name, fn in providers:
+            for tag, v in fn():
+                lines.append(fmt(_prom_name(tag), None, float(v)))
+        return "\n".join(lines) + "\n"
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def drop_prefix(self, prefix: str) -> None:
+        """Remove every metric whose name starts with ``prefix`` — the
+        start-of-run hook that keeps one process's second training run
+        from re-emitting a previous run's ``Analysis/*``/``Telemetry/*``
+        gauges under stale values."""
+        with self._lock:
+            for key in [k for k, m in self._metrics.items()
+                        if m.name.startswith(prefix)]:
+                del self._metrics[key]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+            self._providers.clear()
+
+
+REGISTRY = MetricsRegistry()

@@ -45,6 +45,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from bigdl_tpu import telemetry
 from bigdl_tpu.visualization.crc32c import crc32c
 
 logger = logging.getLogger("bigdl_tpu")
@@ -116,12 +117,13 @@ def _capture(model, optim, neval: int) -> Dict[str, bytes]:
     pickle of the live objects could observe a torn snapshot.  Bytes are
     unambiguously detached; what moves to the writer thread is the part
     with unbounded latency — checksumming and (possibly remote) IO."""
-    return {
-        f"model.{neval}": pickle.dumps(
-            model, protocol=pickle.HIGHEST_PROTOCOL),
-        f"optimMethod.{neval}": pickle.dumps(
-            optim, protocol=pickle.HIGHEST_PROTOCOL),
-    }
+    with telemetry.span("checkpoint/capture", neval=neval):
+        return {
+            f"model.{neval}": pickle.dumps(
+                model, protocol=pickle.HIGHEST_PROTOCOL),
+            f"optimMethod.{neval}": pickle.dumps(
+                optim, protocol=pickle.HIGHEST_PROTOCOL),
+        }
 
 
 class _AsyncWriter:
@@ -198,6 +200,11 @@ class CheckpointManager:
             self._write_snapshot(blobs, neval)
 
     def _write_snapshot(self, blobs: Dict[str, bytes], neval: int) -> None:
+        with telemetry.span("checkpoint/write", neval=neval):
+            self._write_snapshot_inner(blobs, neval)
+
+    def _write_snapshot_inner(self, blobs: Dict[str, bytes],
+                              neval: int) -> None:
         from bigdl_tpu.utils import file_io
         file_io.makedirs(self.path)
         self._sweep_orphan_temps()

@@ -58,6 +58,20 @@ _DEFAULTS: Dict[str, Any] = {
     "bigdl.analysis.hostSync": "warn",     # implicit device→host pulls in hot loop
     "bigdl.analysis.hotLoopScope": "iteration",  # sanitize fetch+step, or "step"
     "bigdl.analysis.contracts": "warn",    # module contract checker strictness
+    # runtime telemetry (bigdl_tpu/telemetry): span tracer + step-time
+    # decomposition + metrics registry
+    "bigdl.telemetry.trace": False,        # arm the span tracer
+    "bigdl.telemetry.ringSize": 65536,     # per-thread span ring capacity
+    "bigdl.telemetry.tracePath": None,     # export Chrome trace JSON here at run end
+    "bigdl.telemetry.snapshotPath": None,  # write telemetry.json registry snapshot here
+    "bigdl.telemetry.logEveryN": 1,        # throughput log line every N iterations
+    "bigdl.telemetry.percentileWindow": 512,  # rolling step-latency window
+    "bigdl.telemetry.slowStepFactor": 0,   # slow step = > k x EMA; 0 disables
+    "bigdl.telemetry.slowStepWarmup": 5,   # EMA warmup steps before detection
+    "bigdl.telemetry.slowStepCooldown": 50,  # min steps between anomaly windows
+    "bigdl.telemetry.profileOnSlowStep": None,  # dir: capture jax.profiler + timeline
+    "bigdl.telemetry.mfu": False,          # estimate fused-step FLOPs -> MFU logging
+    "bigdl.telemetry.peakTflops": None,    # chip peak for MFU% (None: log TFLOP/s)
 }
 
 _OVERRIDES: Dict[str, Any] = {}

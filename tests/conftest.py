@@ -52,6 +52,22 @@ def _sanitizers_armed():
 
 
 @pytest.fixture(autouse=True)
+def _telemetry_armed():
+    """Arm the span tracer for EVERY tier-1 test: telemetry must be able
+    to ride along any training run without changing its behaviour — in
+    particular, with the strict host-sync guard above also armed, a
+    traced train proves the tracer itself introduces zero device→host
+    syncs.  Rings are small (memory stays flat across the session) and
+    dropped after each test."""
+    from bigdl_tpu import telemetry
+
+    telemetry.arm(ring_size=4096)
+    yield
+    telemetry.disarm()
+    telemetry.reset_tracer()
+
+
+@pytest.fixture(autouse=True)
 def _hang_guard(request):
     """Per-test hard timeout without pytest-timeout (not installed in
     this image): SIGALRM fails the test at 1200 s — generous enough for

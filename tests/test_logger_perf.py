@@ -1,9 +1,10 @@
-"""LoggerFilter + perf harness coverage."""
+"""LoggerFilter + perf harness + driver log hygiene coverage."""
 
 import logging
 import os
 
 from bigdl_tpu.models import perf
+from bigdl_tpu.utils import config
 from bigdl_tpu.utils.logger_filter import redirect_spark_info_logs
 
 
@@ -29,3 +30,96 @@ def test_perf_harness_distributed():
     opt = perf.main(["-m", "lenet5", "-b", "32", "-i", "3",
                      "--partitions", "8"])
     assert opt.metrics.get("computing time for each node") > 0
+
+
+class _ThroughputTap(logging.Handler):
+    """Counts emitted per-iteration throughput records; a non-trivial
+    ``emit`` makes any formatting/handling cost observable."""
+
+    def __init__(self):
+        super().__init__()
+        self.lines = []
+
+    def emit(self, record):
+        msg = record.getMessage()
+        if "Throughput is" in msg:
+            self.lines.append(msg)
+
+
+def _train_with_tap(iterations: int):
+    import jax
+
+    import bigdl_tpu.nn as nn
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.dataset import LocalDataSet, SampleToMiniBatch
+    from bigdl_tpu.dataset.datasets import synthetic_separable
+
+    samples = synthetic_separable(64, 8, n_classes=2, seed=4)
+    ds = LocalDataSet(samples).transform(SampleToMiniBatch(16))
+    model = nn.Sequential().add(nn.Linear(8, 2)).add(nn.LogSoftMax())
+    model.reset(jax.random.PRNGKey(0))
+    opt = optim.Optimizer.create(model, ds, nn.ClassNLLCriterion())
+    opt.set_optim_method(optim.SGD(learning_rate=0.1))
+    opt.set_end_when(optim.max_iteration(iterations))
+    lg = logging.getLogger("bigdl_tpu")
+    tap = _ThroughputTap()
+    level = lg.level
+    lg.addHandler(tap)
+    lg.setLevel(logging.INFO)
+    try:
+        opt.optimize()
+    finally:
+        lg.removeHandler(tap)
+        lg.setLevel(level)
+    return tap.lines
+
+
+def test_throughput_log_default_every_iteration():
+    """Default bigdl.telemetry.logEveryN=1: the reference protocol is
+    unchanged — one throughput line per iteration."""
+    assert len(_train_with_tap(6)) == 6
+
+
+def test_throughput_log_rate_limited():
+    """bigdl.telemetry.logEveryN=3 logs iterations 3 and 6 only — the
+    skipped iterations must not even reach a handler (no formatting, no
+    emission: zero per-step logging cost on the drain path)."""
+    config.set_property("bigdl.telemetry.logEveryN", 3)
+    try:
+        lines = _train_with_tap(6)
+    finally:
+        config.clear_property("bigdl.telemetry.logEveryN")
+    assert len(lines) == 2
+    assert "[Iteration 3]" in lines[0] and "[Iteration 6]" in lines[1]
+
+
+def test_rate_limited_run_keeps_loss_and_summary_series():
+    """Rate limiting is LOG hygiene only: driver state, metrics, and the
+    per-iteration summary protocol are untouched."""
+    import tempfile
+
+    import jax
+
+    import bigdl_tpu.nn as nn
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.dataset import LocalDataSet, SampleToMiniBatch
+    from bigdl_tpu.dataset.datasets import synthetic_separable
+    from bigdl_tpu.visualization import TrainSummary
+
+    config.set_property("bigdl.telemetry.logEveryN", 100)
+    try:
+        samples = synthetic_separable(64, 8, n_classes=2, seed=4)
+        ds = LocalDataSet(samples).transform(SampleToMiniBatch(16))
+        model = nn.Sequential().add(nn.Linear(8, 2)).add(nn.LogSoftMax())
+        model.reset(jax.random.PRNGKey(0))
+        opt = optim.Optimizer.create(model, ds, nn.ClassNLLCriterion())
+        opt.set_optim_method(optim.SGD(learning_rate=0.1))
+        opt.set_end_when(optim.max_iteration(5))
+        ts = TrainSummary(tempfile.mkdtemp(), "ratelimit")
+        opt.set_train_summary(ts)
+        opt.optimize()
+        assert opt.metrics.get("computing time for each node") > 0
+        assert len(ts.read_scalar("Loss")) == 5
+        assert len(ts.read_scalar("Throughput")) == 5
+    finally:
+        config.clear_property("bigdl.telemetry.logEveryN")
