@@ -1022,6 +1022,163 @@ def bench_telemetry(steps: int = 25, out_path: str = None):
     return record
 
 
+def bench_elastic(out_path: str = None):
+    """``--elastic-only``: the elastic-training leg → bench_elastic.json.
+
+    Three numbers the autoscaling story depends on, all provable on the
+    virtual CPU mesh (the leg re-runs the tests' rehearsals under a
+    clock):
+
+    - **restore + reshard latency by device-count pair** — checkpoint a
+      run on N devices, resume it on M; ``Elastic/restore_ms`` times the
+      manifest-verified load, ``Elastic/reshard_ms`` the re-partition +
+      re-placement of the ZeRO-1 slots onto the new mesh;
+    - **preemption-to-first-resumed-step** — wall time from the
+      ``Preempted`` unwind (grace snapshot committed) to a new process
+      image completing its first resumed step;
+    - **watchdog detection latency** — how far past the stall threshold
+      the open step was when the monitor fired (poll-quantized).
+    """
+    import jax
+    from bigdl_tpu import telemetry
+    import bigdl_tpu.nn as nn
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.engine import Engine
+    from bigdl_tpu.dataset import SampleToMiniBatch
+    from bigdl_tpu.dataset.dataset import ShardedDataSet
+    from bigdl_tpu.dataset.datasets import synthetic_separable
+    from bigdl_tpu.parallel import DistriOptimizer
+    from bigdl_tpu.utils import chaos, config, elastic
+
+    if len(jax.devices()) < 4:
+        raise SystemExit(
+            "--elastic-only needs a >=4-device mesh to change topology "
+            f"under (found {len(jax.devices())}). jax was initialized "
+            "before the leg could force the virtual CPU mesh — run "
+            "bench.py --elastic-only as its own invocation (XLA_FLAGS="
+            "--xla_force_host_platform_device_count=8).")
+
+    samples = synthetic_separable(256, 16, n_classes=4, seed=3)
+    config.set_property("bigdl.failure.retryTimeInterval", 0.0)
+
+    def trainer(parts, epochs, ckpt=None):
+        m = (nn.Sequential().add(nn.Linear(16, 64)).add(nn.Tanh())
+             .add(nn.Linear(64, 4)).add(nn.LogSoftMax()))
+        m.reset(jax.random.PRNGKey(11))
+        ds = ShardedDataSet(samples, parts).transform(
+            SampleToMiniBatch(256, parts))
+        mesh = Engine.create_mesh((parts,), ("data",),
+                                  devices=jax.devices()[:parts])
+        o = DistriOptimizer(m, ds, nn.ClassNLLCriterion(), mesh=mesh)
+        o.set_optim_method(optim.Adam(learning_rate=0.01))
+        o.set_end_when(optim.max_epoch(epochs))
+        if ckpt:
+            o.set_checkpoint(str(ckpt), optim.every_epoch())
+        return o
+
+    import tempfile
+
+    def gauge_value(name):
+        return telemetry.REGISTRY.snapshot()["gauges"].get(name)
+
+    # -- restore + reshard latency, by (from, to) device-count pair ------
+    n_dev = len(jax.devices())
+    pairs = [(n, m) for n, m in ((4, 2), (2, 4), (n_dev, 2), (2, n_dev))
+             if n <= n_dev and m <= n_dev and n != m]
+    pair_records = []
+    for n, m in dict.fromkeys(pairs):
+        d = tempfile.mkdtemp(prefix=f"elastic_{n}to{m}_")
+        trainer(n, 2, ckpt=d).optimize()
+        o2 = trainer(m, 3, ckpt=d)
+        t0 = time.perf_counter()
+        assert o2._restore_latest_checkpoint()
+        restore_wall_ms = (time.perf_counter() - t0) * 1e3
+        o2.optimize()          # 1 resumed epoch; reshard timed inside
+        pair_records.append({
+            "from_devices": n, "to_devices": m,
+            "restore_ms": round(gauge_value("Elastic/restore_ms"), 3),
+            "restore_wall_ms": round(restore_wall_ms, 3),
+            "reshard_ms": round(gauge_value("Elastic/reshard_ms"), 3),
+        })
+        _log(f"elastic {n}->{m}: restore "
+             f"{pair_records[-1]['restore_ms']:.1f} ms, reshard "
+             f"{pair_records[-1]['reshard_ms']:.2f} ms")
+
+    # -- preemption-to-first-resumed-step --------------------------------
+    d = tempfile.mkdtemp(prefix="elastic_preempt_")
+    config.set_property("bigdl.chaos.preemptAt", 3)
+    chaos.install()
+    o = trainer(2, 6, ckpt=d)
+    try:
+        o.optimize()
+        raise AssertionError("preemption injection did not fire")
+    except elastic.Preempted:
+        pass
+    finally:
+        chaos.uninstall()
+        config.clear_property("bigdl.chaos.preemptAt")
+    marker = elastic.read_preemption_marker(d)
+    assert marker is not None, "grace-period drain left no marker"
+    t0 = time.perf_counter()
+    o2 = trainer(2, marker["neval"] + 1, ckpt=d)   # exactly 1 resumed step
+    assert o2._restore_latest_checkpoint()
+    o2.optimize()
+    preempt_resume_ms = (time.perf_counter() - t0) * 1e3
+    preemption = {
+        "grace_snapshot_ms": round(
+            gauge_value("Elastic/preempt_snapshot_ms"), 3),
+        "to_first_resumed_step_ms": round(preempt_resume_ms, 3),
+    }
+    _log(f"elastic preemption: snapshot {preemption['grace_snapshot_ms']:.1f}"
+         f" ms, to first resumed step {preempt_resume_ms:.1f} ms")
+
+    # -- watchdog detection latency --------------------------------------
+    fired_before = telemetry.REGISTRY.counter(
+        "Elastic/watchdog_fired").value
+    config.set_property("bigdl.watchdog.stallFactor", 5.0)
+    config.set_property("bigdl.watchdog.warmupSteps", 2)
+    config.set_property("bigdl.watchdog.pollInterval", 0.05)
+    config.set_property("bigdl.chaos.stallStepAt", "6:1.0")
+    chaos.install()
+    try:
+        trainer(2, 10, ckpt=tempfile.mkdtemp(
+            prefix="elastic_wd_")).optimize()
+    finally:
+        chaos.uninstall()
+        for k in ("bigdl.watchdog.stallFactor", "bigdl.watchdog.warmupSteps",
+                  "bigdl.watchdog.pollInterval", "bigdl.chaos.stallStepAt",
+                  "bigdl.failure.retryTimeInterval"):
+            config.clear_property(k)
+    fired = telemetry.REGISTRY.counter(
+        "Elastic/watchdog_fired").value - fired_before
+    assert fired == 1, f"watchdog fired {fired} times, expected exactly 1"
+    watchdog = {
+        "fired": int(fired),
+        "detect_past_threshold_ms": round(
+            gauge_value("Elastic/watchdog_detect_ms"), 3),
+        "poll_interval_ms": 50.0,
+    }
+    _log(f"elastic watchdog: detected "
+         f"{watchdog['detect_past_threshold_ms']:.1f} ms past threshold "
+         f"(poll 50 ms)")
+
+    record = {
+        "pairs": pair_records,
+        "preemption": preemption,
+        "watchdog": watchdog,
+        "devices": n_dev,
+        "note": "CPU virtual-mesh rehearsal: restore/reshard are "
+                "host+placement costs and transfer with model size; "
+                "detection latency is poll-quantized",
+    }
+    out_path = out_path or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_elastic.json")
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=1)
+    _log(f"elastic record -> {out_path}")
+    return record
+
+
 def preflight() -> int:
     """Static preflight: lint the package (host-sync/dtype/exception/lock
     rules) and verify the native pipeline build — a broken tree or a
@@ -1075,10 +1232,32 @@ def main():
                     help="telemetry leg: tracer overhead armed vs disarmed "
                          "(<1%% of step time asserted) + a validated sample "
                          "Chrome trace -> bench_telemetry.json")
+    ap.add_argument("--elastic-only", action="store_true",
+                    help="elastic-training leg: restore+reshard latency by "
+                         "device-count pair, preemption-to-first-resumed-"
+                         "step, watchdog detection latency -> "
+                         "bench_elastic.json (runs on a virtual 8-device "
+                         "CPU mesh)")
     args = ap.parse_args()
 
     if args.lint_only:
         sys.exit(preflight())
+
+    if args.elastic_only:
+        # the leg needs a multi-device mesh to change topology under; a
+        # virtual CPU mesh (the tier-1 configuration) must be forced
+        # BEFORE jax initializes its backend
+        if "jax" not in sys.modules:
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "") +
+                " --xla_force_host_platform_device_count=8").strip()
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        rec = bench_elastic()
+        worst = max(p["restore_ms"] + p["reshard_ms"]
+                    for p in rec["pairs"])
+        print(json.dumps({"metric": "elastic_restore_reshard_ms",
+                          "value": round(worst, 2), "unit": "ms"}))
+        return
 
     if args.ingest_only:
         # no device work at all — do not even init jax's backend

@@ -57,6 +57,16 @@ Rules
     ``.join(...)`` / ``time.sleep`` / ``wait``) while holding a lock —
     the handoff rings must never be touched under a stage lock.
 
+``signal-handler-in-hot-path``
+    In hot-loop functions (the ``host-sync`` set plus the driver's
+    ``_drive`` / the watchdog ``heartbeat`` / the chaos ``on_step``
+    hooks), calls into the ``signal`` module — ``signal.signal`` /
+    ``signal.getsignal`` / ``signal.setitimer`` / masking.  Handler
+    (de)installation belongs at run scope
+    (``elastic.PreemptionHandler``): per-iteration signal syscalls cost
+    real time, and a handler swapped inside the loop can lose the one
+    SIGTERM the scheduler will ever send.
+
 Silencing: append ``# lint: allow(<rule-name>)`` to the offending line,
 or list ``<relpath>:<rule-name>`` in an allowlist file (one per line,
 ``#`` comments) — the CI gate keeps the repo allowlist empty, so every
@@ -82,6 +92,12 @@ SYNC_METHODS = {"item", "tolist"}
 RAW_CLOCKS = {"time", "time_ns", "perf_counter", "perf_counter_ns",
               "monotonic", "monotonic_ns"}
 TELEMETRY_SCOPE = os.path.join("telemetry", "")
+
+#: signal-module calls that must stay out of per-iteration code; the
+#: hot set widens to the driver loop body and the elastic hooks it calls
+SIGNAL_CALLS = {"signal", "getsignal", "setitimer", "sigwait",
+                "pthread_sigmask", "pthread_kill", "raise_signal"}
+SIGNAL_HOT_FUNCS = HOT_FUNCS | {"_drive", "heartbeat", "on_step"}
 
 NN_SCOPE = os.path.join("nn", "")
 FORWARD_FUNCS = {"apply", "init_hidden", "project_input", "step", "route",
@@ -226,6 +242,39 @@ def _rule_raw_clock(path: str, rel: str, tree: ast.AST) -> List[Finding]:
                     "measure with bigdl_tpu.telemetry.clock_ns (or a "
                     "telemetry.span) so every hot-path duration shares "
                     "one monotonic timeline"))
+            self.generic_visit(node)
+
+    V().visit(tree)
+    return out
+
+
+def _rule_signal_handler(path: str, rel: str, tree: ast.AST) -> List[Finding]:
+    """``signal.*`` management calls inside per-iteration functions:
+    handler (de)installation is run-scoped work
+    (``elastic.PreemptionHandler``), never loop work."""
+    out: List[Finding] = []
+
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.hot = 0
+
+        def visit_FunctionDef(self, node):
+            is_hot = node.name in SIGNAL_HOT_FUNCS
+            self.hot += is_hot
+            self.generic_visit(node)
+            self.hot -= is_hot
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Call(self, node):
+            if (self.hot and _qualifier(node) == "signal" and
+                    _call_name(node) in SIGNAL_CALLS):
+                out.append(Finding(
+                    rel, node.lineno, "signal-handler-in-hot-path",
+                    f"signal.{_call_name(node)}() in a hot-loop function "
+                    "— install/restore handlers at run scope "
+                    "(bigdl_tpu.utils.elastic.PreemptionHandler), not "
+                    "per iteration"))
             self.generic_visit(node)
 
     V().visit(tree)
@@ -476,6 +525,7 @@ def lint_paths(targets: Sequence[str],
         allows = _inline_allows(source)
         file_findings = (_rule_host_sync(path, rel, tree) +
                          _rule_raw_clock(path, rel, tree) +
+                         _rule_signal_handler(path, rel, tree) +
                          _rule_dtype_drop(path, rel, tree) +
                          _rule_exceptions(path, rel, tree))
         if any(rel.endswith(t) for t in THREADED_FILES):

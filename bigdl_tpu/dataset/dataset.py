@@ -20,12 +20,15 @@ full deployment; the in-process sharded form is what feeds pjit).
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Callable, Iterator, List, Optional, Sequence
 
 import numpy as np
 
 from bigdl_tpu.dataset.transformer import Transformer
 from bigdl_tpu.utils.random_generator import RandomGenerator
+
+logger = logging.getLogger("bigdl_tpu")
 
 
 class AbstractDataSet:
@@ -89,30 +92,86 @@ class LocalDataSet(AbstractDataSet):
         return it
 
 
+class _ShardView(LocalDataSet):
+    """One partition's window onto the parent :class:`ShardedDataSet`:
+    the FULL record list (a shared reference, never a copy) plus a numpy
+    slice VIEW of the parent's global shuffle index.  The parent permutes
+    that index in place, so every shard sees each epoch's new order
+    without any per-shard reshuffle."""
+
+    def __init__(self, records: Sequence[Any], index_view: np.ndarray,
+                 transformers: Optional[List[Transformer]] = None):
+        self.records = records
+        self.index = index_view
+        self.transformers: List[Transformer] = list(transformers or [])
+
+    def size(self) -> int:
+        return len(self.index)
+
+    def transform(self, transformer: Transformer) -> "_ShardView":
+        return _ShardView(self.records, self.index,
+                          self.transformers + [transformer])
+
+
 class ShardedDataSet(AbstractDataSet):
     """Partition-sharded dataset — the DistributedDataSet analog
     (reference ``CachedDistriDataSet``, ``dataset/DataSet.scala:240-314``:
-    per-partition record arrays, per-partition shuffled indexes, coalesced to
-    exactly nodeNumber partitions).
+    in-memory records + a separately shuffled index, coalesced to exactly
+    nodeNumber partitions).
 
     ``data(train=True)`` yields per-shard iterators via :meth:`shard_data`;
     the distributed optimizer zips shard streams into one global step.
 
+    **Partition-count-invariant order.**  One GLOBAL index permutation is
+    shuffled per epoch (seeded by ``(global seed, round)`` only — never by
+    partition id or count) and partition ``p`` streams the contiguous
+    slice ``index[p*per:(p+1)*per]`` of it.  Under the full-epoch-batch
+    protocol the assembled global batch is therefore the SAME record
+    sequence whatever ``partition_num`` is — the property elastic
+    training leans on: a run checkpointed on N devices and resumed on M
+    replays the identical batch stream, so trajectory parity across the
+    topology change is decided by arithmetic alone.  (With several
+    batches per epoch the per-batch *composition* still follows the
+    partition slicing — only the epoch-level order is invariant.)
+    CAVEAT: the permutation runs over the TRUNCATED count
+    ``per * partition_num``, so the invariance needs ``len(records)``
+    divisible by both partition counts — a remainder is dropped (warned
+    at construction) and makes the epoch order depend on the count.  The
+    per-shard split-with-its-own-RNG protocol this replaces made the
+    batch sequence a function of the partition count, which also made
+    any per-shard-group statistic — the MoE load-balancing loss — differ
+    between topologies.
+
     Multi-host: pass ``local_partitions`` (the data-axis partition ids this
     process's devices own — :func:`bigdl_tpu.parallel.distri_optimizer.
     local_data_partitions` computes them from the mesh) and only those
-    shards are materialized; every process constructs the SAME logical
-    dataset (same ``records`` order, same ``partition_num``) but holds just
-    its slice — the reference keeps per-partition records on the executor
-    that owns the partition (``dataset/DataSet.scala:240-314``), never the
-    whole set on one node.  ``size()``/``shuffle()`` stay globally
-    consistent (size counts all partitions; the shared shuffle seed keeps
-    shard index permutations aligned across processes).
+    shard views are constructed; every process builds the SAME logical
+    dataset (same ``records`` order, same ``partition_num``, same global
+    shuffle seed) so all processes derive the same epoch order.
+
+    **Memory.**  The global permutation can route ANY record to any
+    partition each epoch, so under it every process retains the full
+    record list for the dataset's lifetime — ``P`` hosts hold ``P`` x the
+    records a partition-local scheme would.  Jobs sized against per-host
+    memory can opt out with ``bigdl.elastic.globalShuffle=false``
+    (or ``global_shuffle=False``): shards then copy ONLY their own
+    contiguous record block (the caller's full list is droppable after
+    construction — the pre-elastic footprint) and shuffle within it,
+    pure in ``(seed, round, partition)``.  Same-topology resume parity
+    is preserved; what is given up is the partition-count-invariant
+    batch stream, i.e. an elastic N->M restore continues from exact
+    weights but not the identical batch sequence.
     """
 
     def __init__(self, records: Sequence[Any], partition_num: int,
                  transformers: Optional[List[Transformer]] = None,
-                 local_partitions: Optional[Sequence[int]] = None):
+                 local_partitions: Optional[Sequence[int]] = None,
+                 global_shuffle: Optional[bool] = None):
+        if global_shuffle is None:
+            from bigdl_tpu.utils import config
+            global_shuffle = config.get_bool(
+                "bigdl.elastic.globalShuffle", True)
+        self.global_shuffle = bool(global_shuffle)
         self.partition_num = partition_num
         n = len(records)
         if n < partition_num:
@@ -125,17 +184,42 @@ class ShardedDataSet(AbstractDataSet):
             raise ValueError(
                 f"local_partitions {self.local_partitions} must be a "
                 f"non-empty subset of range({partition_num})")
-        # round-robin assignment keeps shard sizes within 1 of each other,
-        # then truncate to equal size (static shapes for XLA); the
+        # truncate to equal shard size (static shapes for XLA); the
         # remainder count is recorded so evaluation paths can surface it
         self._per = n // partition_num
         self.dropped_records = n - self._per * partition_num
+        if self.global_shuffle and self.dropped_records:
+            # the permutation runs over per*partition_num records, so a
+            # truncated remainder makes the epoch order (and size) a
+            # function of the partition count after all — elastic N->M
+            # replay parity needs len(records) divisible by BOTH counts
+            logger.warning(
+                "ShardedDataSet drops %d remainder record(s) at "
+                "partition_num=%d: the epoch permutation is over the "
+                "truncated count, so the batch stream is NOT "
+                "partition-count-invariant across an elastic topology "
+                "change (weights still restore exactly; the replayed "
+                "batch sequence differs)", self.dropped_records,
+                partition_num)
         self._shuffle_round = [0]      # shared across transform() views
         self.shards: dict = {}
-        for p in self.local_partitions:
-            recs = [records[i] for i in range(p, self._per * partition_num,
-                                              partition_num)]
-            self.shards[p] = LocalDataSet(recs, transformers)
+        if self.global_shuffle:
+            self._records = list(records)
+            #: the ONE global epoch permutation; shards hold slice views
+            self.index = np.arange(self._per * partition_num)
+            for p in self.local_partitions:
+                view = self.index[p * self._per:(p + 1) * self._per]
+                self.shards[p] = _ShardView(self._records, view,
+                                            transformers)
+        else:
+            # partition-local: shard p copies records[p*per:(p+1)*per]
+            # only — non-local records are not retained on this process
+            self._records = None
+            self.index = None
+            for p in self.local_partitions:
+                block = list(records[p * self._per:(p + 1) * self._per])
+                self.shards[p] = _ShardView(block, np.arange(self._per),
+                                            transformers)
 
     def size(self) -> int:
         """GLOBAL record count (all partitions, held locally or not) — the
@@ -143,25 +227,55 @@ class ShardedDataSet(AbstractDataSet):
         return self._per * self.partition_num
 
     def shuffle(self) -> None:
-        """Per-shard permutations seeded by (global seed, round, partition
-        id) — independent of which process holds the shard or how many
-        shards are local, so every multi-host process derives the SAME
-        epoch order (the reference keeps per-partition RNGs on the
-        executors for the same reason, ``dataset/DataSet.scala:262``)."""
+        """Permute the GLOBAL index in place, as a PURE function of
+        ``(global seed, round)`` — each round's permutation regenerates
+        from the identity order, never by composing onto the previous
+        round's.  Three consumers lean on that purity: partition count
+        independence (any topology derives the same epoch order),
+        multi-host alignment (every process derives it — the reference
+        keeps aligned per-partition RNGs for this,
+        ``dataset/DataSet.scala:262``), and elastic resume
+        (:meth:`set_shuffle_round` fast-forwards a fresh dataset to the
+        interrupted run's round, replaying the exact epoch orders an
+        uninterrupted run would have drawn)."""
         base = RandomGenerator.RNG().get_seed()
         self._shuffle_round[0] += 1
         rnd = self._shuffle_round[0]
-        for p, s in self.shards.items():
-            seed = (base + 0x9E3779B1 * rnd + 7919 * p) % (2 ** 32)
-            s.shuffle(np.random.RandomState(seed))
+        if not self.global_shuffle:
+            # partition-local mode: each shard permutes its own block,
+            # pure in (seed, round, partition) — same-topology replay
+            # still works, the cross-topology invariance does not apply
+            for p, shard in self.shards.items():
+                seed = (base + 0x9E3779B1 * rnd +
+                        0x85EBCA77 * (p + 1)) % (2 ** 32)
+                idx = np.arange(len(shard.index))
+                np.random.RandomState(seed).shuffle(idx)
+                shard.index[:] = idx
+            return
+        seed = (base + 0x9E3779B1 * rnd) % (2 ** 32)
+        idx = np.arange(len(self.index))
+        np.random.RandomState(seed).shuffle(idx)
+        # in-place assignment: shard slice views track the same buffer
+        self.index[:] = idx
+
+    def set_shuffle_round(self, round_: int) -> None:
+        """Fast-forward (or rewind) the shuffle round counter: a resumed
+        run sets ``epoch - 1`` before its first ``shuffle()`` so epoch E
+        trains on the SAME permutation the interrupted run drew for
+        epoch E — the last piece of cross-restart batch-stream parity
+        (shuffles are pure in ``(seed, round)``, see :meth:`shuffle`)."""
+        self._shuffle_round[0] = int(round_)
 
     def transform(self, transformer: Transformer) -> "ShardedDataSet":
         ds = ShardedDataSet.__new__(ShardedDataSet)
+        ds.global_shuffle = self.global_shuffle
         ds.partition_num = self.partition_num
         ds.local_partitions = self.local_partitions
         ds._per = self._per
         ds.dropped_records = self.dropped_records
         ds._shuffle_round = self._shuffle_round
+        ds._records = self._records
+        ds.index = self.index
         ds.shards = {p: s.transform(transformer)
                      for p, s in self.shards.items()}
         return ds

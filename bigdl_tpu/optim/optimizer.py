@@ -206,8 +206,9 @@ class Checkpoint:
                                          overwrite=isOverwrite)
         self.manager.TEMP_SWEEP_AGE_S = self.TEMP_SWEEP_AGE_S
 
-    def save(self, model: Module, optim: OptimMethod, neval: int) -> None:
-        self.manager.save(model, optim, neval)
+    def save(self, model: Module, optim: OptimMethod, neval: int,
+             topology=None) -> None:
+        self.manager.save(model, optim, neval, topology=topology)
 
     def latest(self) -> Optional[Tuple[str, str, int]]:
         """Newest snapshot that is a complete pair, committed, and
@@ -374,66 +375,27 @@ class Optimizer:
         attempt's high-water mark), so a long healthy run is never
         killed by unrelated failures hours apart, while a deterministic
         failure that replays the same stretch after every rollback still
-        exhausts the budget."""
-        from bigdl_tpu.utils import config
+        exhausts the budget.
+
+        Failure taxonomy (``utils/elastic.py``): *divergence* and a
+        watchdog-aborted hung step restore-and-retry here; *preemption*
+        (:class:`~bigdl_tpu.utils.elastic.Preempted` — the driver already
+        drained and published) commits a final verified snapshot plus a
+        resumable marker within ``bigdl.elastic.gracePeriod`` and
+        re-raises: the scheduler said leave, not rewind."""
+        from bigdl_tpu.utils import config, elastic
         retry_times = config.get_int("bigdl.failure.retryTimes", 5)
         base = config.get_float("bigdl.failure.retryTimeInterval", 120.0)
         cap = config.get_float("bigdl.failure.maxRetryInterval", 900.0)
-        attempt = 0
-        high_water = None   # furthest evalCounter any attempt reached
+        # a fresh optimize() starts clean: a preemption flag left over
+        # from a previous run in this process (or a marker from the
+        # preempted lifetime we are resuming) must not instantly re-drain
+        elastic.clear_preemption()
+        if self.checkpoint is not None and is_writer_process():
+            elastic.clear_preemption_marker(self.checkpoint.path)
         try:
-            while True:
-                try:
-                    result = self._optimize()
-                except (ValueError, TypeError, KeyboardInterrupt):
-                    # reference: IllegalArgumentException aborts immediately
-                    raise
-                except Exception as e:
-                    cur = self.optim_method.state.get("evalCounter", 0)
-                    if (not isinstance(e, DivergenceError) and
-                            high_water is not None and cur > high_water):
-                        # NEW ground — training got further than any
-                        # previous attempt, so this is a fresh fault, not
-                        # the same one looping (reference retryNum reset
-                        # on state-version advance, :772-776).  The
-                        # baseline must be the high-water mark across
-                        # attempts: replayed ground after a rollback is
-                        # not progress, or a deterministic failure pinned
-                        # one step past the newest snapshot would reset
-                        # the budget every cycle and retry forever.
-                        # Divergence NEVER resets the budget: guard-
-                        # skipped iterations still advance the counters
-                        # (frozen params, moving evalCounter), so a
-                        # persistently-NaN pipeline would otherwise creep
-                        # the high-water mark every restore cycle and
-                        # loop unbounded.
-                        attempt = 0
-                    high_water = cur if high_water is None else max(
-                        high_water, cur)
-                    attempt += 1
-                    if attempt >= retry_times:
-                        raise
-                    restored = self._restore_latest_checkpoint()
-                    if not restored and self._params_dead():
-                        # the jitted step donates its carries: without a
-                        # snapshot to reload, the in-memory params are gone
-                        # — retrying would fail on deleted buffers, so
-                        # surface the original
-                        raise
-                    interval = _retry_backoff(attempt, base, cap)
-                    logger.exception(
-                        "Training failed (attempt %d/%d); %s and retrying "
-                        "in %.1fs", attempt, retry_times,
-                        "restored latest valid checkpoint" if restored else
-                        "resuming from last published state", interval)
-                    _sleep(interval)
-                    continue
-                # clean exit: surface any deferred async-writer error
-                # BEFORE reporting success — a "finished" run whose last
-                # snapshot silently failed to land is a lie
-                if self.checkpoint is not None:
-                    self.checkpoint.join()
-                return result
+            with elastic.PreemptionHandler():
+                return self._optimize_with_retry(retry_times, base, cap)
         except BaseException:
             # already unwinding: drain the writer but never let a deferred
             # write error mask the original failure
@@ -443,6 +405,159 @@ class Optimizer:
                 except Exception:  # pragma: no cover - defensive
                     pass
             raise
+
+    def _optimize_with_retry(self, retry_times, base, cap) -> Module:
+        from bigdl_tpu.utils import elastic
+        attempt = 0
+        high_water = None   # furthest evalCounter any attempt reached
+        while True:
+            try:
+                result = self._optimize()
+            except (ValueError, TypeError, KeyboardInterrupt):
+                # reference: IllegalArgumentException aborts immediately
+                raise
+            except elastic.Preempted:
+                # the driver drained and published before raising; commit
+                # the grace-period snapshot and leave — preemption is an
+                # eviction, not a fault, so no retry and no restore
+                self._commit_preemption_snapshot()
+                raise
+            except Exception as e:
+                cur = self.optim_method.state.get("evalCounter", 0)
+                if (not isinstance(e, DivergenceError) and
+                        high_water is not None and cur > high_water):
+                    # NEW ground — training got further than any
+                    # previous attempt, so this is a fresh fault, not
+                    # the same one looping (reference retryNum reset
+                    # on state-version advance, :772-776).  The
+                    # baseline must be the high-water mark across
+                    # attempts: replayed ground after a rollback is
+                    # not progress, or a deterministic failure pinned
+                    # one step past the newest snapshot would reset
+                    # the budget every cycle and retry forever.
+                    # Divergence NEVER resets the budget: guard-
+                    # skipped iterations still advance the counters
+                    # (frozen params, moving evalCounter), so a
+                    # persistently-NaN pipeline would otherwise creep
+                    # the high-water mark every restore cycle and
+                    # loop unbounded.
+                    attempt = 0
+                high_water = cur if high_water is None else max(
+                    high_water, cur)
+                attempt += 1
+                if attempt >= retry_times:
+                    raise
+                restored = self._restore_latest_checkpoint()
+                if not restored and self._params_dead():
+                    # the jitted step donates its carries: without a
+                    # snapshot to reload, the in-memory params are gone
+                    # — retrying would fail on deleted buffers, so
+                    # surface the original
+                    raise
+                interval = _retry_backoff(attempt, base, cap)
+                logger.exception(
+                    "Training failed (attempt %d/%d); %s and retrying "
+                    "in %.1fs", attempt, retry_times,
+                    "restored latest valid checkpoint" if restored else
+                    "resuming from last published state", interval)
+                _sleep(interval)
+                continue
+            # clean exit: surface any deferred async-writer error
+            # BEFORE reporting success — a "finished" run whose last
+            # snapshot silently failed to land is a lie
+            if self.checkpoint is not None:
+                self.checkpoint.join()
+            return result
+
+    def _commit_preemption_snapshot(self) -> None:
+        """The grace-period exit: the driver already flushed its dispatch
+        pipeline and published the carries before raising ``Preempted``,
+        so the live model/optim shells hold the newest weights — commit
+        them as a final verified snapshot, drain the async writer, and
+        drop the resumable marker.  Multi-host note: preemption unwinds
+        every rank (the scheduler signals the whole slice); only the
+        writer process touches the store, and no barrier is added here —
+        peers may already be dying, and a barrier against the dead hangs
+        the grace window."""
+        from bigdl_tpu.utils import elastic
+        if self.checkpoint is None:
+            logger.warning(
+                "Preempted with no checkpoint configured — state of this "
+                "run is lost (set_checkpoint enables the grace-period "
+                "snapshot)")
+            return
+        # the grace window opened when preemption was REQUESTED: the
+        # drain the driver already ran (pipeline flush + publish) spent
+        # part of it, and the overshoot report must say so
+        opened = elastic.preemption_requested_at()
+        deadline = ((opened if opened is not None else time.monotonic())
+                    + elastic.grace_period())
+        neval = self.optim_method.state.get("evalCounter", 0)
+        committed = True
+        if is_writer_process():
+            with elastic.timed("preempt_snapshot"):
+                # a failed write (sync save raising, or an async write
+                # surfacing at join) must neither drop the marker — a
+                # marker naming a snapshot that never landed would turn
+                # a botched drain into a trusted orderly preemption —
+                # nor replace the Preempted unwinding this frame: the
+                # run IS preempted either way, resume falls back to the
+                # newest earlier valid snapshot
+                try:
+                    self.checkpoint.save(self.model, self.optim_method,
+                                         neval,
+                                         topology=self._topology_meta())
+                    # the marker must only land AFTER the snapshot is
+                    # committed
+                    self.checkpoint.join()
+                except Exception:
+                    committed = False
+                    logger.exception(
+                        "Grace-period snapshot %d failed to commit — "
+                        "NOT writing the preemption marker; resume will "
+                        "fall back to the newest earlier valid snapshot",
+                        neval)
+            if committed:
+                elastic.write_preemption_marker(self.checkpoint.path, neval)
+        overshoot = time.monotonic() - deadline
+        status = ("snapshot %d is committed" % neval if committed else
+                  "snapshot %d FAILED to commit" % neval)
+        if overshoot > 0:
+            logger.warning(
+                "Preemption drain exceeded bigdl.elastic.gracePeriod by "
+                "%.1fs — the scheduler may have killed peers already; "
+                "%s", overshoot, status)
+        else:
+            logger.info(
+                "Preemption drain complete: %s with %.1fs of the grace "
+                "period to spare", status, -overshoot)
+
+    def _topology_meta(self) -> Optional[Dict[str, Any]]:
+        """The saving topology recorded in snapshot manifests
+        (``elastic.describe_topology``); distributed trainers override
+        with their mesh so restores onto a different device count can
+        reshard — the local trainer has no mesh to record."""
+        from bigdl_tpu.utils import elastic
+        return elastic.describe_topology(step="local")
+
+    def _sync_dataset_epoch(self) -> None:
+        """Cross-restart batch-stream parity, part 2: a RESUMED run
+        fast-forwards the dataset's shuffle round to ``epoch - 1`` so
+        its first ``reset_epoch`` draws epoch E's permutation — the one
+        the interrupted run trained (and an uninterrupted run would
+        train), not round 1's.  ``ShardedDataSet`` shuffles are pure in
+        ``(seed, round)`` which makes the replay exact; ``LocalDataSet``
+        draws from the stateful thread-local generator and has no round
+        protocol (no-op here) — bit-exact resume parity is the sharded
+        dataset's contract."""
+        epoch = self.optim_method.state.get("epoch", 1)
+        sync = getattr(self.dataset, "set_shuffle_round", None)
+        if sync is not None:
+            # unconditional, epoch 1 included: an in-process retry that
+            # restores into epoch 1 reuses a dataset whose round already
+            # advanced — without the rewind the replayed epoch would
+            # draw round 2's permutation
+            sync(epoch - 1)
 
     def _optimize(self) -> Module:
         raise NotImplementedError
@@ -514,7 +629,16 @@ class Optimizer:
         snapshots are skipped, and a snapshot that fails to deserialize
         falls back to the next-older one.  Returns False when there is
         nothing to restore (no checkpoint configured, or no valid
-        snapshot written yet)."""
+        snapshot written yet).
+
+        Topology-elastic: the manager compares the snapshot's recorded
+        saving topology against this trainer's (``_topology_meta``) —
+        same topology restores as always; a changed one either reshards
+        (``bigdl.elastic.reshardOnRestore``: the canonical host trees
+        restored here are re-partitioned for the new mesh when the
+        trainer next places its carries) or raises a structured
+        ``TopologyMismatchError``.  The whole restore is timed into
+        ``Elastic/restore_ms``."""
         if self.checkpoint is None:
             return False
         # drain the async writer first: an in-flight snapshot must either
@@ -534,18 +658,47 @@ class Optimizer:
             # to every rank by _run_checkpoint before anyone raises.
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices("bigdl_restore_scan")
-        loaded = self.checkpoint.manager.load_latest()
-        if loaded is None:
-            return False
-        loaded_model, loaded_optim, n = loaded
-        self.model.params = loaded_model.params
-        self.model.state = loaded_model.state
-        if isinstance(self.model, Container):
-            self.model._adopt()
-        self.optim_method.state = loaded_optim.state
-        self.optim_method.set_slots(loaded_optim._slots)
+        from bigdl_tpu.utils import elastic
+        with elastic.timed("restore") as timer:
+            loaded = self.checkpoint.manager.load_latest(
+                expected_topology=self._topology_meta())
+            if loaded is None:
+                # nothing restorable: the empty directory scan is not a
+                # restore — don't report its duration as one
+                timer.cancel()
+                return False
+            loaded_model, loaded_optim, n = loaded
+            self.model.params = loaded_model.params
+            self.model.state = loaded_model.state
+            if isinstance(self.model, Container):
+                self.model._adopt()
+            self.optim_method.state = loaded_optim.state
+            self.optim_method.set_slots(loaded_optim._slots)
+        # consumed (and cleared) by the trainers' slot-placement blocks
+        # via _consume_elastic_resumed — only a restore that actually
+        # crossed a topology change is a reshard; a same-topology retry
+        # restore re-places onto the same mesh and must not be timed
+        # (or barriered) as one, keeping the gauge consistent with the
+        # Elastic/reshards counter
+        self._elastic_resumed = (
+            self.checkpoint.manager.last_restore_mode == "reshard")
         logger.info("Restored snapshot model.%d / optimMethod.%d", n, n)
         return True
+
+    def _consume_elastic_resumed(self) -> bool:
+        """True when the live optimizer slots came from a checkpoint
+        restore that CROSSED a topology change
+        (``_restore_latest_checkpoint`` with ``last_restore_mode ==
+        "reshard"``) — the slot placement that follows is a
+        topology-elastic reshard worth timing (``elastic.place_slots``).
+        A same-topology restore or a second in-process ``optimize()``
+        re-placing live slots is not one, and must neither overwrite
+        ``Elastic/reshard_ms`` nor pay a startup barrier.  Clears the
+        flag: one placement consumes one restore."""
+        resumed = (self.optim_method._slots is not None and
+                   getattr(self, "_elastic_resumed", False))
+        self._elastic_resumed = False
+        return resumed
 
     # -- shared driver loop (used by Local and Distri trainers) -----------
 
@@ -721,6 +874,18 @@ class Optimizer:
         flush_pending = pipeline.flush
         end_reads_loss = getattr(self.end_when, "reads_loss", False)
 
+        # hung-step watchdog (bigdl.watchdog.stallFactor): a monitor
+        # thread fed one heartbeat per iteration; a step whose OPEN
+        # interval exceeds k x the completed-step EMA dumps the telemetry
+        # timeline and aborts this thread with HungStepError so the retry
+        # loop restores — instead of the job hanging forever.  Legitimate
+        # long phases (publish/validation/checkpoint) run under paused().
+        from contextlib import nullcontext
+        from bigdl_tpu.utils import elastic as _elastic
+        watchdog = _elastic.HungStepWatchdog.from_config()
+        wd_pause = (watchdog.paused if watchdog is not None
+                    else nullcontext)
+
         def should_end():
             if end_reads_loss:
                 flush_pending()
@@ -799,6 +964,12 @@ class Optimizer:
                 # another window call set_trace_profile again
                 self._profile_dir = None
 
+        # started HERE, not at construction: everything between would-be
+        # start and this try can raise, and only the finally below joins
+        # the monitor — a retried setup failure must not leak a polling
+        # thread per attempt
+        if watchdog is not None:
+            watchdog.start()
         try:
             while not should_end():
                 # >= not ==: a run resumed past the start iteration still
@@ -829,13 +1000,39 @@ class Optimizer:
                     jax.profiler.start_trace(pdir)
                     profiling = True
                     profile_end = state["neval"] + 1
+                if watchdog is not None:
+                    watchdog.heartbeat()
                 if _chaos.active():
-                    # chaos harness step-level hooks: a simulated
-                    # preemption raises here (the retry loop absorbs it);
+                    # chaos harness step-level hooks: a simulated step
+                    # failure raises here (the retry loop absorbs it), a
+                    # preemption injection sets the elastic flag checked
+                    # below, a stall blocks to exercise the watchdog, and
                     # a nan-loss injection flags this iteration's loss
                     inject_nan = _chaos.on_step(state["neval"])
                 else:
                     inject_nan = False
+                if _elastic.preemption_requested():
+                    # graceful drain (SIGTERM/SIGINT via PreemptionHandler,
+                    # or bigdl.chaos.preemptAt): finish the in-flight
+                    # dispatches, publish the carries so the shells hold
+                    # the newest weights, and unwind as Preempted — the
+                    # retry loop commits the grace-period snapshot +
+                    # resumable marker and exits instead of retrying.
+                    # The counter is bumped HERE, not in the signal
+                    # handler (registry locks are not signal-safe); the
+                    # drain runs watchdog-paused — a long publish during
+                    # the grace window is not a hung step.
+                    telemetry.counter(
+                        "Elastic/preemptions",
+                        help="graceful-shutdown drains observed").inc()
+                    with wd_pause():
+                        flush_pending()
+                        with telemetry.span("driver/publish"):
+                            publish()
+                    raise _elastic.Preempted(
+                        f"preemption requested "
+                        f"({_elastic.preemption_reason()}) — drained and "
+                        f"published at iteration {state['neval']}")
                 with fetch_guard.armed():
                     with telemetry.span("driver/fetch"):
                         t_data = telemetry.clock_ns()
@@ -891,30 +1088,42 @@ class Optimizer:
                          getattr(self.train_summary, "save_parameters_due",
                                  lambda s: False)(state))
                 if v_due or c_due or p_due:
-                    flush_pending()   # ordered log lines before validation
-                    with telemetry.span("driver/publish"):
-                        publish()
-                    if v_due:
-                        with telemetry.span("driver/validation"):
-                            self._run_validation(state)
-                    if c_due:
-                        with telemetry.span("driver/checkpoint"):
-                            self._run_checkpoint(state)
-                    if p_due and is_writer_process():
-                        # weight histograms (reference
-                        # DistriOptimizer:426-456); the due-decision is
-                        # shared (all processes publish), the write is not
-                        with telemetry.span("driver/param_histograms"):
-                            self.train_summary.save_parameters(
-                                self.model, state["neval"] - 1)
+                    # a checkpoint or validation pass can legitimately
+                    # dwarf a training step — not a stall
+                    with wd_pause():
+                        flush_pending()   # ordered log lines pre-validation
+                        with telemetry.span("driver/publish"):
+                            publish()
+                        if v_due:
+                            with telemetry.span("driver/validation"):
+                                self._run_validation(state)
+                        if c_due:
+                            with telemetry.span("driver/checkpoint"):
+                                self._run_checkpoint(state)
+                        if p_due and is_writer_process():
+                            # weight histograms (reference
+                            # DistriOptimizer:426-456); the due-decision is
+                            # shared (all processes publish), the write is
+                            # not
+                            with telemetry.span("driver/param_histograms"):
+                                self.train_summary.save_parameters(
+                                    self.model, state["neval"] - 1)
         finally:
-            # a run ending (or failing) inside the window must still close
-            # the trace — an unterminated xplane capture is unreadable —
-            # and the producer thread must stop even if closing re-raises
+            # the watchdog goes down FIRST: stop_profile()'s flush can
+            # block for several EMAs of queued dispatches, and an armed
+            # monitor would read that (or the post-loop flush/publish) as
+            # a hung step and abort a COMPLETING run into a pointless
+            # restore-and-retrain.  The trace must still close even if
+            # the flush re-raises — an unterminated xplane capture is
+            # unreadable — and the producer thread must stop regardless.
             try:
-                stop_profile()
+                if watchdog is not None:
+                    watchdog.stop()
             finally:
-                fetch.stop()
+                try:
+                    stop_profile()
+                finally:
+                    fetch.stop()
 
         flush_pending()
         publish()
@@ -1062,7 +1271,8 @@ class Optimizer:
         if is_writer_process():
             try:
                 self.checkpoint.save(self.model, self.optim_method,
-                                     state["neval"] - 1)
+                                     state["neval"] - 1,
+                                     topology=self._topology_meta())
             except BaseException as e:  # noqa: BLE001 — re-raised below
                 err = e
         if jax.process_count() > 1:
@@ -1281,6 +1491,7 @@ class LocalOptimizer(Optimizer):
         def publish():
             self._publish(carry["params"], carry["slots"], carry["mstate"])
 
+        self._sync_dataset_epoch()
         reset_epoch()
         self._drive(fetch_batch, run_step, reset_epoch, publish,
                     epoch_size=_epoch_records(self.dataset))

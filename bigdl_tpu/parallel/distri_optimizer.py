@@ -168,6 +168,16 @@ class DistriOptimizer(Optimizer):
         self._step_fn = None
         return self
 
+    def _topology_meta(self):
+        """Saving topology for snapshot manifests: the mesh axes, the
+        ZeRO-1 slot axis, and which fused step owns the layout — what a
+        restore onto a different device count needs in order to reshard
+        (or to refuse with the mismatch named)."""
+        from bigdl_tpu.utils import elastic
+        return elastic.describe_topology(
+            self.mesh, step="gspmd" if self.model_axis else "shard_map",
+            slot_axis="data")
+
     # ---- the fused sharded step ----------------------------------------
 
     @property
@@ -333,12 +343,21 @@ class DistriOptimizer(Optimizer):
 
         arp = AllReduceParameter(model.params, axis_size, self.compression)
         self._arp = arp
+        # a resumed run re-partitions the restored CANONICAL host slots
+        # for THIS mesh: _flat_slots re-ravels and re-pads each family
+        # for the current shard count, and the device_put places the new
+        # 1/N shards — the topology-elastic reshard (timed when resuming;
+        # a fresh run's zeros take the identical path untimed)
+        from bigdl_tpu.utils import elastic
+        slot_shards = elastic.place_slots(
+            lambda: jax.device_put(self._flat_slots(arp),
+                                   NamedSharding(mesh, P("data"))),
+            self._consume_elastic_resumed())
         carry = {
             "flat": jax.device_put(arp.flatten(model.params),
                                    NamedSharding(mesh, P())),
             # slots live sharded across the mesh: each device owns 1/N (ZeRO-1)
-            "slots": jax.device_put(self._flat_slots(arp),
-                                    NamedSharding(mesh, P("data"))),
+            "slots": slot_shards,
             "mstate": jax.device_put(model.state, NamedSharding(mesh, P())),
         }
         self.optim_method.state.setdefault("epoch", 1)
@@ -443,6 +462,7 @@ class DistriOptimizer(Optimizer):
             self._publish(arp.unflatten(carry["flat"]), unflat_slots,
                           carry["mstate"])
 
+        self._sync_dataset_epoch()
         reset_epoch()
         self._drive(fetch_batch, run_step, reset_epoch, publish,
                     epoch_size=self.dataset.size())
@@ -495,12 +515,19 @@ class DistriOptimizer(Optimizer):
         # alike are placed onto the slot specs
         slot_specs = zero1_slot_specs(carry["params"], specs,
                                       mesh.shape["data"])
-        slots0 = (self.optim_method._slots
-                  if self.optim_method._slots is not None
+        resumed = self.optim_method._slots is not None
+        slots0 = (self.optim_method._slots if resumed
                   else self.optim_method.init_slots(carry["params"]))
-        carry["slots"] = self._map_over_slots(
-            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-            slots0, slot_specs)
+        # resumed CANONICAL host slots re-place onto the data x model slot
+        # specs of THIS mesh — the GSPMD leg of the topology-elastic
+        # reshard (map_over_slots is the pivot: each family's tree zips
+        # against the per-parameter spec tree)
+        from bigdl_tpu.utils import elastic
+        carry["slots"] = elastic.place_slots(
+            lambda: self._map_over_slots(
+                lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+                slots0, slot_specs),
+            self._consume_elastic_resumed())
         self.optim_method.set_slots(carry["slots"])
         self.optim_method.state.setdefault("epoch", 1)
 
@@ -565,6 +592,7 @@ class DistriOptimizer(Optimizer):
                 self._publish(carry["params"], carry["slots"],
                               carry["mstate"])
 
+        self._sync_dataset_epoch()
         reset_epoch()
         self._drive(fetch_batch, run_step, reset_epoch, publish,
                     epoch_size=self.dataset.size())

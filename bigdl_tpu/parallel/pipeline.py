@@ -318,6 +318,16 @@ class PipelineOptimizer(Optimizer):
     def mesh(self):
         return self._mesh
 
+    def _topology_meta(self):
+        """Saving topology for snapshot manifests: stage/data(/model)
+        axes plus the slot axis (ZeRO-1 over 'data' when present) — what
+        a restore onto a different data-parallel width needs to reshard
+        the stage slots (the stage count itself is model structure, not
+        elastic topology)."""
+        from bigdl_tpu.utils import elastic
+        return elastic.describe_topology(self._mesh, step="pipeline",
+                                         slot_axis=self.data_axis)
+
     def _build_step(self):
         from bigdl_tpu.optim.optimizer import regularization_penalty
 
@@ -414,6 +424,7 @@ class PipelineOptimizer(Optimizer):
             params["embed"] = jax.device_put(self.embed.params, rep)
         if self.head is not None:
             params["head"] = jax.device_put(self.head.params, rep)
+        resumed = self._consume_elastic_resumed()
         carry = {"params": params,
                  "slots": self.optim_method.slots(params)}
         self._slot_specs = None
@@ -433,11 +444,17 @@ class PipelineOptimizer(Optimizer):
                 slot_per_param["stages"] = zero1_slot_specs(
                     params["stages"], self._stage_specs,
                     mesh.shape[self.data_axis])
+            # resumed canonical host slots re-place onto this mesh's
+            # stage(+model) x ZeRO-1 specs — the pipeline leg of the
+            # topology-elastic reshard, map_over_slots again the pivot
+            from bigdl_tpu.utils import elastic
             from bigdl_tpu.parallel.distri_optimizer import map_over_slots
-            carry["slots"] = map_over_slots(
-                self.optim_method,
-                lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-                carry["slots"], slot_per_param)
+            carry["slots"] = elastic.place_slots(
+                lambda: map_over_slots(
+                    self.optim_method,
+                    lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+                    carry["slots"], slot_per_param),
+                resumed)
             self.optim_method.set_slots(carry["slots"])
             self._param_specs_tree = per_param
             self._slot_specs = slot_per_param
@@ -531,6 +548,7 @@ class PipelineOptimizer(Optimizer):
                 model_params.append(p["head"])
             self._publish(model_params, slots, self.model.state)
 
+        self._sync_dataset_epoch()
         reset_epoch()
         self._drive(fetch_batch, run_step, reset_epoch, publish,
                     epoch_size=self.dataset.size())

@@ -34,6 +34,23 @@ identically under pytest, a soak script, or a real cluster rehearsal:
                                 runs past the span and recovers) —
                                 exercises the divergence guard's host-side
                                 counting without poisoning device state.
+``bigdl.chaos.preemptAt``       k: at iteration k the harness calls
+                                ``elastic.request_preemption`` ONCE — the
+                                same flag a real SIGTERM handler sets, so
+                                the driver's graceful drain (publish +
+                                final verified snapshot + resumable
+                                marker) runs exactly as under a scheduler
+                                preemption.
+``bigdl.chaos.stallStepAt``     "k" or "k:seconds": iteration k blocks the
+                                driver thread for ``seconds`` (default
+                                5.0) — a simulated wedged step the
+                                hung-step watchdog must detect and abort.
+``bigdl.chaos.topologyChangeAt``  k: the driver raises ONCE at iteration k
+                                (like ``failStepAt`` but named for the
+                                scenario) — the test/rehearsal then
+                                resumes the snapshot on a DIFFERENT
+                                device count, proving the topology-
+                                elastic restore path end to end.
 ==============================  =============================================
 
 Counters are process-local and monotonically increasing from
@@ -67,10 +84,18 @@ class _ChaosState:
         self.fail_step_at = config.get_int("bigdl.chaos.failStepAt", 0)
         self.nan_loss_at = _parse_span(
             config.get_property("bigdl.chaos.nanLossAt"))
+        self.preempt_at = config.get_int("bigdl.chaos.preemptAt", 0)
+        self.stall_step_at, self.stall_seconds = _parse_stall(
+            config.get_property("bigdl.chaos.stallStepAt"))
+        self.topology_change_at = config.get_int(
+            "bigdl.chaos.topologyChangeAt", 0)
         self.writes = 0
         self.steps_failed = 0
         self.steps_seen = 0
         self.transient_raised = 0
+        self.preempts = 0
+        self.stalls = 0
+        self.topology_changes = 0
         self._lock = threading.Lock()
 
     # ---- storage-layer hooks -------------------------------------------
@@ -107,10 +132,36 @@ class _ChaosState:
             seen = self.steps_seen
         if self.fail_step_at and neval == self.fail_step_at:
             with self._lock:
-                if self.steps_failed == 0:   # preempt once, not every retry
+                if self.steps_failed == 0:   # fail once, not every retry
                     self.steps_failed += 1
                     raise ChaosError(
-                        f"chaos: simulated preemption at iteration {neval}")
+                        f"chaos: simulated step failure at iteration "
+                        f"{neval}")
+        if self.topology_change_at and neval == self.topology_change_at:
+            with self._lock:
+                if self.topology_changes == 0:   # once, not every retry
+                    self.topology_changes += 1
+                    raise ChaosError(
+                        f"chaos: mesh lost at iteration {neval} — resume "
+                        "on a different topology")
+        if self.preempt_at and neval == self.preempt_at:
+            with self._lock:
+                fire = self.preempts == 0        # one SIGTERM, not a storm
+                self.preempts += 1 if fire else 0
+            if fire:
+                from bigdl_tpu.utils import elastic
+                elastic.request_preemption(
+                    reason=f"chaos preemption at iteration {neval}")
+        if self.stall_step_at and neval == self.stall_step_at:
+            with self._lock:
+                fire = self.stalls == 0          # one wedge per plan
+                self.stalls += 1 if fire else 0
+            if fire:
+                # block the driver in Python-land: the watchdog's injected
+                # HungStepError lands the moment this sleep returns —
+                # exactly how a recovered-but-overdue step should die
+                import time
+                time.sleep(self.stall_seconds)
         lo, hi = self.nan_loss_at
         return bool(lo) and lo <= seen <= hi
 
@@ -138,6 +189,18 @@ def _parse_span(value) -> Tuple[int, int]:
         return (int(lo), int(hi))
     k = int(s)
     return (k, k)
+
+
+def _parse_stall(value) -> Tuple[int, float]:
+    """``"k"`` -> (k, 5.0); ``"k:seconds"`` -> (k, seconds); falsy ->
+    (0, 0.0)."""
+    if not value:
+        return (0, 0.0)
+    s = str(value)
+    if ":" in s:
+        k, secs = s.split(":", 1)
+        return (int(k), float(secs))
+    return (int(s), 5.0)
 
 
 _state: Optional[_ChaosState] = None

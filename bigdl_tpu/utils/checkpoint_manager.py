@@ -38,11 +38,13 @@ the next-older snapshot if unpickling fails.
 
 from __future__ import annotations
 
+import atexit
 import json
 import logging
 import pickle
 import threading
 import time
+import weakref
 from typing import Any, Dict, List, Optional, Tuple
 
 from bigdl_tpu import telemetry
@@ -50,7 +52,12 @@ from bigdl_tpu.visualization.crc32c import crc32c
 
 logger = logging.getLogger("bigdl_tpu")
 
-MANIFEST_VERSION = 1
+#: manifest schema: 2 added the saved-topology record (``topology`` key,
+#: ``utils/elastic.py``); version-1 manifests (and pre-manifest legacy
+#: pairs) stay restorable — same-topology by assumption.  A manifest
+#: from a NEWER release than this reader fails restore with a structured
+#: :class:`SnapshotSchemaError`, never an unpickle crash.
+MANIFEST_VERSION = 2
 
 
 def _native_crc32c():
@@ -104,6 +111,21 @@ class SnapshotCorruptError(RuntimeError):
     """A snapshot payload failed its manifest checksum."""
 
 
+class SnapshotSchemaError(RuntimeError):
+    """A snapshot manifest declares a schema newer than this reader —
+    restoring it would mean unpickling payloads whose layout this
+    release cannot vouch for.  Raised with the versions named, instead
+    of whatever exception the unpickler would eventually hit."""
+
+    def __init__(self, neval: int, found: Any):
+        self.neval = neval
+        self.found = found
+        super().__init__(
+            f"snapshot {neval}: manifest schema version {found!r} is newer "
+            f"than this release understands (<= {MANIFEST_VERSION}) — "
+            "restore it with the release that wrote it")
+
+
 def _capture(model, optim, neval: int) -> Dict[str, bytes]:
     """Serialize the live model/optim into detached byte payloads, on the
     caller's thread.
@@ -149,10 +171,19 @@ class _AsyncWriter:
                                         name="bigdl-ckpt-writer")
         self._thread.start()
 
-    def join(self, raise_errors: bool = True) -> None:
+    def join(self, raise_errors: bool = True,
+             timeout: Optional[float] = None) -> None:
         t = self._thread
         if t is not None:
-            t.join()
+            t.join(timeout)
+            if t.is_alive():
+                # bounded drain gave up (exit path): leave the thread
+                # for a later join — do NOT report a deferred error that
+                # hasn't happened yet
+                logger.warning(
+                    "checkpoint writer still running after %.1fs drain "
+                    "timeout — abandoning the wait", timeout)
+                return
             self._thread = None
         if self._error is not None:
             err, self._error = self._error, None
@@ -160,6 +191,38 @@ class _AsyncWriter:
                 raise SnapshotWriteError(
                     "background checkpoint write failed") from err
             logger.warning("background checkpoint write failed: %r", err)
+
+
+#: async-writing managers still alive — drained once more at interpreter
+#: shutdown (see ``_register_for_exit_drain``)
+_LIVE_ASYNC_MANAGERS: "weakref.WeakSet" = weakref.WeakSet()
+_EXIT_HOOK_INSTALLED = [False]
+
+
+def drain_all_async_writers() -> None:
+    """Join every live async checkpoint writer (errors logged, not
+    raised — shutdown must proceed).  Registered with ``atexit`` by the
+    first async manager and also invoked by the elastic preemption
+    drain, so a snapshot submitted moments before SIGTERM/exit always
+    reaches its commit marker.  The join is BOUNDED by
+    ``bigdl.elastic.gracePeriod``: a wedged remote write (hung fsspec
+    network call) must not block interpreter exit forever — before the
+    exit hook existed such threads were simply abandoned, and past the
+    bound they still are."""
+    from bigdl_tpu.utils import elastic
+    timeout = elastic.grace_period()
+    for mgr in list(_LIVE_ASYNC_MANAGERS):
+        try:
+            mgr.join(raise_errors=False, timeout=timeout)
+        except Exception as e:  # pragma: no cover - defensive shutdown
+            logger.warning("checkpoint writer drain at exit failed: %r", e)
+
+
+def _register_for_exit_drain(manager: "CheckpointManager") -> None:
+    _LIVE_ASYNC_MANAGERS.add(manager)
+    if not _EXIT_HOOK_INSTALLED[0]:
+        _EXIT_HOOK_INSTALLED[0] = True
+        atexit.register(drain_all_async_writers)
 
 
 class CheckpointManager:
@@ -182,29 +245,49 @@ class CheckpointManager:
                             config.get_bool("bigdl.checkpoint.asyncWrite",
                                             False))
         self._writer = _AsyncWriter() if self.async_write else None
+        #: manifest of the snapshot load_latest most recently restored
+        self.last_loaded_manifest: Optional[Dict[str, Any]] = None
+        #: topology decision of that load: "same", "reshard", or None
+        #: (nothing loaded yet) — restore paths gate the reshard timing
+        #: on it, so a same-topology retry is never reported as one
+        self.last_restore_mode: Optional[str] = None
+        if self._writer is not None:
+            # interpreter-shutdown flush: the writer thread is a daemon,
+            # so without this a clean exit (or an un-drained SIGINT path)
+            # would strand the newest snapshot in the queue behind a
+            # stale on-disk one
+            _register_for_exit_drain(self)
 
     # ---- save -----------------------------------------------------------
 
-    def save(self, model, optim, neval: int) -> None:
+    def save(self, model, optim, neval: int,
+             topology: Optional[Dict[str, Any]] = None) -> None:
         """Write snapshot ``neval`` as a verified unit.  Synchronous mode
         blocks until the commit marker lands; async mode blocks only for
         the host fetch + in-memory serialization (and for a still-in-flight
         PREVIOUS write, whose errors re-raise here) — directory creation
         and the orphan-temp sweep are filesystem round-trips and belong
-        on the writer thread."""
+        on the writer thread.
+
+        ``topology`` (``elastic.describe_topology``) records the saving
+        mesh in the manifest so a restore onto a different device count
+        can reshard the ZeRO-1 slots — or refuse with the mismatch
+        named — instead of discovering the change as a shape error."""
         blobs = _capture(model, optim, neval)
         if self._writer is not None:
             self._writer.submit(
-                lambda: self._write_snapshot(blobs, neval))
+                lambda: self._write_snapshot(blobs, neval, topology))
         else:
-            self._write_snapshot(blobs, neval)
+            self._write_snapshot(blobs, neval, topology)
 
-    def _write_snapshot(self, blobs: Dict[str, bytes], neval: int) -> None:
+    def _write_snapshot(self, blobs: Dict[str, bytes], neval: int,
+                        topology: Optional[Dict[str, Any]] = None) -> None:
         with telemetry.span("checkpoint/write", neval=neval):
-            self._write_snapshot_inner(blobs, neval)
+            self._write_snapshot_inner(blobs, neval, topology)
 
-    def _write_snapshot_inner(self, blobs: Dict[str, bytes],
-                              neval: int) -> None:
+    def _write_snapshot_inner(self, blobs: Dict[str, bytes], neval: int,
+                              topology: Optional[Dict[str, Any]] = None
+                              ) -> None:
         from bigdl_tpu.utils import file_io
         file_io.makedirs(self.path)
         self._sweep_orphan_temps()
@@ -219,6 +302,8 @@ class CheckpointManager:
             "algo": algo,
             "files": files,
         }
+        if topology is not None:
+            manifest["topology"] = topology
         for name, data in blobs.items():
             file_io.write_bytes(file_io.join(self.path, name), data,
                                 self.overwrite)
@@ -297,6 +382,9 @@ class CheckpointManager:
             raise SnapshotCorruptError(
                 f"snapshot {n}: commit marker does not match manifest "
                 f"checksum")
+        version = manifest.get("version", 1)
+        if not isinstance(version, int) or version > MANIFEST_VERSION:
+            raise SnapshotSchemaError(n, version)
         return manifest
 
     def _read_verified(self, name: str,
@@ -324,7 +412,14 @@ class CheckpointManager:
         checksums every payload; :meth:`load_latest` gets that for free
         since it must read the bytes anyway.  Legacy snapshots have
         nothing to verify against and pass (the load-time fallback still
-        protects restore)."""
+        protects restore).
+
+        A :class:`SnapshotSchemaError` (manifest from a NEWER release)
+        is a deliberate rejection, not corruption, and PROPAGATES — the
+        same semantics as :meth:`load_latest` — so a supervisor probing
+        :meth:`latest_valid` cannot silently plan around stale state the
+        actual restore path would refuse (:meth:`gc` catches it and
+        treats the snapshot as untouchable)."""
         if not has_manifest:
             return True
         from bigdl_tpu.utils import file_io
@@ -341,6 +436,8 @@ class CheckpointManager:
                             f"manifest ({manifest['files'][name]['bytes']}"
                             " bytes)")
             return True
+        except SnapshotSchemaError:
+            raise
         except Exception as e:
             logger.warning("snapshot %d fails verification (%s) — "
                            "skipping to an older snapshot", n, e)
@@ -352,7 +449,10 @@ class CheckpointManager:
         ``(model_path, optimMethod_path, neval)`` — the drop-in shape of
         the old ``Checkpoint.latest()``.  Full checksums run when the
         payloads are actually read (:meth:`load_latest`), which also
-        falls back to older snapshots on a deep-verification failure."""
+        falls back to older snapshots on a deep-verification failure.
+        Like :meth:`load_latest`, a newer-schema newest snapshot raises
+        :class:`SnapshotSchemaError` instead of silently answering with
+        older state."""
         from bigdl_tpu.utils import file_io
         for n, has_manifest in self.candidates():
             if self.verify(n, has_manifest):
@@ -360,20 +460,50 @@ class CheckpointManager:
                         file_io.join(self.path, f"optimMethod.{n}"), n)
         return None
 
-    def load_latest(self) -> Optional[Tuple[Any, Any, int]]:
+    def load_latest(self, expected_topology: Optional[Dict[str, Any]] = None
+                    ) -> Optional[Tuple[Any, Any, int]]:
         """Load the newest restorable snapshot, walking to the next-older
         one when verification OR deserialization fails (a corrupt legacy
         pickle has no manifest to fail against — the unpickler is its
-        verifier)."""
+        verifier).
+
+        ``expected_topology``: the RESUMING trainer's topology
+        (``elastic.describe_topology``).  When the newest snapshot's
+        recorded topology differs, the elastic policy decides — reshard
+        (``bigdl.elastic.reshardOnRestore``) or a structured
+        :class:`~bigdl_tpu.utils.elastic.TopologyMismatchError`.  Both
+        that error and :class:`SnapshotSchemaError` are deliberate
+        REJECTIONS and propagate instead of falling back: an older
+        snapshot would carry the same incompatibility, and silently
+        restoring older state would masquerade as progress loss.  The
+        manifest of the snapshot actually loaded (None for legacy pairs)
+        is left in :attr:`last_loaded_manifest`."""
         for n, has_manifest in self.candidates():
             try:
                 manifest = self._read_manifest(n) if has_manifest else None
+                mode = "same"
+                if expected_topology is not None and manifest is not None:
+                    from bigdl_tpu.utils import elastic
+                    mode = elastic.check_restore_topology(
+                        manifest.get("topology"), expected_topology)
                 model = pickle.loads(
                     self._read_verified(f"model.{n}", manifest))
                 optim = pickle.loads(
                     self._read_verified(f"optimMethod.{n}", manifest))
+                self.last_loaded_manifest = manifest
+                self.last_restore_mode = mode
+                if mode == "reshard":
+                    # counted here, after the load succeeded: a fallback
+                    # walk past a corrupt newest snapshot is ONE restore
+                    from bigdl_tpu.utils import elastic
+                    elastic.count_reshard()
                 return model, optim, n
             except Exception as e:
+                if isinstance(e, SnapshotSchemaError):
+                    raise
+                from bigdl_tpu.utils import elastic
+                if isinstance(e, elastic.TopologyMismatchError):
+                    raise
                 logger.warning(
                     "snapshot %d failed to restore (%s: %s) — falling "
                     "back to the next-older snapshot", n,
@@ -417,15 +547,32 @@ class CheckpointManager:
         # recovery path the manifest machinery exists to protect
         keepers: List[int] = []
         drop: List[Tuple[int, bool]] = []
+        protected: set = set()
         for n, has_manifest in cands:
-            if (len(keepers) < self.keep_last and
-                    self.verify(n, has_manifest)):
-                keepers.append(n)
-            elif len(keepers) >= self.keep_last:
+            if len(keepers) >= self.keep_last:
                 drop.append((n, has_manifest))
+                continue
+            try:
+                ok = self.verify(n, has_manifest)
+            except SnapshotSchemaError:
+                # a NEWER release's snapshot (mixed-version rollout):
+                # not loadable here, but absolutely not debris — GC of
+                # another release's data would be destructive
+                protected.add(n)
+                continue
+            if ok:
+                keepers.append(n)
             # verification failures are left in place here and swept as
             # debris below only once something newer AND valid exists
         for n, has_manifest in drop:
+            if has_manifest:
+                try:
+                    self._read_manifest(n)
+                except SnapshotSchemaError:
+                    protected.add(n)   # shields the debris sweep below too
+                    continue
+                except Exception:
+                    pass   # torn/corrupt past the quota: normal debris
             names = ((f"commit.{n}", f"model.{n}", f"optimMethod.{n}",
                       f"manifest.{n}") if has_manifest else
                      (f"model.{n}", f"optimMethod.{n}"))
@@ -445,16 +592,18 @@ class CheckpointManager:
                 n = int(tail)
             except ValueError:
                 continue
-            if n < newest and n not in kept:
+            if n < newest and n not in kept and n not in protected:
                 _rm(f)
 
     # ---- async lifecycle ------------------------------------------------
 
-    def join(self, raise_errors: bool = True) -> None:
+    def join(self, raise_errors: bool = True,
+             timeout: Optional[float] = None) -> None:
         """Drain the background writer (no-op in sync mode).  Deferred
         write errors re-raise here unless ``raise_errors`` is False (used
-        on paths already unwinding an exception)."""
+        on paths already unwinding an exception).  ``timeout`` bounds the
+        wait (exit paths); an expired bound abandons the thread."""
         if self._writer is not None:
-            self._writer.join(raise_errors=raise_errors)
+            self._writer.join(raise_errors=raise_errors, timeout=timeout)
 
     close = join

@@ -36,6 +36,23 @@ _DEFAULTS: Dict[str, Any] = {
     "bigdl.chaos.transientWrites": 0,
     "bigdl.chaos.failStepAt": 0,
     "bigdl.chaos.nanLossAt": None,
+    "bigdl.chaos.preemptAt": 0,        # iteration k: simulated SIGTERM
+    "bigdl.chaos.stallStepAt": None,   # "k:seconds": iteration k hangs
+    "bigdl.chaos.topologyChangeAt": 0,  # iteration k: mesh goes away
+    # elastic training (utils/elastic.py): topology-elastic restore +
+    # graceful preemption
+    "bigdl.elastic.gracePeriod": 30.0,  # seconds for the final drain+snapshot
+    "bigdl.elastic.reshardOnRestore": True,  # N->M slot reshard vs reject
+    "bigdl.elastic.handleSignals": False,    # SIGTERM/SIGINT -> graceful drain
+    "bigdl.elastic.globalShuffle": True,  # one global epoch permutation
+    # (partition-count-invariant batch stream) vs partition-local blocks
+    # (pre-elastic per-host memory footprint, same-topology replay only)
+    # hung-step watchdog (utils/elastic.py): step open > k x EMA -> abort
+    "bigdl.watchdog.stallFactor": 0,   # 0 disables the monitor thread
+    "bigdl.watchdog.pollInterval": 0.25,  # monitor wake period, seconds
+    "bigdl.watchdog.warmupSteps": 5,   # EMA warmup (compile exemption)
+    "bigdl.watchdog.cooldownSteps": 50,  # heartbeats between fires
+    "bigdl.watchdog.timelineDir": None,  # dump telemetry timeline here on fire
     "bigdl.check.singleton": False,
     "bigdl.summary.flushSecs": 2.0,
     "bigdl.compilation.cacheDir": None,    # jax persistent compile cache

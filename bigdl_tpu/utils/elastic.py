@@ -1,0 +1,633 @@
+"""Elastic training: topology-elastic restore, preemption, hung-step watchdog.
+
+The PR 2 fault-tolerance loop assumed the world it restored into was the
+world it snapshotted from: same device count, same mesh, and a failure
+mode that announces itself by raising.  Production TPU fleets violate all
+three — preemptible slices come and go (the snapshot taken on N devices
+must resume on M), the scheduler delivers SIGTERM with a grace window
+instead of an exception, and the nastiest failure is the step that never
+*finishes* (a wedged collective, a deadlocked host thread) and therefore
+never raises anything.  This module is the three missing legs:
+
+1. **Topology-elastic restore.**  Snapshots record the saving topology
+   (:func:`describe_topology` — device count, mesh axis names/sizes, the
+   ZeRO-1 slot partition axis, which fused step wrote them) in the
+   checkpoint manifest.  At restore, :func:`check_restore_topology`
+   compares it against the resuming trainer's topology: same topology
+   restores as before; a different one either enters the reshard path
+   (``bigdl.elastic.reshardOnRestore``, default on — snapshots publish
+   CANONICAL per-parameter host trees, so resharding = re-partitioning
+   those trees for the new mesh and re-placing them with the new
+   ``NamedSharding``, timed by :func:`timed` into the metrics registry)
+   or is rejected with a :class:`TopologyMismatchError` that names every
+   mismatching axis instead of failing deep inside a shape check.
+
+2. **Preemption handling.**  :class:`PreemptionHandler` installs
+   SIGTERM/SIGINT handlers (``bigdl.elastic.handleSignals``) that only
+   set a flag — signal-safe by construction; the driver loop polls
+   :func:`preemption_requested` once per iteration and unwinds through
+   a *graceful drain*: flush the dispatch pipeline, publish the carries,
+   raise :class:`Preempted`.  The retry loop recognizes the class —
+   preemption commits a final verified snapshot plus a resumable marker
+   within ``bigdl.elastic.gracePeriod`` and exits, where divergence
+   restores-and-retries.
+
+3. **Hung-step watchdog.**  :class:`HungStepWatchdog` is a monitor
+   thread fed one :meth:`~HungStepWatchdog.heartbeat` per driver
+   iteration.  Completed intervals feed the PR 5 step-time EMA
+   (:class:`~bigdl_tpu.telemetry.step_stats.SlowStepDetector`, whose
+   warmup-minimum seeding keeps compile steps out of the baseline — the
+   compile-warmup exemption); when the *open* interval exceeds
+   ``bigdl.watchdog.stallFactor`` x EMA the watchdog fires ONCE for that
+   stall (re-arming only after a heartbeat lands plus a cooldown): dumps
+   the telemetry timeline, bumps the registry counters, and aborts the
+   driver thread with an injected :class:`HungStepError` so the retry
+   loop restores the newest valid snapshot instead of hanging the job
+   forever.  (The async-exception abort lands when the wedged thread
+   re-enters Python bytecode — it interrupts chaos-simulated stalls and
+   host-side wedges; a thread parked forever inside a C extension call
+   is only reachable by process-level supervision, which the log line
+   and counters are there to inform.)
+
+Everything is provable on CPU: ``utils/chaos.py`` injects preemption
+signals (``bigdl.chaos.preemptAt``), stalled steps
+(``bigdl.chaos.stallStepAt``) and mid-run topology changes
+(``bigdl.chaos.topologyChangeAt``), and ``tests/test_elastic.py`` holds
+the parity proofs.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger("bigdl_tpu")
+
+#: schema key the checkpoint manifest stores the topology under
+TOPOLOGY_KEY = "topology"
+
+
+class TopologyMismatchError(RuntimeError):
+    """A snapshot's saved topology is incompatible with the resuming
+    trainer and resharding was disabled — the structured alternative to
+    an unpickle/shape crash.  ``mismatches`` names every differing
+    field."""
+
+    def __init__(self, saved: Dict[str, Any], current: Dict[str, Any],
+                 mismatches: List[str]):
+        self.saved = saved
+        self.current = current
+        self.mismatches = list(mismatches)
+        super().__init__(
+            "snapshot topology does not match the resuming trainer "
+            f"({'; '.join(mismatches)}) and bigdl.elastic.reshardOnRestore "
+            "is disabled — enable it to reshard the ZeRO-1 slots onto the "
+            "new mesh, or resume on the saving topology "
+            f"(saved={saved}, current={current})")
+
+
+class Preempted(RuntimeError):
+    """The run was asked to stop (SIGTERM/SIGINT or an injected
+    preemption): the driver drained gracefully and — when a checkpoint
+    is configured — a final verified snapshot plus a resumable marker
+    were committed.  Deliberately NOT retried by the failure loop:
+    preemption means *leave*, divergence means *rewind*."""
+
+
+class HungStepError(RuntimeError):
+    """Injected into the driver thread by the hung-step watchdog: a step
+    exceeded ``bigdl.watchdog.stallFactor`` x the step-time EMA.  The
+    retry loop treats it like any crash — restore newest valid snapshot
+    and resume."""
+
+
+# ---- topology ------------------------------------------------------------
+
+
+def describe_topology(mesh=None, step: str = "local",
+                      slot_axis: Optional[str] = None) -> Dict[str, Any]:
+    """The manifest-serializable description of the topology a snapshot
+    is being written from: plain ints/strings only (it travels through
+    the JSON manifest).  ``step`` names the fused step that owns the
+    layout (``local`` / ``shard_map`` / ``gspmd`` / ``pipeline``);
+    ``slot_axis`` is the mesh axis the ZeRO-1 optimizer slots shard
+    over (None: slots are unsharded)."""
+    if mesh is None:
+        return {"device_count": 1, "axes": {}, "step": step,
+                "slot_axis": slot_axis}
+    return {
+        "device_count": int(mesh.size),
+        "axes": {str(a): int(s) for a, s in mesh.shape.items()},
+        "step": str(step),
+        "slot_axis": slot_axis,
+    }
+
+
+def compare_topology(saved: Optional[Dict[str, Any]],
+                     current: Optional[Dict[str, Any]]) -> List[str]:
+    """Human-readable mismatch list between a snapshot's saved topology
+    and the resuming trainer's; empty means compatible as-is.  A snapshot
+    with no topology record (pre-schema-2) compares equal to anything —
+    those snapshots restore same-topology by assumption, exactly as they
+    did before the schema carried topology at all."""
+    if not saved or not current:
+        return []
+    out: List[str] = []
+    if saved.get("device_count") != current.get("device_count"):
+        out.append(f"device_count {saved.get('device_count')} -> "
+                   f"{current.get('device_count')}")
+    s_axes = saved.get("axes") or {}
+    c_axes = current.get("axes") or {}
+    for name in sorted(set(s_axes) | set(c_axes)):
+        if s_axes.get(name) != c_axes.get(name):
+            out.append(f"axis '{name}' {s_axes.get(name)} -> "
+                       f"{c_axes.get(name)}")
+    if saved.get("step") != current.get("step"):
+        out.append(f"step {saved.get('step')!r} -> {current.get('step')!r}")
+    return out
+
+
+def check_restore_topology(saved: Optional[Dict[str, Any]],
+                           current: Optional[Dict[str, Any]]) -> str:
+    """``"same"`` when the snapshot restores without resharding,
+    ``"reshard"`` when the topology changed and
+    ``bigdl.elastic.reshardOnRestore`` allows re-partitioning; raises
+    :class:`TopologyMismatchError` otherwise."""
+    mismatches = compare_topology(saved, current)
+    if not mismatches:
+        return "same"
+    from bigdl_tpu.utils import config
+    if config.get_bool("bigdl.elastic.reshardOnRestore", True):
+        logger.info(
+            "elastic restore: snapshot topology differs from the resuming "
+            "trainer (%s) — resharding ZeRO-1 slots onto the new mesh",
+            "; ".join(mismatches))
+        return "reshard"
+    raise TopologyMismatchError(saved or {}, current or {}, mismatches)
+
+
+def place_slots(place_fn, resharding: bool):
+    """Shared protocol of the three trainer slot-placement legs
+    (shard_map dp, GSPMD dp x tp, pipeline): run ``place_fn`` — the
+    device_put of optimizer slots onto the current mesh — under the
+    ``Elastic/reshard_ms`` timer when ``resharding`` (the slots were
+    just restored from a checkpoint, see
+    ``Optimizer._consume_elastic_resumed``), blocking for completion so
+    the gauge measures the transfer rather than the dispatch.  Fresh
+    zeros and in-process re-placements take the identical path untimed
+    and unblocked."""
+    with timed("reshard", enabled=resharding):
+        out = place_fn()
+        if resharding:
+            import jax
+            jax.block_until_ready(out)
+        return out
+
+
+def count_reshard() -> None:
+    """Bump ``Elastic/reshards`` — called by the restore path for the
+    snapshot ACTUALLY loaded, not per candidate examined: a fallback walk
+    past a corrupt newest snapshot is one restore, not several."""
+    from bigdl_tpu import telemetry
+    telemetry.counter(
+        "Elastic/reshards",
+        help="topology-elastic restores that re-partitioned").inc()
+
+
+class _TimedHandle:
+    __slots__ = ("record",)
+
+    def __init__(self, record: bool):
+        self.record = record
+
+    def cancel(self) -> None:
+        self.record = False
+
+
+@contextmanager
+def timed(metric: str, enabled: bool = True):
+    """Time a restore/reshard phase into the metrics registry
+    (``Elastic/<metric>_ms`` gauge; last value wins — these are per-event
+    durations the bench leg and the end-of-run snapshot read).
+    ``enabled=False`` is a no-op, so call sites shared between fresh and
+    resumed runs stay single-path.  Yields a handle whose ``cancel()``
+    suppresses the recording — for bodies that discover mid-flight the
+    event did not happen (a restore scan that found nothing)."""
+    handle = _TimedHandle(enabled)
+    if not enabled:
+        yield handle
+        return
+    from bigdl_tpu import telemetry
+    t0 = time.perf_counter()
+    try:
+        yield handle
+    finally:
+        if handle.record:
+            telemetry.gauge(f"Elastic/{metric}_ms").set(
+                (time.perf_counter() - t0) * 1e3)
+
+
+# ---- preemption ----------------------------------------------------------
+
+_PREEMPT = {"requested": False, "reason": None, "at": None}
+
+
+def request_preemption(reason: str = "signal") -> None:
+    """Flag the run for graceful shutdown (signal handlers and the chaos
+    injector call this; anything here must stay async-signal-safe — set
+    state, no locks beyond the GIL, no IO.  In particular NO metric
+    registry touches: a handler interrupting the main thread inside a
+    registry/metric lock would deadlock on re-acquiring it — the
+    ``Elastic/preemptions`` counter is bumped by the driver when it
+    observes the flag)."""
+    _PREEMPT["requested"] = True
+    _PREEMPT["reason"] = reason
+    # the grace clock starts HERE: the drain the driver runs before the
+    # final snapshot (pipeline flush + publish) spends the same window
+    _PREEMPT["at"] = time.monotonic()
+
+
+def preemption_requested() -> bool:
+    return _PREEMPT["requested"]
+
+
+def preemption_reason() -> Optional[str]:
+    return _PREEMPT["reason"]
+
+
+def preemption_requested_at() -> Optional[float]:
+    """``time.monotonic()`` of the preemption request, or None."""
+    return _PREEMPT["at"]
+
+
+def clear_preemption() -> None:
+    """Reset the flag (a resumed run in the same process starts clean)."""
+    _PREEMPT["requested"] = False
+    _PREEMPT["reason"] = None
+    _PREEMPT["at"] = None
+
+
+def grace_period() -> float:
+    from bigdl_tpu.utils import config
+    return config.get_float("bigdl.elastic.gracePeriod", 30.0)
+
+
+class PreemptionHandler:
+    """Context manager that routes SIGTERM/SIGINT into
+    :func:`request_preemption` for the duration of a training run.
+
+    Installed only when ``bigdl.elastic.handleSignals`` is on AND the
+    caller runs on the main thread (CPython restricts ``signal.signal``
+    to it); previous handlers are restored on exit, so a library user's
+    own signal strategy survives the run.  The handler body is flag-only
+    — every consequence (pipeline flush, publish, the final snapshot)
+    happens on the driver thread at the next iteration boundary, inside
+    the grace period the scheduler granted."""
+
+    SIGNALS = ("SIGTERM", "SIGINT")
+
+    def __init__(self, enabled: Optional[bool] = None):
+        from bigdl_tpu.utils import config
+        if enabled is None:
+            enabled = config.get_bool("bigdl.elastic.handleSignals", False)
+        self.enabled = bool(enabled)
+        self._previous: Dict[int, Any] = {}
+
+    def __enter__(self) -> "PreemptionHandler":
+        if not self.enabled:
+            return self
+        import signal
+        if threading.current_thread() is not threading.main_thread():
+            logger.warning(
+                "bigdl.elastic.handleSignals is on but optimize() runs "
+                "off the main thread — signal handlers not installed")
+            self.enabled = False
+            return self
+
+        def handler(signum, frame):   # noqa: ARG001 — signal signature
+            request_preemption(reason=f"signal {signum}")
+
+        for name in self.SIGNALS:
+            signum = getattr(signal, name)
+            self._previous[signum] = signal.signal(signum, handler)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if not self._previous:
+            return
+        import signal
+        for signum, prev in self._previous.items():
+            try:
+                signal.signal(signum, prev)
+            except (ValueError, TypeError):  # pragma: no cover - teardown
+                pass
+        self._previous.clear()
+
+
+#: resumable-marker filename committed next to the grace-period snapshot
+PREEMPT_MARKER = "preempted"
+
+
+def write_preemption_marker(ckpt_path: str, neval: int) -> None:
+    """Drop the resumable marker into the checkpoint directory: a tiny
+    JSON naming the snapshot the grace-period drain committed, so an
+    external supervisor (or the next attempt) can tell an orderly
+    preemption from a crash without scanning manifests."""
+    import json
+    from bigdl_tpu.utils import file_io
+    payload = json.dumps({
+        "neval": int(neval),
+        "reason": preemption_reason() or "preempted",
+        "unix_time": time.time(),
+    }, sort_keys=True).encode("utf-8")
+    try:
+        file_io.write_bytes(file_io.join(ckpt_path, PREEMPT_MARKER),
+                            payload, overwrite=True)
+    except Exception as e:  # the marker is advisory, the snapshot is not
+        logger.warning("could not write preemption marker: %r", e)
+
+
+def read_preemption_marker(ckpt_path: str) -> Optional[Dict[str, Any]]:
+    import json
+    from bigdl_tpu.utils import file_io
+    try:
+        data = file_io.read_bytes(file_io.join(ckpt_path, PREEMPT_MARKER))
+    except Exception:
+        return None
+    return json.loads(data.decode("utf-8"))
+
+
+def clear_preemption_marker(ckpt_path: str) -> None:
+    from bigdl_tpu.utils import file_io
+    try:
+        file_io.remove(file_io.join(ckpt_path, PREEMPT_MARKER))
+    except Exception:
+        pass
+
+
+# ---- hung-step watchdog --------------------------------------------------
+
+
+def _async_raise(thread_id: int, exc_type) -> bool:
+    """Inject ``exc_type`` into the thread with ``thread_id`` (CPython's
+    PyThreadState_SetAsyncExc).  The exception surfaces when that thread
+    next executes bytecode."""
+    import ctypes
+    set_exc = ctypes.pythonapi.PyThreadState_SetAsyncExc
+    set_exc.argtypes = (ctypes.c_ulong, ctypes.py_object)
+    set_exc.restype = ctypes.c_int
+    res = set_exc(ctypes.c_ulong(thread_id), ctypes.py_object(exc_type))
+    if res > 1:  # pragma: no cover - interpreter-level inconsistency
+        set_exc(ctypes.c_ulong(thread_id), None)
+        return False
+    return res == 1
+
+
+class HungStepWatchdog:
+    """Monitor thread detecting a driver iteration that never finishes.
+
+    The driver calls :meth:`heartbeat` once per loop iteration; the
+    interval between consecutive heartbeats is a completed step and
+    feeds the EMA (a :class:`SlowStepDetector` seeded from the warmup
+    MINIMUM, so compile steps cannot poison the baseline — detection is
+    disarmed until the warmup completes).  The monitor wakes every
+    ``poll_interval`` seconds and compares the OPEN interval — time
+    since the last heartbeat — against ``factor`` x EMA.  One stall
+    fires exactly once, however long it lasts; after the stalled step
+    finally completes (or the driver is reborn by the retry loop), a
+    ``cooldown`` of completed heartbeats must pass before the next fire.
+
+    Firing dumps the telemetry timeline (``bigdl.watchdog.timelineDir``,
+    when tracing is armed), records ``Elastic/watchdog_fired`` /
+    ``Elastic/watchdog_detect_ms`` in the metrics registry, invokes
+    ``on_fire`` (tests/bench probes), and — when ``abort`` is on —
+    injects :class:`HungStepError` into the driver thread so the retry
+    loop can restore the newest valid snapshot.
+    """
+
+    def __init__(self, factor: float, warmup: int = 5, cooldown: int = 50,
+                 poll_interval: float = 0.25, abort: bool = True,
+                 timeline_dir: Optional[str] = None, on_fire=None):
+        from bigdl_tpu.telemetry import SlowStepDetector
+        self.factor = float(factor)
+        self.detector = SlowStepDetector(self.factor, warmup=warmup,
+                                         cooldown=0)
+        self.cooldown = max(0, int(cooldown))
+        self.poll_interval = max(0.01, float(poll_interval))
+        self.abort = abort
+        self.timeline_dir = timeline_dir
+        self.on_fire = on_fire
+        self.fired = 0
+        self._lock = threading.Lock()
+        self._last_beat_ns: Optional[int] = None
+        self._beats = 0
+        self._fired_this_stall = False
+        self._cool_left = 0
+        self._paused = 0
+        #: the start()->first-beat interval covers setup, not a step, and
+        #: must not feed the EMA — a near-zero observation would deflate
+        #: the stall threshold and fire on healthy steps
+        self._skip_next_observe = True
+        #: step time accrued BEFORE a pause interrupted the interval —
+        #: added back at the next heartbeat so the observation is the
+        #: true step work minus the paused span.  (Discarding post-pause
+        #: intervals instead would starve the EMA whenever every
+        #: iteration checkpoints/validates, silently disarming the
+        #: watchdog.)
+        self._carry_ns = 0
+        self._driver_tid: Optional[int] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def from_config(cls, timeline_dir: Optional[str] = None,
+                    on_fire=None) -> Optional["HungStepWatchdog"]:
+        """A watchdog per the ``bigdl.watchdog.*`` keys, or None when
+        ``stallFactor`` is unset (the default: no monitor thread at
+        all — zero overhead for runs that did not opt in)."""
+        from bigdl_tpu.utils import config
+        factor = config.get_float("bigdl.watchdog.stallFactor", 0.0)
+        if factor <= 0:
+            return None
+        return cls(
+            factor,
+            warmup=config.get_int("bigdl.watchdog.warmupSteps", 5),
+            cooldown=config.get_int("bigdl.watchdog.cooldownSteps", 50),
+            poll_interval=config.get_float("bigdl.watchdog.pollInterval",
+                                           0.25),
+            timeline_dir=(timeline_dir if timeline_dir is not None else
+                          config.get_property("bigdl.watchdog.timelineDir")),
+            on_fire=on_fire)
+
+    @property
+    def enabled(self) -> bool:
+        return self.factor > 0
+
+    # -- driver side ------------------------------------------------------
+
+    def start(self) -> "HungStepWatchdog":
+        """Begin monitoring; call from the DRIVER thread (its identity is
+        what the abort targets)."""
+        from bigdl_tpu import telemetry
+        self._driver_tid = threading.get_ident()
+        self._last_beat_ns = telemetry.clock_ns()
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._monitor, daemon=True,
+                                        name="bigdl-watchdog")
+        self._thread.start()
+        return self
+
+    def heartbeat(self) -> None:
+        """One driver iteration completed.  Cheap: a clock read and a
+        few float ops under a lock the monitor holds for microseconds."""
+        from bigdl_tpu import telemetry
+        now = telemetry.clock_ns()
+        with self._lock:
+            last = self._last_beat_ns
+            self._last_beat_ns = now
+            self._beats += 1
+            skip = self._skip_next_observe
+            self._skip_next_observe = False
+            carry, self._carry_ns = self._carry_ns, 0
+            if self._fired_this_stall:
+                self._fired_this_stall = False
+                self._cool_left = self.cooldown
+            elif self._cool_left > 0:
+                self._cool_left -= 1
+        if last is not None and not skip:
+            # completed STEP intervals feed the EMA outside the lock —
+            # the detector is only ever touched from the driver thread
+            self.detector.observe(float(carry + now - last))
+
+    @contextmanager
+    def paused(self):
+        """Suspend stall detection over a legitimately-long driver phase
+        (validation, checkpoint, publish): those are not hung steps, and
+        their duration must count neither against the open interval nor
+        into the EMA.  The step time already spent is banked into a
+        carry and the clock restarts on resume, so the next completed
+        heartbeat observes the step's true work with the paused span
+        excised — NOT a near-zero tail (which would deflate the EMA) and
+        NOT nothing at all (skipping would starve the EMA and silently
+        disarm the watchdog when every iteration checkpoints)."""
+        from bigdl_tpu import telemetry
+        with self._lock:
+            if self._paused == 0 and self._last_beat_ns is not None:
+                # bank the step time already spent this interval; the
+                # next heartbeat observes carry + post-pause time = the
+                # step's true work, the paused span excluded exactly
+                self._carry_ns += telemetry.clock_ns() - self._last_beat_ns
+            self._paused += 1
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._paused -= 1
+                if self._paused == 0:
+                    self._last_beat_ns = telemetry.clock_ns()
+
+    def stop(self) -> None:
+        # set under the lock: _fire re-checks _stop under the same lock
+        # immediately before injecting, so a monitor that raced the end
+        # of the run cannot abort a driver that already completed (an
+        # async exception cannot be un-injected — the target thread sees
+        # it at its very next bytecode, before any clear could run)
+        with self._lock:
+            self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._thread = None
+
+    # -- monitor side -----------------------------------------------------
+
+    def threshold_ns(self) -> float:
+        """Current stall threshold; inf while the EMA is still in its
+        compile-warmup window (detection disarmed)."""
+        return self.detector.threshold()
+
+    def _monitor(self) -> None:
+        from bigdl_tpu import telemetry
+        while not self._stop.wait(self.poll_interval):
+            threshold = self.threshold_ns()
+            if threshold == float("inf"):
+                continue
+            with self._lock:
+                last = self._last_beat_ns
+                carry = self._carry_ns
+                blocked = (not self._paused and
+                           not self._fired_this_stall and
+                           self._cool_left == 0)
+            if last is None or not blocked:
+                continue
+            # carry counts: step work banked before a mid-step pause is
+            # part of how long THIS step has really been running
+            open_ns = carry + telemetry.clock_ns() - last
+            if open_ns <= threshold:
+                continue
+            with self._lock:
+                # re-check against a beat/pause that landed between the
+                # first snapshot and here: firing on a stale interval
+                # would abort a HEALTHY driver that already moved on
+                # (e.g. into a paused checkpoint write)
+                if (self._fired_this_stall or self._paused or
+                        self._last_beat_ns != last):
+                    continue
+                self._fired_this_stall = True
+            self.fired += 1
+            self._fire(open_ns, threshold, last)
+
+    def _fire(self, open_ns: float, threshold_ns: float,
+              beat_snapshot) -> None:
+        from bigdl_tpu import telemetry
+        detect_ms = (open_ns - threshold_ns) / 1e6
+        logger.error(
+            "Hung step detected: current step open for %.1f ms "
+            "(> %.1f ms = %.1f x EMA); aborting to restore the newest "
+            "valid snapshot (watchdog fire %d this run)",
+            open_ns / 1e6, threshold_ns / 1e6, self.factor, self.fired)
+        telemetry.counter("Elastic/watchdog_fired",
+                          help="hung-step watchdog aborts").inc()
+        telemetry.gauge("Elastic/watchdog_detect_ms").set(detect_ms)
+        telemetry.instant("watchdog/hung_step",
+                          open_ms=round(open_ns / 1e6, 3),
+                          threshold_ms=round(threshold_ns / 1e6, 3))
+        if self.timeline_dir and telemetry.tracing_enabled():
+            try:
+                os.makedirs(str(self.timeline_dir), exist_ok=True)
+                telemetry.export_chrome_trace(os.path.join(
+                    str(self.timeline_dir), "watchdog_timeline.json"))
+            except Exception as e:  # diagnostics must not mask the abort
+                logger.warning("watchdog timeline dump failed: %r", e)
+        if self.on_fire is not None:
+            try:
+                self.on_fire(open_ns, threshold_ns)
+            except Exception as e:  # pragma: no cover - probe bug
+                logger.warning("watchdog on_fire callback failed: %r", e)
+        if self.abort and self._driver_tid is not None:
+            with self._lock:
+                if self._stop.is_set():
+                    # the run completed while this fire was in flight —
+                    # aborting a finished driver would turn a clean end
+                    # into a restore-and-retrain
+                    logger.info("hung-step abort suppressed: the run "
+                                "already completed")
+                    return
+                if self._paused or self._last_beat_ns != beat_snapshot:
+                    # the diagnostics above (timeline dump, file I/O)
+                    # take real time: a step that finished marginally
+                    # past threshold may have heartbeat (or entered a
+                    # paused phase) meanwhile — the driver is healthy
+                    # again, injecting now would abort the NEXT step
+                    logger.info("hung-step abort suppressed: the step "
+                                "completed during fire diagnostics")
+                    return
+                injected = _async_raise(self._driver_tid, HungStepError)
+            if not injected:
+                logger.error(
+                    "watchdog could not inject HungStepError into the "
+                    "driver thread (already exited?)")

