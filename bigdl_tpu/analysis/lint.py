@@ -67,6 +67,17 @@ Rules
     real time, and a handler swapped inside the loop can lose the one
     SIGTERM the scheduler will ever send.
 
+``unguarded-io-in-stage-thread``
+    In the ingest stage-thread file (``dataset/ingest.py``), raw file IO
+    — builtin ``open(...)`` / ``os.open`` / ``io.open`` / an
+    ``fsspec.open`` — anywhere in the module.  Stage threads re-raise at
+    the consumer, so an unguarded read that hits a transient storage
+    blip aborts the whole training run; every byte the pipeline touches
+    must route through ``utils.file_io`` (the capped-backoff retry +
+    chaos choke point) or ``dataset.seqfile`` (the corrupt-record
+    taxonomy + resync), or carry an explicit
+    ``# lint: allow(unguarded-io-in-stage-thread)``.
+
 Silencing: append ``# lint: allow(<rule-name>)`` to the offending line,
 or list ``<relpath>:<rule-name>`` in an allowlist file (one per line,
 ``#`` comments) — the CI gate keeps the repo allowlist empty, so every
@@ -105,6 +116,10 @@ FORWARD_FUNCS = {"apply", "init_hidden", "project_input", "step", "route",
 DTYPE_DROP_FACTORIES = {"zeros", "ones", "empty"}
 
 THREADED_FILES = (os.path.join("dataset", "ingest.py"), "engine.py")
+#: files whose threads feed the training loop: raw file IO here must
+#: route through utils.file_io / dataset.seqfile (retry + taxonomy)
+STAGE_THREAD_FILES = (os.path.join("dataset", "ingest.py"),)
+RAW_IO_QUALIFIERS = {"os", "io", "fsspec"}
 BLOCKING_METHODS = {"put", "get", "join", "wait", "sleep", "acquire"}
 #: receivers whose .put/.get actually block (queues/rings) — a dict .get
 #: or os.environ.get under a lock is not a handoff
@@ -311,6 +326,32 @@ def _rule_dtype_drop(path: str, rel: str, tree: ast.AST) -> List[Finding]:
             self.generic_visit(node)
 
     V().visit(tree)
+    return out
+
+
+def _rule_unguarded_io(path: str, rel: str, tree: ast.AST) -> List[Finding]:
+    """Raw ``open``-family calls in the ingest stage-thread file: stage
+    threads surface errors at the consumer, so a naked read that blips
+    kills the run instead of retrying — route through ``utils.file_io``
+    or ``dataset.seqfile``."""
+    if not any(rel.endswith(t) for t in STAGE_THREAD_FILES):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        qual = _qualifier(node)
+        raw = ((isinstance(node.func, ast.Name) and name == "open") or
+               (qual in RAW_IO_QUALIFIERS and name == "open"))
+        if raw:
+            out.append(Finding(
+                rel, node.lineno, "unguarded-io-in-stage-thread",
+                f"raw {qual + '.' if qual else ''}open(...) in ingest "
+                "stage-thread code — a transient storage blip here "
+                "aborts the training run; route the read through "
+                "utils.file_io (capped-backoff retry + chaos choke "
+                "point) or dataset.seqfile (corrupt-record taxonomy)"))
     return out
 
 
@@ -527,6 +568,7 @@ def lint_paths(targets: Sequence[str],
                          _rule_raw_clock(path, rel, tree) +
                          _rule_signal_handler(path, rel, tree) +
                          _rule_dtype_drop(path, rel, tree) +
+                         _rule_unguarded_io(path, rel, tree) +
                          _rule_exceptions(path, rel, tree))
         if any(rel.endswith(t) for t in THREADED_FILES):
             lv = _LockVisitor(rel)

@@ -37,6 +37,28 @@ per-shard streams (the reference's per-partition RNG model) — sync-path
 bit-parity is a single-engine contract, multi-shard runs are run-to-run
 deterministic.
 
+Self-healing contract (the part that makes this usable on dirty data at
+production scale): stage failures are CLASSIFIED.  *Data* faults — a
+corrupt/truncated SequenceFile record, an undecodable image, an
+undersized frame — skip the one offending record into a bounded
+:class:`RecordQuarantine` (``bigdl.ingest.maxBadRecords``; budget
+exceeded or budget 0 → fail loudly, with a sample of offenders), counted
+through the metrics registry (``Ingest/quarantined``) so silent data
+loss is impossible.  *Infrastructure* faults — a transient IO blip, a
+dead stage thread, a wedged ring — are retried/restarted: record reads
+run behind ``utils.file_io``'s capped-backoff transient retry, a
+:class:`_StageSupervisor` restarts a silently-dead reader/assembler
+thread (bounded by ``bigdl.ingest.maxStageRestarts``, then escalates to
+:class:`IngestInfraError`), and per-ring progress heartbeats detect a
+wedged handoff (``bigdl.ingest.stallTimeoutSec``) so the run aborts with
+per-stage diagnostics instead of hanging forever.  As graceful
+degradation, ``bigdl.ingest.fallbackOnFailure`` lets a supervisor-
+declared-dead engine finish the epoch on the synchronous path — same
+drawer RNG, so the batch stream continues bit-identically (modulo
+quarantined records).  All of it is provable on CPU via the chaos
+injectors (``bigdl.chaos.corruptRecordAt`` / ``failDecodeAt`` /
+``killStageThread`` / ``transientReads``, ``utils/chaos.py``).
+
 Configuration (``bigdl.ingest.*``, see ``utils/config.py``):
 
 ===============================  =============================================
@@ -46,6 +68,10 @@ Configuration (``bigdl.ingest.*``, see ``utils/config.py``):
 ``bigdl.ingest.decodedRingDepth``in-flight decode window (default 2x batch)
 ``bigdl.ingest.batchRingDepth``  assembled batches buffered ahead
 ``bigdl.ingest.batchesInFlight`` device uploads in flight (BatchPrefetcher)
+``bigdl.ingest.maxBadRecords``   data-error quarantine budget (0 = fail fast)
+``bigdl.ingest.maxStageRestarts``dead-stage restarts before escalation
+``bigdl.ingest.fallbackOnFailure`` dead engine → sync path mid-epoch
+``bigdl.ingest.stallTimeoutSec`` wedged-ring detection window (0 = off)
 ===============================  =============================================
 """
 
@@ -74,6 +100,273 @@ _NO_ITEM = object()      # try_get on an empty ring
 
 _NAME_LOCK = threading.Lock()
 _NAME_SEQ = [0]          # per-process engine naming (ingest0, ingest1, …)
+
+
+# ---------------------------------------------------------------------------
+# error taxonomy + quarantine
+# ---------------------------------------------------------------------------
+
+class IngestDataError(Exception):
+    """A fault in the DATA, not the machinery: corrupt/truncated record,
+    undecodable image, undersized frame.  Quarantinable — skipping the
+    one record is correct; retrying it is not (corrupt bytes stay
+    corrupt)."""
+
+    #: never absorbed by a transient-IO retry (``file_io._is_transient``)
+    fatal = True
+
+
+class IngestInfraError(RuntimeError):
+    """The ingest MACHINERY failed beyond its self-healing budget: a
+    stage thread died ``maxStageRestarts + 1`` times, or a ring wedged
+    past ``stallTimeoutSec``.  Carries the engine's last per-stage
+    ``stats()`` snapshot in ``diagnosis`` so the failure names the sick
+    stage, not just the symptom."""
+
+    def __init__(self, message: str, diagnosis: Optional[dict] = None):
+        super().__init__(message)
+        self.diagnosis = diagnosis or {}
+
+
+class IngestStallError(IngestInfraError):
+    """No ring made progress for ``bigdl.ingest.stallTimeoutSec`` while
+    the consumer was blocked waiting — a wedged handoff (dead producer +
+    blocked consumer), detected instead of hung."""
+
+
+class QuarantineExceededError(IngestInfraError):
+    """More data errors than ``bigdl.ingest.maxBadRecords`` allows: the
+    data set is dirtier than the operator budgeted for, and silently
+    skipping an unbounded stream of records would train on a different
+    distribution than requested.  The message carries a sample of the
+    offenders."""
+
+
+def _is_data_error(e: BaseException) -> bool:
+    """Data-vs-infrastructure classification shared by every stage."""
+    from bigdl_tpu.dataset.seqfile import CorruptRecordError
+    from bigdl_tpu.utils.chaos import CorruptRecord, UndecodableImage
+    return isinstance(e, (IngestDataError, CorruptRecordError,
+                          CorruptRecord, UndecodableImage))
+
+
+class _StageKilledError(RuntimeError):
+    """Chaos-injected silent death of a decode worker: an INFRA fault
+    the assembler answers by resubmitting the decode (bounded), never by
+    quarantining the record (its bytes are fine)."""
+
+
+class RecordQuarantine:
+    """Bounded sink for data-error records.
+
+    ``admit(stage, index, name, error)`` either swallows the fault
+    (budget remaining: count it, sample it, bump the registry counters)
+    or raises — the ORIGINAL error when the budget is 0 (quarantine
+    disabled: today's fail-fast contract, bit-parity with the sync
+    path), a :class:`QuarantineExceededError` naming a sample of
+    offenders when a nonzero budget runs out.  Thread-safe: read,
+    decode, and assemble stages admit concurrently."""
+
+    SAMPLE_MAX = 8
+
+    def __init__(self, budget: Optional[int] = None):
+        if budget is None:
+            budget = config.get_int("bigdl.ingest.maxBadRecords", 0)
+        self.budget = int(budget)
+        self.count = 0
+        self.by_stage: dict = {}
+        self.samples: List[dict] = []
+        self._lock = threading.Lock()
+
+    def admit(self, stage: str, index: Optional[int], name: Optional[str],
+              error: BaseException) -> None:
+        if self.budget <= 0:
+            raise error
+        with self._lock:
+            self.count += 1
+            self.by_stage[stage] = self.by_stage.get(stage, 0) + 1
+            if len(self.samples) < self.SAMPLE_MAX:
+                self.samples.append({
+                    "stage": stage, "index": index, "name": name,
+                    "error": repr(error)})
+            over = self.count > self.budget
+        telemetry.counter(
+            "Ingest/quarantined", summary=True,
+            help="data-error records skipped by the ingest quarantine"
+        ).inc()
+        telemetry.counter("Ingest/stage_errors", labels={"stage": stage},
+                          help="data errors observed per ingest stage").inc()
+        if over:
+            raise QuarantineExceededError(
+                f"ingest quarantine budget exhausted: {self.count} bad "
+                f"records > bigdl.ingest.maxBadRecords={self.budget}; "
+                f"offender sample: {self.samples}",
+                diagnosis={"quarantine": self.summary()}) from error
+        import logging
+        logging.getLogger("bigdl_tpu").warning(
+            "ingest quarantined record %s (%s) at stage %s: %r "
+            "[%d/%d budget]", index, name, stage, error, self.count,
+            self.budget)
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {"count": self.count, "budget": self.budget,
+                    "by_stage": dict(self.by_stage),
+                    "samples": list(self.samples)}
+
+
+class _StageSupervisor:
+    """Monitor for the engine's stage threads and ring heartbeats.
+
+    Each restartable stage registers a thread factory and a done flag;
+    the monitor polls: a thread that is dead with its done flag unset
+    (it neither finished nor surfaced an error — a silent crash) is
+    restarted from shared stage state, up to ``max_restarts`` times,
+    then the engine is DECLARED DEAD: ``failure`` is set and ``failed``
+    signaled so the blocked consumer wakes immediately.  With
+    ``stall_timeout`` > 0 the monitor also watches ring progress
+    heartbeats: no ring progressing while the consumer is blocked
+    waiting means a wedged handoff — declared dead with the per-stage
+    stats in the error instead of hanging forever."""
+
+    POLL_S = 0.02
+
+    def __init__(self, max_restarts: int, stall_timeout: float,
+                 diagnose, rings: Sequence["_Ring"],
+                 run_stats: Optional[dict] = None):
+        self.max_restarts = max(0, int(max_restarts))
+        self.stall_timeout = float(stall_timeout)
+        self._diagnose = diagnose          # () -> stats dict, for errors
+        #: THIS run's StageStats (progress source for the stall check —
+        #: the engine-wide diagnose merge would let a sibling shard
+        #: run's progress mask this run's wedge)
+        self._run_stats = run_stats or {}
+        self._rings = list(rings)
+        self._stages: dict = {}
+        self.failure: Optional[BaseException] = None
+        self.failed = threading.Event()
+        self.consumer_waiting_since: Optional[float] = None
+        self._last_items = -1
+        self._last_items_at: Optional[float] = None
+        self.restarts = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def register(self, name: str, factory, done_flag: List[bool]) -> None:
+        """Track a stage: ``factory()`` builds AND STARTS a replacement
+        thread (resuming from the stage's shared state); ``done_flag[0]``
+        is set by the stage on any orderly exit — completion or error
+        surfaced downstream — and gates restarts."""
+        self._stages[name] = {"factory": factory, "thread": factory(),
+                              "done": done_flag, "restarts": 0}
+
+    def thread(self, name: str) -> threading.Thread:
+        return self._stages[name]["thread"]
+
+    def declare_failed(self, error: BaseException) -> None:
+        with self._lock:
+            if self.failure is None:
+                self.failure = error
+        self.failed.set()
+
+    def count_restart(self, stage: str) -> None:
+        with self._lock:
+            self.restarts += 1
+        telemetry.counter(
+            "Ingest/stage_restarts", labels={"stage": stage},
+            help="dead ingest stage workers restarted by the "
+                 "supervisor").inc()
+
+    def start(self) -> "_StageSupervisor":
+        self._thread = threading.Thread(target=self._monitor, daemon=True,
+                                        name="ingest-supervisor")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+            self._thread = None
+
+    # -- monitor ----------------------------------------------------------
+
+    def _monitor(self) -> None:
+        import logging
+        logger = logging.getLogger("bigdl_tpu")
+        while not self._stop.wait(self.POLL_S):
+            if self.failure is not None:
+                return
+            try:
+                self._poll_once(logger)
+            except BaseException as e:
+                # the monitor must NEVER die silently: with it gone the
+                # consumer would block on sup.failed forever — exactly
+                # the hang this thread exists to prevent.  A failing
+                # restart factory (thread exhaustion) or diagnose call
+                # becomes an engine failure instead.
+                self.declare_failed(IngestInfraError(
+                    f"ingest supervisor failed: {e!r}"))
+                return
+            if self.failure is not None:
+                return
+
+    def _poll_once(self, logger) -> None:
+        for name, st in self._stages.items():
+            if st["done"][0] or st["thread"].is_alive():
+                continue
+            # dead without an orderly exit: a silent crash
+            if st["restarts"] >= self.max_restarts:
+                self.declare_failed(IngestInfraError(
+                    f"ingest stage '{name}' died "
+                    f"{st['restarts'] + 1} time(s) (restart budget "
+                    f"bigdl.ingest.maxStageRestarts="
+                    f"{self.max_restarts} exhausted)",
+                    diagnosis=self._diagnose()))
+                return
+            st["restarts"] += 1
+            self.count_restart(name)
+            logger.warning(
+                "ingest stage '%s' thread died silently — "
+                "restarting from shared stage state (%d/%d)",
+                name, st["restarts"], self.max_restarts)
+            st["thread"] = st["factory"]()
+        if self.stall_timeout > 0:
+            self._check_stall()
+
+    def _check_stall(self) -> None:
+        waiting = self.consumer_waiting_since
+        if waiting is None:
+            return
+        now = time.monotonic()
+        if now - waiting < self.stall_timeout:
+            return
+        newest = max((r.last_progress for r in self._rings),
+                     default=0.0)
+        if now - newest < self.stall_timeout:
+            return
+        # ring silence alone is not a wedge: a slow stage working a big
+        # item (a long assemble with full record ring + empty batch
+        # ring) heartbeats no ring but still COMPLETES items — consult
+        # THIS run's per-stage item counters before declaring death
+        # (this run's own stats, not the engine-wide merge: a sibling
+        # shard run's progress must not mask this run's wedge)
+        items = sum(s.items for s in self._run_stats.values())
+        if items != self._last_items or self._last_items_at is None:
+            self._last_items = items
+            self._last_items_at = now
+            return
+        if now - self._last_items_at < self.stall_timeout:
+            return
+        self.declare_failed(IngestStallError(
+            f"ingest wedged: no ring progressed for "
+            f"{now - newest:.1f}s and no stage completed an item for "
+            f"{now - self._last_items_at:.1f}s while the consumer was "
+            f"blocked (bigdl.ingest.stallTimeoutSec={self.stall_timeout});"
+            " per-stage stats in .diagnosis name the stuck handoff",
+            diagnosis=self._diagnose()))
 
 
 class StageStats:
@@ -139,12 +432,17 @@ class _Ring:
         self.q: "queue.Queue" = queue.Queue(maxsize=max(1, int(depth)))
         self._producer = producer
         self._consumer = consumer
+        #: progress heartbeat: monotonic time of the last successful
+        #: put/get — the stage supervisor's wedged-handoff signal and
+        #: the watchdog's stall diagnostic (ring age)
+        self.last_progress = time.monotonic()
 
     def put(self, item, stop: Optional[threading.Event]) -> bool:
         t0 = None
         while stop is None or not stop.is_set():
             try:
                 self.q.put(item, timeout=0.05)
+                self.last_progress = time.monotonic()
                 if t0 is not None and self._producer is not None:
                     self._producer.add(backpressure_s=time.monotonic() - t0)
                 if self._producer is not None:
@@ -162,6 +460,7 @@ class _Ring:
         while stop is None or not stop.is_set():
             try:
                 item = self.q.get(timeout=0.05)
+                self.last_progress = time.monotonic()
                 if t0 is not None and self._consumer is not None:
                     self._consumer.add(starve_s=time.monotonic() - t0)
                 return item
@@ -198,7 +497,8 @@ class ShardedSeqFileReader:
     for file k+1..k+shards overlap the consumer's handling of file k."""
 
     def __init__(self, path: str, shards: Optional[int] = None,
-                 ring_depth: Optional[int] = None):
+                 ring_depth: Optional[int] = None,
+                 quarantine: Optional[RecordQuarantine] = None):
         if os.path.isdir(path):
             self.files: List[str] = []
             for root, _, files in sorted(os.walk(path)):
@@ -212,13 +512,91 @@ class ShardedSeqFileReader:
         self.ring_depth = (ring_depth if ring_depth is not None
                            else config.get_int("bigdl.ingest.recordRingDepth", 256))
         self.stats = StageStats("seqfile_read")
+        #: data-error sink; None = build one per sweep from
+        #: ``bigdl.ingest.maxBadRecords`` (budget 0 keeps the historical
+        #: fail-fast: corrupt record -> IOError on the merge side)
+        self.quarantine = quarantine
+
+    def _file_records(self, path: str,
+                      quarantine: Optional[RecordQuarantine]) -> Iterator:
+        """One file's (name, label, data) records, self-healing: corrupt
+        records resync-skip into the quarantine (budget permitting), and
+        a TRANSIENT read failure re-opens the file and resumes after the
+        already-yielded prefix — the ``utils.file_io`` capped-backoff
+        policy applied to a streaming read (``file_io.retrying`` itself
+        wraps one call; a generator needs the resume)."""
+        from bigdl_tpu.dataset.seqfile import (CorruptRecordError,
+                                               read_image_seqfile,
+                                               read_image_seqfile_resilient)
+        from bigdl_tpu.utils import file_io
+
+        attempts = max(1, config.get_int("bigdl.io.retryTimes", 3))
+        base = config.get_float("bigdl.io.retryInterval", 0.1)
+        yielded = 0
+        attempt = 1
+        resilient = False    # fast native path until the FIRST corruption
+        # a transient failure REPLAYS the file from the top; corrupt
+        # records are deterministic, so the replay re-encounters skips
+        # already admitted — count events and admit only the new ones,
+        # or every replay would burn quarantine budget twice
+        skips = {"admitted": 0}
+        while True:
+            seen = 0
+            pass_start = yielded
+            pass_skips = [0]
+            try:
+                if resilient:
+                    def on_skip(err, resume):
+                        pass_skips[0] += 1
+                        if pass_skips[0] > skips["admitted"]:
+                            quarantine.admit("seqfile_read", None, path,
+                                             err)
+                            skips["admitted"] = pass_skips[0]
+                    src = read_image_seqfile_resilient(path,
+                                                       on_skip=on_skip)
+                else:
+                    src = read_image_seqfile(path)
+                for rec in src:
+                    seen += 1
+                    if seen <= yielded:
+                        continue     # replayed prefix after a retry
+                    yield rec
+                    yielded += 1
+                return
+            except CorruptRecordError as e:
+                if (resilient or quarantine is None or
+                        quarantine.budget <= 0):
+                    raise            # fail-fast contract (budget 0)
+                # dirty file discovered: replay through the resilient
+                # Python reader, which resyncs past the damage and
+                # admits each skip into the quarantine.  Clean files
+                # never pay for this — they stay on the native reader.
+                resilient = True
+            except Exception as e:
+                if yielded > pass_start:
+                    # this pass made fresh progress before failing: the
+                    # budget is per-blip, like file_io.retrying grants
+                    # it per operation — not per lifetime of the file
+                    attempt = 1
+                if attempt >= attempts or not file_io._is_transient(e):
+                    raise
+                delay = base * (2.0 ** (attempt - 1))
+                import logging
+                logging.getLogger("bigdl_tpu").warning(
+                    "transient seqfile read failure on %s (attempt "
+                    "%d/%d, resuming after record %d in %.2fs): %r",
+                    path, attempt, attempts, yielded, delay, e)
+                file_io._sleep(delay)
+                attempt += 1
 
     def __iter__(self) -> Iterator:
         from bigdl_tpu.dataset.image import LabeledImageBytes
-        from bigdl_tpu.dataset.seqfile import read_image_seqfile
 
         if not self.files:
             return
+        quarantine = (self.quarantine if self.quarantine is not None
+                      else RecordQuarantine())
+        self.last_quarantine = quarantine   # observable after the sweep
         n = min(self.shards, len(self.files))
         stop = threading.Event()
         rings = [_Ring(max(1, self.ring_depth // n), producer=self.stats)
@@ -229,8 +607,8 @@ class ShardedSeqFileReader:
             try:
                 for fi in range(si, len(self.files), n):
                     t0 = time.monotonic()
-                    for name, label, data in read_image_seqfile(
-                            self.files[fi]):
+                    for name, label, data in self._file_records(
+                            self.files[fi], quarantine):
                         t1 = time.monotonic()
                         self.stats.add(items=1, busy_s=t1 - t0)
                         telemetry.add_span_s("ingest/seqfile_read", t0, t1)
@@ -303,7 +681,11 @@ class StreamingIngest(Transformer):
                  decoded_ring_depth: Optional[int] = None,
                  batch_ring_depth: Optional[int] = None,
                  assemble_threads: Optional[int] = None,
-                 name: Optional[str] = None):
+                 name: Optional[str] = None,
+                 max_bad_records: Optional[int] = None,
+                 max_stage_restarts: Optional[int] = None,
+                 fallback_on_failure: Optional[bool] = None,
+                 stall_timeout: Optional[float] = None):
         if name is None:
             with _NAME_LOCK:
                 name = f"ingest{_NAME_SEQ[0]}"
@@ -331,17 +713,89 @@ class StreamingIngest(Transformer):
             batch_ring_depth if batch_ring_depth is not None
             else config.get_int("bigdl.ingest.batchRingDepth", 2))
         self.assemble_threads = assemble_threads or cores
+        self.max_bad_records = (
+            max_bad_records if max_bad_records is not None
+            else config.get_int("bigdl.ingest.maxBadRecords", 0))
+        self.max_stage_restarts = (
+            max_stage_restarts if max_stage_restarts is not None
+            else config.get_int("bigdl.ingest.maxStageRestarts", 2))
+        self.fallback_on_failure = (
+            fallback_on_failure if fallback_on_failure is not None
+            else config.get_bool("bigdl.ingest.fallbackOnFailure", False))
+        self.stall_timeout = (
+            stall_timeout if stall_timeout is not None
+            else config.get_float("bigdl.ingest.stallTimeoutSec", 0.0))
         # per-run stage stats: a ShardedDataSet applies ONE transformer
         # instance to every shard, so several runs can be live at once —
         # each run appends its own dict and stats() merges them
         self._active_stats: List[dict] = []
         self._last_stats: Optional[dict] = None
+        #: latest run's quarantine / supervisor, for diagnostics + tests;
+        #: _active_faults mirrors _active_stats (several shard runs can
+        #: be live at once — monitoring must SUM them, not report the
+        #: last-started run); run_history keeps a LIGHTWEIGHT summary
+        #: dict per finished run ({"quarantine": ..., "stage_restarts":
+        #: n}) so a multi-epoch soak can audit what epoch 1 quarantined
+        #: without pinning dead threads/rings for the engine's lifetime
+        self.quarantine: Optional[RecordQuarantine] = None
+        self.supervisor: Optional[_StageSupervisor] = None
+        self._active_faults: List[tuple] = []
+        self._last_faults: Optional[tuple] = None
+        self.run_history: List[dict] = []
+        self.fallbacks = 0
 
     # ---- diagnostics ----------------------------------------------------
 
     def has_active_run(self) -> bool:
         """True while at least one pipeline run of this engine is live."""
         return bool(self._active_stats)
+
+    def _fault_pairs(self) -> List[tuple]:
+        """(quarantine, supervisor) of every ACTIVE run, else the last
+        finished one — same merge contract as :meth:`stats`."""
+        pairs = list(self._active_faults)
+        if not pairs and self._last_faults is not None:
+            pairs = [self._last_faults]
+        return pairs
+
+    def quarantined_count(self) -> int:
+        """Data-error records skipped, summed over every active run."""
+        return sum(q.count for q, _ in self._fault_pairs())
+
+    def stage_restart_count(self) -> int:
+        return sum(s.restarts for _, s in self._fault_pairs())
+
+    def ring_ages(self) -> dict:
+        """Seconds since each ring last made progress — the freshest
+        (minimum) age across active runs, the wedged-handoff signal the
+        supervisor and the watchdog diagnostics read; empty before the
+        first run."""
+        now = time.monotonic()
+        out: dict = {}
+        for _, sup in self._fault_pairs():
+            for name, ring in zip(("record_ring", "batch_ring"),
+                                  sup._rings):
+                age = round(now - ring.last_progress, 3)
+                out[name] = min(out.get(name, age), age)
+        return out
+
+    def fault_stats(self) -> dict:
+        """Self-healing counters merged over the active runs (multi-
+        shard pipelines sum, like :meth:`stats`): quarantine summary,
+        stage restarts, fallbacks — the robustness sibling of
+        :meth:`stats`."""
+        pairs = self._fault_pairs()
+        quarantine = {"count": sum(q.count for q, _ in pairs),
+                      "samples": [s for q, _ in pairs
+                                  for s in q.samples]}
+        if len(pairs) == 1:
+            quarantine = pairs[0][0].summary()
+        return {
+            "quarantine": quarantine if pairs else {},
+            "stage_restarts": sum(s.restarts for _, s in pairs),
+            "fallbacks": self.fallbacks,
+            "ring_ages_s": self.ring_ages(),
+        }
 
     def stats(self) -> dict:
         """Per-stage snapshots: the merge of every ACTIVE run (multi-shard
@@ -377,18 +831,23 @@ class StreamingIngest(Transformer):
     # ---- the pipeline ---------------------------------------------------
 
     def __call__(self, it: Iterator) -> Iterator:
+        import logging
         from concurrent.futures import ThreadPoolExecutor
         from bigdl_tpu.dataset.mt_batch import (MTLabeledBGRImgToBatch,
                                                 _check_crop_fits,
                                                 assemble_batch,
                                                 assemble_batch_u8)
         from bigdl_tpu.dataset.sample import MiniBatch
+        from bigdl_tpu.utils import chaos, file_io
         from bigdl_tpu.utils.random_generator import RandomGenerator
 
+        logger = logging.getLogger("bigdl_tpu")
         stats = {name: StageStats(name)
                  for name in ("read", "decode", "assemble", "consume")}
         self._active_stats.append(stats)
         _LIVE.add(self)
+        quarantine = RecordQuarantine(self.max_bad_records)
+        self.quarantine = quarantine
 
         # the caller's stream is CLONED, not handed off: the assembler
         # draws from the clone in record order, and each batch carries the
@@ -438,139 +897,415 @@ class StreamingIngest(Transformer):
                                   thread_name_prefix="ingest-decode")
         ch, cw = self.crop
 
+        # shared stage state: everything a RESTARTED stage thread needs to
+        # resume exactly where its dead predecessor stopped (the chaos
+        # injector kills at the loop top, a consistent point) lives here,
+        # never in thread-local closure variables
+        rd = {"index": 0, "exhausted": False, "inhand": None}
+        rd_done = [False]        # orderly exit (completion or surfaced error)
+        asm = {"pending": deque(),   # (index, record, decode future) in order
+               "done": False,        # upstream exhausted / error queued
+               "aborted": False,     # teardown stop observed mid-wait
+               "imgs": [], "recs": [], "offsets": [], "flips": [],
+               "items": 0,           # records fully handled (chaos kill key)
+               "decode_restarts": 0}
+        asm_done = [False]
+
         def reader() -> None:
             """Pull upstream records into the record ring.  The upstream
             iterator draws no host RNG (crop/flip belongs to the assembler;
             reshuffles to the training driver's producer), so running it on
             its own thread keeps the single-drawer contract intact."""
             try:
-                t0 = time.monotonic()
-                for rec in it:
+                while True:
+                    if chaos.kill_stage_thread("reader", rd["index"]):
+                        return          # silent death — supervisor's job
+                    t0 = time.monotonic()
+                    try:
+                        rec = next(it)
+                    except StopIteration:
+                        rd["exhausted"] = True
+                        break
+                    idx, rd["index"] = rd["index"], rd["index"] + 1
+                    try:
+                        # transient blips retry with the file_io backoff;
+                        # data faults (fatal) pass straight through
+                        file_io.retrying(chaos.on_record_read, idx,
+                                         op="ingest record read")
+                    except BaseException as e:
+                        if _is_data_error(e):
+                            quarantine.admit("read", idx,
+                                             getattr(rec, "name", None), e)
+                            continue     # one record skipped, stream lives
+                        raise
                     t1 = time.monotonic()
                     stats["read"].add(items=1, busy_s=t1 - t0)
                     telemetry.add_span_s("ingest/read", t0, t1)
-                    if not record_ring.put(rec, stop):
+                    if not record_ring.put((idx, rec), stop):
+                        # teardown aborted the handoff: keep the in-hand
+                        # record so a fallback drain loses nothing
+                        rd["inhand"] = (idx, rec)
+                        rd_done[0] = True
                         return
-                    t0 = time.monotonic()
                 record_ring.put(_END, stop)
+                rd_done[0] = True
             except BaseException as e:  # surface downstream
                 record_ring.put(e, stop)
+                rd_done[0] = True
 
-        def timed_decode(data: bytes) -> np.ndarray:
+        def timed_decode(idx: int, data: bytes) -> np.ndarray:
+            if chaos.kill_stage_thread("decode", idx):
+                raise _StageKilledError(
+                    f"decode worker died at record {idx}")
             t0 = time.monotonic()
-            img = MTLabeledBGRImgToBatch._decode(data)
+            chaos.on_decode(idx)
+            try:
+                img = MTLabeledBGRImgToBatch._decode(data)
+            except Exception as e:
+                # junk bytes, not junk machinery: quarantinable
+                raise IngestDataError(
+                    f"undecodable image at stream position {idx}: "
+                    f"{e!r}") from e
             t1 = time.monotonic()
             stats["decode"].add(items=1, busy_s=t1 - t0)
             telemetry.add_span_s("ingest/decode", t0, t1)
             return img
 
+        def fill(block: bool) -> None:
+            """Top up the in-flight decode window.  Blocking only when
+            the window is empty keeps the assembler from stalling on a
+            slow upstream while it still has decoded work to pack."""
+            pending = asm["pending"]
+            while not asm["done"] and len(pending) < self.decoded_ring_depth:
+                item = (record_ring.get(stop) if block and not pending
+                        else record_ring.try_get())
+                if item is _NO_ITEM:
+                    if block and not pending:
+                        # stop was set mid-get: TEARDOWN, not upstream
+                        # completion — the fallback drain must still see
+                        # the remaining upstream records
+                        asm["aborted"] = True
+                    return
+                if item is _END:
+                    asm["done"] = True
+                    return
+                if isinstance(item, BaseException):
+                    asm["done"] = True
+                    pending.append((None, None, item))
+                    return
+                idx, rec = item
+                pending.append((idx, rec,
+                                pool.submit(timed_decode, idx, rec.bytes)))
+
+        def pack_batch() -> Tuple["MiniBatch", int, float]:
+            """The ONE batch-packing path (native assemble + labels)
+            over the shared lists — pipelined emit and fallback emit
+            both call it, so they can never drift apart.  Returns the
+            batch plus (record count, pack seconds); the CALLER accounts
+            the stats once the batch is actually handed off — a pack
+            discarded by a teardown-aborted ring put (the fallback
+            re-packs it) must not be counted twice."""
+            imgs, recs = asm["imgs"], asm["recs"]
+            t0 = time.monotonic()
+            offs = np.asarray(asm["offsets"], np.int32).reshape(len(imgs), 2)
+            fl = np.asarray(asm["flips"], np.uint8)
+            if self.device_normalize:
+                x = assemble_batch_u8(imgs, self.crop, offs, fl,
+                                      n_threads=self.assemble_threads)
+            else:
+                x = assemble_batch(imgs, self.crop, offs, fl,
+                                   self.mean, self.std,
+                                   n_threads=self.assemble_threads)
+            y = np.asarray([r.label for r in recs], np.float32)
+            t1 = time.monotonic()
+            # the span records the pack that really happened (a second
+            # pack after an aborted handoff is a real event on the
+            # timeline); the STATS are the caller's, on handoff only
+            telemetry.add_span_s("ingest/assemble", t0, t1,
+                                 {"batch": len(imgs)})
+            return MiniBatch(x, y), len(imgs), t1 - t0
+
+        def admit_and_append(idx: int, rec, img) -> bool:
+            """Crop-fit check (quarantinable), crop/flip draws in strict
+            record order — the same draw sequence MTLabeledBGRImgToBatch
+            makes — and append to the shared batch lists.  False when
+            the record was quarantined (no RNG drawn: the surviving
+            stream's draws equal the sync path's over the survivors).
+            Shared by the assembler thread and the fallback drain."""
+            try:
+                _check_crop_fits(
+                    [img], self.crop,
+                    describe=lambda _i: (
+                        f"StreamingIngest: record {len(asm['imgs'])} of "
+                        f"the current batch (label {rec.label})"))
+            except ValueError as e:
+                quarantine.admit("assemble", idx, rec.name, e)
+                return False
+            h, w = img.shape[:2]
+            if self.random_crop:
+                oy = drawer.random_int(0, h - ch + 1)
+                ox = drawer.random_int(0, w - cw + 1)
+            else:
+                oy, ox = (h - ch) // 2, (w - cw) // 2
+            fl = int(drawer.uniform() < 0.5) if self.hflip else 0
+            asm["imgs"].append(img if img.ndim == 3 else img[:, :, None])
+            asm["recs"].append(rec)
+            asm["offsets"].append((oy, ox))
+            asm["flips"].append(fl)
+            return True
+
+        def emit() -> bool:
+            batch, n, pack_s = pack_batch()
+            ok = batch_ring.put((batch, drawer.np.get_state()), stop)
+            if ok:
+                stats["assemble"].add(items=n, busy_s=pack_s)
+                # on a teardown-aborted put the DRAWN batch stays in the
+                # shared lists: the fallback drain re-emits it with its
+                # already-drawn offsets/flips instead of losing it
+                for key in ("imgs", "recs", "offsets", "flips"):
+                    asm[key].clear()
+            return ok
+
         def assembler() -> None:
-            pending: "deque" = deque()   # (record, decode future), in order
-            done = [False]
-
-            def fill(block: bool) -> None:
-                """Top up the in-flight decode window.  Blocking only when
-                the window is empty keeps the assembler from stalling on a
-                slow upstream while it still has decoded work to pack."""
-                while not done[0] and len(pending) < self.decoded_ring_depth:
-                    rec = (record_ring.get(stop) if block and not pending
-                           else record_ring.try_get())
-                    if rec is _NO_ITEM:
-                        if block and not pending:
-                            done[0] = True    # stop was set mid-get
-                        return
-                    if rec is _END:
-                        done[0] = True
-                        return
-                    if isinstance(rec, BaseException):
-                        done[0] = True
-                        pending.append((None, rec))
-                        return
-                    pending.append((rec, pool.submit(timed_decode,
-                                                     rec.bytes)))
-
-            imgs: List[np.ndarray] = []
-            recs: List = []
-            offsets: List[Tuple[int, int]] = []
-            flips: List[int] = []
-
-            def emit() -> bool:
-                t0 = time.monotonic()
-                offs = np.asarray(offsets, np.int32).reshape(len(imgs), 2)
-                fl = np.asarray(flips, np.uint8)
-                if self.device_normalize:
-                    x = assemble_batch_u8(imgs, self.crop, offs, fl,
-                                          n_threads=self.assemble_threads)
-                else:
-                    x = assemble_batch(imgs, self.crop, offs, fl,
-                                       self.mean, self.std,
-                                       n_threads=self.assemble_threads)
-                y = np.asarray([r.label for r in recs], np.float32)
-                t1 = time.monotonic()
-                stats["assemble"].add(items=len(imgs), busy_s=t1 - t0)
-                telemetry.add_span_s("ingest/assemble", t0, t1,
-                                     {"batch": len(imgs)})
-                ok = batch_ring.put(
-                    (MiniBatch(x, y), drawer.np.get_state()), stop)
-                imgs.clear(), recs.clear(), offsets.clear(), flips.clear()
-                return ok
-
+            pending = asm["pending"]
+            imgs = asm["imgs"]
             try:
                 while True:
+                    if chaos.kill_stage_thread("assembler", asm["items"]):
+                        return          # silent death — supervisor's job
                     fill(block=True)
+                    if asm["aborted"]:
+                        asm_done[0] = True   # orderly teardown exit
+                        return
                     if not pending:
                         break
-                    rec, fut = pending.popleft()
+                    idx, rec, fut = pending.popleft()
                     if rec is None:      # upstream error, in order
                         raise fut
-                    if fut.done():
-                        img = fut.result()
-                    else:                # wait-on-decode = assemble starve
-                        t0 = time.monotonic()
-                        img = fut.result()
-                        stats["assemble"].add(
-                            starve_s=time.monotonic() - t0)
+                    try:
+                        if fut.done():
+                            img = fut.result()
+                        else:            # wait-on-decode = assemble starve
+                            t0 = time.monotonic()
+                            img = fut.result()
+                            stats["assemble"].add(
+                                starve_s=time.monotonic() - t0)
+                    except _StageKilledError as e:
+                        # a dead decode WORKER is infrastructure: the
+                        # record's bytes are fine — resubmit the decode,
+                        # bounded like any stage restart
+                        if asm["decode_restarts"] >= self.max_stage_restarts:
+                            raise IngestInfraError(
+                                "ingest decode worker died and the "
+                                "restart budget (bigdl.ingest."
+                                f"maxStageRestarts={self.max_stage_restarts}"
+                                ") is exhausted",
+                                diagnosis=self.stats()) from e
+                        asm["decode_restarts"] += 1
+                        sup.count_restart("decode")
+                        logger.warning(
+                            "ingest decode worker died on record %d — "
+                            "resubmitting (%d/%d)", idx,
+                            asm["decode_restarts"], self.max_stage_restarts)
+                        pending.appendleft(
+                            (idx, rec, pool.submit(timed_decode, idx,
+                                                   rec.bytes)))
+                        continue
+                    except BaseException as e:
+                        if _is_data_error(e):
+                            # skipped BEFORE any RNG draw: the surviving
+                            # stream's draw sequence equals the sync
+                            # path's over the surviving records
+                            quarantine.admit("decode", idx, rec.name, e)
+                            asm["items"] += 1
+                            continue
+                        raise
                     fill(block=False)    # decode of the NEXT batch proceeds
-                    _check_crop_fits(
-                        [img], self.crop,
-                        describe=lambda _i: (
-                            f"StreamingIngest: record {len(imgs)} of the "
-                            f"current batch (label {rec.label})"))
-                    # crop/flip draws in strict record order — the same
-                    # draw sequence MTLabeledBGRImgToBatch makes, just
-                    # without the batch barrier
-                    h, w = img.shape[:2]
-                    if self.random_crop:
-                        oy = drawer.random_int(0, h - ch + 1)
-                        ox = drawer.random_int(0, w - cw + 1)
-                    else:
-                        oy, ox = (h - ch) // 2, (w - cw) // 2
-                    fl = int(drawer.uniform() < 0.5) if self.hflip else 0
-                    imgs.append(img if img.ndim == 3 else img[:, :, None])
-                    recs.append(rec)
-                    offsets.append((oy, ox))
-                    flips.append(fl)
+                    appended = admit_and_append(idx, rec, img)
+                    asm["items"] += 1
+                    if not appended:
+                        continue
                     if len(imgs) == self.batch_size:
                         if not emit():
+                            asm_done[0] = True
                             return
                 if imgs:
                     if not emit():
+                        asm_done[0] = True
                         return
                 batch_ring.put(_END, stop)
+                asm_done[0] = True
             except BaseException as e:  # surface at the consumer
                 batch_ring.put(e, stop)
+                asm_done[0] = True
 
-        reader_t = threading.Thread(target=reader, daemon=True,
-                                    name="ingest-reader")
-        asm_t = threading.Thread(target=assembler, daemon=True,
-                                 name="ingest-assembler")
-        reader_t.start()
-        asm_t.start()
+        def _thread_factory(fn, tname):
+            def factory():
+                t = threading.Thread(target=fn, daemon=True, name=tname)
+                t.start()
+                return t
+            return factory
+
+        sup = _StageSupervisor(self.max_stage_restarts, self.stall_timeout,
+                               diagnose=self.stats,
+                               rings=[record_ring, batch_ring],
+                               run_stats=stats)
+        self.supervisor = sup
+        sup.register("reader", _thread_factory(reader, "ingest-reader"),
+                     rd_done)
+        sup.register("assembler",
+                     _thread_factory(assembler, "ingest-assembler"),
+                     asm_done)
+        sup.start()
+        fault_pair = (quarantine, sup)
+        self._active_faults.append(fault_pair)
+
+        def _sync_record_source() -> Iterator:
+            """Leftover + remaining records for the fallback drain, in
+            exact stream order: the assembler's in-flight window, then
+            the record ring, then the (single-threaded, chaos-gated)
+            remainder of the upstream iterator."""
+            upstream_done = asm["done"] or rd["exhausted"]
+            upstream_err = None
+            for idx, rec, _fut in asm["pending"]:
+                if rec is None:
+                    upstream_err = _fut
+                    upstream_done = True
+                    break
+                yield idx, rec
+            asm["pending"].clear()
+            while upstream_err is None:
+                item = record_ring.try_get()
+                if item is _NO_ITEM:
+                    break
+                if item is _END:
+                    upstream_done = True
+                    break
+                if isinstance(item, BaseException):
+                    upstream_err = item
+                    break
+                yield item
+            if upstream_err is None and rd["inhand"] is not None:
+                # the record the reader held when teardown aborted its
+                # ring put — after everything it already handed off
+                yield rd["inhand"]
+                rd["inhand"] = None
+            while upstream_err is None and not upstream_done:
+                try:
+                    rec = next(it)
+                except StopIteration:
+                    break
+                idx, rd["index"] = rd["index"], rd["index"] + 1
+                try:
+                    file_io.retrying(chaos.on_record_read, idx,
+                                     op="ingest record read")
+                except BaseException as e:
+                    if _is_data_error(e):
+                        quarantine.admit("read", idx,
+                                         getattr(rec, "name", None), e)
+                        continue
+                    raise
+                stats["read"].add(items=1)
+                yield idx, rec
+            if upstream_err is not None:
+                raise upstream_err
+
+        def _fallback_tail(err: BaseException) -> Iterator:
+            """Finish the epoch on the synchronous path: same drawer RNG,
+            same quarantine, no stage threads — the batch stream
+            continues bit-identically to an uninterrupted run (modulo
+            quarantined records).  Only safe once every stage thread is
+            verifiably dead (a live reader still owns the upstream
+            iterator); otherwise the original failure re-raises."""
+            self.fallbacks += 1
+            telemetry.counter(
+                "Ingest/fallbacks", summary=True,
+                help="mid-epoch switches to the synchronous ingest path"
+            ).inc()
+            logger.warning(
+                "ingest engine '%s' declared dead (%s) — falling back to "
+                "the synchronous path mid-epoch; per-stage stats: %s",
+                self.name, err, self.stats())
+            sup.stop()
+            stop.set()
+            for tname in ("reader", "assembler"):
+                sup.thread(tname).join(timeout=5)
+            if any(sup.thread(n).is_alive()
+                   for n in ("reader", "assembler")):
+                logger.error(
+                    "ingest fallback impossible: a stage thread is still "
+                    "alive and owns the upstream iterator")
+                raise err
+            # completed batches already in the ring are valid drawn work:
+            # deliver them (committing their RNG positions) before
+            # continuing from the first unassembled record
+            while True:
+                item = batch_ring.try_get()
+                if item is _NO_ITEM or item is _END:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                batch, rng_state = item
+                if primary:
+                    shared_rng.np.set_state(rng_state)
+                stats["consume"].add(items=1)
+                yield batch
+
+            def emit_sync():
+                # same pack as the pipelined emit(); consumption == the
+                # yield itself, so the RNG position commits here
+                batch, n, pack_s = pack_batch()
+                stats["assemble"].add(items=n, busy_s=pack_s)
+                for key in ("imgs", "recs", "offsets", "flips"):
+                    asm[key].clear()
+                if primary:
+                    shared_rng.np.set_state(drawer.np.get_state())
+                stats["consume"].add(items=1)
+                return batch
+
+            if len(asm["imgs"]) >= self.batch_size:
+                # a fully-drawn batch whose ring put was aborted by the
+                # teardown: emit it before touching new records
+                yield emit_sync()
+
+            for idx, rec in _sync_record_source():
+                try:
+                    img = timed_decode(idx, rec.bytes)
+                except BaseException as e:
+                    if _is_data_error(e):
+                        quarantine.admit("decode", idx, rec.name, e)
+                        continue
+                    raise
+                if not admit_and_append(idx, rec, img):
+                    continue
+                if len(asm["imgs"]) == self.batch_size:
+                    yield emit_sync()
+            if asm["imgs"]:
+                yield emit_sync()
+
         try:
             while True:
                 # blocked time inside get() is charged to consume.starve_s
-                # by the ring itself
-                item = batch_ring.get(None)
+                # by the ring itself; the failure event doubles as the
+                # stop so a supervisor escalation wakes this wait at once
+                sup.consumer_waiting_since = time.monotonic()
+                item = batch_ring.get(sup.failed)
+                sup.consumer_waiting_since = None
+                if item is _NO_ITEM:
+                    # the supervisor declared the engine dead
+                    err = sup.failure or IngestInfraError(
+                        "ingest engine failed", diagnosis=self.stats())
+                    telemetry.counter(
+                        "Ingest/engine_failures", summary=True,
+                        help="ingest engines declared dead by the "
+                             "supervisor").inc()
+                    if self.fallback_on_failure:
+                        yield from _fallback_tail(err)
+                        return
+                    logger.error(
+                        "ingest engine '%s' declared dead: %s; per-stage "
+                        "stats: %s", self.name, err, self.stats())
+                    raise err
                 if item is _END:
                     return
                 if isinstance(item, BaseException):
@@ -590,14 +1325,27 @@ class StreamingIngest(Transformer):
                     del self._active_stats[i]
                     break
             self._last_stats = stats
+            for i, pair in enumerate(self._active_faults):
+                if pair is fault_pair:
+                    del self._active_faults[i]
+                    break
+            self._last_faults = fault_pair
+            self.run_history.append({
+                "quarantine": quarantine.summary(),
+                "stage_restarts": sup.restarts})
+            sup.stop()          # no restarts while tearing down
             stop.set()
             # cancel queued decodes so teardown never waits on work whose
             # output nobody will read (mirrors the MT transformer fix)
             pool.shutdown(wait=False, cancel_futures=True)
             for ring in (record_ring, batch_ring):
                 ring.drain()
-            reader_t.join(timeout=5)
-            asm_t.join(timeout=5)
+            # a declared-dead engine's threads are dead or wedged beyond
+            # recovery (that is WHY it was declared dead): don't spend
+            # the full grace join on a thread that provably won't exit
+            grace = 0.5 if sup.failure is not None else 5
+            sup.thread("reader").join(timeout=grace)
+            sup.thread("assembler").join(timeout=grace)
             # a final put can land between the first drain and the join —
             # drain again so no full batch stays pinned in the ring
             for ring in (record_ring, batch_ring):
@@ -622,10 +1370,35 @@ def summary_scalars():
             if snap["mean_queue_depth"]:
                 out.append((f"{prefix}/{stage}/queue_depth",
                             snap["mean_queue_depth"]))
+        # self-healing series surface only once they are nonzero: a
+        # clean run's charts stay exactly as before.  Summed over every
+        # ACTIVE run — a multi-shard pipeline must not report just the
+        # last-started shard's counters
+        quarantined = eng.quarantined_count()
+        if quarantined:
+            out.append((f"{prefix}/quarantined", quarantined))
+        restarts = eng.stage_restart_count()
+        if restarts:
+            out.append((f"{prefix}/stage_restarts", restarts))
     return out
+
+
+def _stall_diagnostics() -> dict:
+    """Per-engine stats + ring ages for the hung-step watchdog: when a
+    driver stall traces back to a wedged data pipeline, the fire log
+    names the stage instead of just the symptom."""
+    return {eng.name: {"stats": eng.stats(), "faults": eng.fault_stats()}
+            for eng in sorted(_LIVE, key=lambda e: e.name)
+            if eng.has_active_run()}
 
 
 # the engine's scalars flow through the telemetry registry's single flush
 # path: the driver's one emission loop pulls this provider instead of
 # special-casing the ingest module (tags unchanged — Ingest/<name>/...)
 telemetry.REGISTRY.register_provider("ingest", summary_scalars)
+
+# the hung-step watchdog reports these with every fire: "the step hung"
+# arrives with "which ring is stale and which stage died" attached
+from bigdl_tpu.utils import elastic as _elastic  # noqa: E402
+
+_elastic.register_stall_diagnostic("ingest", _stall_diagnostics)

@@ -165,7 +165,8 @@ class MTLabeledBGRImgToBatch(Transformer):
                  std: Sequence[float] = (1.0, 1.0, 1.0),
                  random_crop: bool = True, hflip: bool = True,
                  n_threads: Optional[int] = None,
-                 device_normalize: bool = False):
+                 device_normalize: bool = False,
+                 rng=None):
         import os
         self.batch_size = batch_size
         self.crop = crop
@@ -176,6 +177,11 @@ class MTLabeledBGRImgToBatch(Transformer):
         # leave (x - mean)/std to an nn.ChannelNormalize module on device —
         # quarters the host->device bytes (the TPU-first ingest layout)
         self.device_normalize = device_normalize
+        # rng: draw crop/flip from THIS RandomGenerator instead of the
+        # calling thread's stream — the single-drawer contract made
+        # explicit, so a mid-epoch fallback (or a parity oracle) can
+        # continue another pipeline's drawer at its exact position
+        self._rng = rng
 
     @staticmethod
     def _decode(data: bytes) -> np.ndarray:
@@ -202,7 +208,7 @@ class MTLabeledBGRImgToBatch(Transformer):
         from bigdl_tpu.dataset.sample import MiniBatch
         from bigdl_tpu.utils.random_generator import RandomGenerator
 
-        rng = RandomGenerator.RNG()
+        rng = self._rng if self._rng is not None else RandomGenerator.RNG()
         ch, cw = self.crop
         pool = ThreadPoolExecutor(self.n_threads)
         try:

@@ -88,60 +88,178 @@ def _read_text(f) -> bytes:
     return f.read(n)
 
 
+class CorruptRecordError(IOError):
+    """A structurally corrupt SequenceFile record (bad length field,
+    short read, bad sync marker).  Carries the byte offset of the record
+    whose framing broke plus its 0-based record index, so a resilient
+    reader can :func:`find_next_sync` past the damage and skip ONE
+    record's worth of bytes instead of abandoning the whole shard."""
+
+    #: corrupt bytes re-read as corrupt bytes: a transient-IO retry
+    #: (``utils.file_io``) must never absorb this as a blip
+    fatal = True
+
+    def __init__(self, path: str, offset: int, record_index: int,
+                 detail: str = "corrupt record"):
+        super().__init__(
+            f"corrupt SequenceFile record {record_index} at offset "
+            f"{offset} in {path}: {detail}")
+        self.path = path
+        self.offset = int(offset)
+        self.record_index = int(record_index)
+
+
+def _read_header(f, path: str) -> bytes:
+    """Consume the SequenceFile header, returning the file's sync
+    marker; the stream is left positioned at the first record."""
+    if f.read(3) != b"SEQ":
+        raise IOError(f"{path} is not a SequenceFile")
+    version = f.read(1)[0]
+    if version < 5:
+        raise IOError(f"unsupported SequenceFile version {version}")
+    _read_text(f)            # key class
+    _read_text(f)            # value class
+    compressed, block = f.read(1)[0], f.read(1)[0]
+    if compressed or block:
+        raise IOError("compressed SequenceFiles are unsupported")
+    (meta,) = struct.unpack(">i", f.read(4))
+    for _ in range(meta):
+        _read_text(f)
+        _read_text(f)
+    return f.read(16)
+
+
+def _py_read_from(f, path: str, sync: bytes, cap: int, start_index: int
+                  ) -> Iterator[Tuple[bytes, bytes]]:
+    """Record loop shared by the plain and resilient Python readers;
+    ``f`` is positioned at a record boundary.  Corruption raises
+    :class:`CorruptRecordError` carrying the record's offset + index."""
+    index = start_index
+    while True:
+        rec_off = f.tell()
+        raw = f.read(4)
+        if not raw:          # clean EOF: zero bytes at a boundary
+            return
+        if len(raw) < 4:     # cut inside the length field
+            raise CorruptRecordError(path, rec_off, index,
+                                     "truncated length field")
+        (rec_len,) = struct.unpack(">i", raw)
+        if rec_len == -1:
+            marker = f.read(16)
+            if marker != sync:
+                # includes a SHORT read: a file cut inside the sync
+                # escape is truncation, not clean EOF (the native
+                # reader agrees, native/seqfile.cc)
+                raise CorruptRecordError(path, rec_off, index,
+                                         "bad sync marker")
+            continue
+        # sanity cap (see module docstring): a flipped length byte
+        # must not become a giant read or a silent short record
+        if rec_len < 0 or rec_len > cap:
+            raise CorruptRecordError(
+                path, rec_off, index,
+                f"implausible record length {rec_len} (cap {cap})")
+        raw_kl = f.read(4)
+        if len(raw_kl) < 4:
+            raise CorruptRecordError(path, rec_off, index,
+                                     "truncated key-length field")
+        (key_len,) = struct.unpack(">i", raw_kl)
+        if key_len < 0 or key_len > rec_len:
+            raise CorruptRecordError(
+                path, rec_off, index,
+                f"key length {key_len} outside record length {rec_len}")
+        key = f.read(key_len)
+        value = f.read(rec_len - key_len)
+        if len(key) != key_len or len(value) != rec_len - key_len:
+            raise CorruptRecordError(path, rec_off, index,
+                                     "record body truncated")
+        yield key, value
+        index += 1
+
+
 def py_read_records(path: str, max_record_bytes: Optional[int] = None
                     ) -> Iterator[Tuple[bytes, bytes]]:
     """(key, value) byte pairs from an uncompressed SequenceFile.
 
     ``max_record_bytes`` overrides the module-level ``MAX_RECORD_BYTES``
-    corruption cap for files with legitimately huge records."""
+    corruption cap for files with legitimately huge records.  A corrupt
+    mid-file record raises :class:`CorruptRecordError` naming the byte
+    offset and record index (see :func:`read_records_resilient` for the
+    skip-and-continue reader built on it)."""
     cap = MAX_RECORD_BYTES if max_record_bytes is None else max_record_bytes
     with open(path, "rb") as f:
-        if f.read(3) != b"SEQ":
-            raise IOError(f"{path} is not a SequenceFile")
-        version = f.read(1)[0]
-        if version < 5:
-            raise IOError(f"unsupported SequenceFile version {version}")
-        _read_text(f)            # key class
-        _read_text(f)            # value class
-        compressed, block = f.read(1)[0], f.read(1)[0]
-        if compressed or block:
-            raise IOError("compressed SequenceFiles are unsupported")
-        (meta,) = struct.unpack(">i", f.read(4))
-        for _ in range(meta):
-            _read_text(f)
-            _read_text(f)
-        sync = f.read(16)
+        sync = _read_header(f, path)
+        yield from _py_read_from(f, path, sync, cap, 0)
+
+
+def find_next_sync(path: str, offset: int,
+                   sync: Optional[bytes] = None) -> Optional[int]:
+    """Byte offset of the first sync escape (``-1`` length + the file's
+    16-byte sync marker) at or after ``offset``, or ``None`` when no
+    further marker exists.  The resync primitive: a reader that hit a
+    corrupt record at offset ``o`` scans from ``o + 1`` and resumes on a
+    known record boundary, losing only the records between the damage
+    and the marker (the Hadoop recovery semantic) instead of the whole
+    shard."""
+    with open(path, "rb") as f:
+        if sync is None:
+            sync = _read_header(f, path)
+        needle = struct.pack(">i", -1) + sync
+        pos = max(0, int(offset))
+        f.seek(pos)
+        chunk_size = 1 << 20
+        carry = b""
         while True:
-            raw = f.read(4)
-            if not raw:          # clean EOF: zero bytes at a boundary
+            chunk = f.read(chunk_size)
+            if not chunk:
+                return None
+            buf = carry + chunk
+            hit = buf.find(needle)
+            if hit != -1:
+                return pos - len(carry) + hit
+            # keep a needle-sized tail so a marker split across chunk
+            # boundaries is still found
+            carry = buf[-(len(needle) - 1):]
+            pos = f.tell()
+
+
+def read_records_resilient(path: str, on_skip=None,
+                           max_record_bytes: Optional[int] = None
+                           ) -> Iterator[Tuple[bytes, bytes]]:
+    """(key, value) pairs, skipping past structurally corrupt records.
+
+    Where :func:`py_read_records` raises :class:`CorruptRecordError`,
+    this reader calls ``on_skip(err, resume_offset)`` (resume_offset is
+    None when no later sync marker exists) and continues from the next
+    sync marker — the quarantine path's shard reader.  ``on_skip`` may
+    itself raise to convert a skip into a hard failure (budget
+    exhaustion).  Without sync markers between the damage and EOF the
+    remainder of the file is unrecoverable and iteration ends after the
+    ``on_skip`` callback.
+
+    Always the Python implementation: the native reader neither reports
+    offsets nor resumes mid-file."""
+    cap = MAX_RECORD_BYTES if max_record_bytes is None else max_record_bytes
+    with open(path, "rb") as f:
+        sync = _read_header(f, path)
+        index = 0
+        while True:
+            gen = _py_read_from(f, path, sync, cap, index)
+            try:
+                for key, value in gen:
+                    index += 1
+                    yield key, value
                 return
-            if len(raw) < 4:     # cut inside the length field
-                raise IOError(f"corrupt SequenceFile record in {path}")
-            (rec_len,) = struct.unpack(">i", raw)
-            if rec_len == -1:
-                marker = f.read(16)
-                if marker != sync:
-                    # includes a SHORT read: a file cut inside the sync
-                    # escape is truncation, not clean EOF (the native
-                    # reader agrees, native/seqfile.cc)
-                    raise IOError(
-                        f"corrupt SequenceFile: bad sync marker in {path}")
-                continue
-            # sanity cap (see module docstring): a flipped length byte
-            # must not become a giant read or a silent short record
-            if rec_len < 0 or rec_len > cap:
-                raise IOError(f"corrupt SequenceFile record in {path}")
-            raw_kl = f.read(4)
-            if len(raw_kl) < 4:
-                raise IOError(f"corrupt SequenceFile record in {path}")
-            (key_len,) = struct.unpack(">i", raw_kl)
-            if key_len < 0 or key_len > rec_len:
-                raise IOError(f"corrupt SequenceFile record in {path}")
-            key = f.read(key_len)
-            value = f.read(rec_len - key_len)
-            if len(key) != key_len or len(value) != rec_len - key_len:
-                raise IOError(f"corrupt SequenceFile record in {path}")
-            yield key, value
+            except CorruptRecordError as e:
+                if on_skip is None:
+                    raise   # resilience needs an observer: silent loss is
+                            # exactly what the quarantine exists to prevent
+                resume = find_next_sync(path, e.offset + 1, sync)
+                on_skip(e, resume)
+                if resume is None:
+                    return
+                f.seek(resume)
+                index = e.record_index  # unknown true count; best effort
 
 
 def py_write_records(path: str, records, key_class: str = "org.apache.hadoop.io.Text",
@@ -201,7 +319,18 @@ def read_records(path: str, max_record_bytes: Optional[int] = None
             if rc == 0:
                 return
             if rc < 0:
-                raise IOError(f"corrupt SequenceFile {path}")
+                # the native reader knows only "corrupt"; replay through
+                # the Python reader to name the exact offset and record
+                # index (cold path — a corrupt shard aborts the sweep
+                # anyway, the second pass costs nothing that matters)
+                for _ in py_read_records(path, max_record_bytes=cap):
+                    pass
+                err = IOError(
+                    f"corrupt SequenceFile {path} (native reader failed "
+                    "but the Python replay read it clean — native/python "
+                    "disagreement, check MAX_RECORD_BYTES)")
+                err.fatal = True   # permanent: a transient-IO retry
+                raise err          # would just re-read the shard twice
             yield (ctypes.string_at(key_p, klen.value),
                    ctypes.string_at(val_p, vlen.value))
     finally:
@@ -264,3 +393,27 @@ def read_image_seqfile(path: str) -> Iterator[Tuple[str, float, bytes]]:
         name, _, label = text.rpartition(" ")
         (n,) = struct.unpack(">i", value[:4])
         yield name, float(label), value[4:4 + n]
+
+
+def read_image_seqfile_resilient(path: str, on_skip=None
+                                 ) -> Iterator[Tuple[str, float, bytes]]:
+    """:func:`read_image_seqfile` over :func:`read_records_resilient`:
+    structurally corrupt records resync to the next marker, and a record
+    whose FRAMING survived but whose key/value payload no longer parses
+    (a bit flip inside the Text key or the BytesWritable prefix) is
+    skipped through the same ``on_skip(err, resume_offset)`` protocol
+    instead of killing the shard."""
+    for key, value in read_records_resilient(path, on_skip=on_skip):
+        try:
+            text = _text_unframe(key).decode()
+            name, _, label = text.rpartition(" ")
+            (n,) = struct.unpack(">i", value[:4])
+            payload = value[4:4 + n]
+            label_f = float(label)
+        except (ValueError, IOError, struct.error,
+                UnicodeDecodeError) as e:
+            if on_skip is None:
+                raise
+            on_skip(e, None)
+            continue
+        yield name, label_f, payload

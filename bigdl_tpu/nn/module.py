@@ -399,15 +399,25 @@ class Module:
         """Flattened (weights, gradients) vectors
         (reference ``getParameters()`` / ``Module.flatten``, ``nn/Module.scala:80``).
         Returns concatenated copies; use :meth:`set_flat_parameters` to write back.
+
+        The flatten runs on the HOST: after distributed training the leaf
+        arrays carry heterogeneous shardings (replicated LayerNorm next to
+        a Megatron-split weight), and jax 0.4.x's eager
+        ``jnp.concatenate`` over mixed-sharding operands on a multi-axis
+        mesh miscomputes — every element comes back scaled by the product
+        of the mesh axes absent from the spec (observed 16x on a
+        ('data','stage','model') mesh).  ``device_get`` + numpy sidesteps
+        the partitioner entirely; the copies this API documents were
+        always host-bound anyway.
         """
         self._ensure_init()
         leaves = jax.tree_util.tree_leaves(self._params)
         gleaves = jax.tree_util.tree_leaves(self._grads)
         if not leaves:
             return jnp.zeros((0,)), jnp.zeros((0,))
-        w = jnp.concatenate([jnp.ravel(l) for l in leaves])
-        g = jnp.concatenate([jnp.ravel(l) for l in gleaves])
-        return w, g
+        w = np.concatenate([np.ravel(l) for l in jax.device_get(leaves)])
+        g = np.concatenate([np.ravel(l) for l in jax.device_get(gleaves)])
+        return jnp.asarray(w), jnp.asarray(g)
 
     def set_flat_parameters(self, flat: jnp.ndarray) -> None:
         self._ensure_init()

@@ -51,6 +51,28 @@ identically under pytest, a soak script, or a real cluster rehearsal:
                                 resumes the snapshot on a DIFFERENT
                                 device count, proving the topology-
                                 elastic restore path end to end.
+``bigdl.chaos.corruptRecordAt`` "k" or "k:m": ingest records k..m (0-based
+                                stream position) read as corrupt — the
+                                quarantine must skip them, the sync path
+                                must die on them.
+``bigdl.chaos.corruptRecordEvery`` n: every n-th ingest record reads corrupt
+                                (rate-based sibling of ``corruptRecordAt``
+                                for throughput-under-dirt benchmarks).
+``bigdl.chaos.failDecodeAt``    "k" or "k:m": records k..m decode to an
+                                undecodable-image error (a data fault the
+                                decode stage must quarantine, not an IO
+                                blip).
+``bigdl.chaos.transientReads``  n: the first n ingest record reads raise a
+                                transient :class:`ChaosError` and then
+                                recover — exercises the reader stage's
+                                capped-backoff retry (a remote-read blip
+                                must not quarantine anything or abort).
+``bigdl.chaos.killStageThread`` "stage" or "stage:k" (stage in reader /
+                                assembler / decode): the named ingest
+                                stage thread dies SILENTLY after its k-th
+                                item (default 1) — no error surfaced, no
+                                done flag: exactly the failure the stage
+                                supervisor must detect and restart.
 ==============================  =============================================
 
 Counters are process-local and monotonically increasing from
@@ -89,10 +111,24 @@ class _ChaosState:
             config.get_property("bigdl.chaos.stallStepAt"))
         self.topology_change_at = config.get_int(
             "bigdl.chaos.topologyChangeAt", 0)
+        self.corrupt_record_at = _parse_span(
+            config.get_property("bigdl.chaos.corruptRecordAt"))
+        self.corrupt_record_every = config.get_int(
+            "bigdl.chaos.corruptRecordEvery", 0)
+        self.fail_decode_at = _parse_span(
+            config.get_property("bigdl.chaos.failDecodeAt"))
+        self.transient_reads = config.get_int(
+            "bigdl.chaos.transientReads", 0)
+        self.kill_stage, self.kill_stage_after = _parse_kill(
+            config.get_property("bigdl.chaos.killStageThread"))
         self.writes = 0
         self.steps_failed = 0
         self.steps_seen = 0
         self.transient_raised = 0
+        self.transient_reads_raised = 0
+        self.record_faults_fired: set = set()   # positions fired once
+        self.decode_faults_fired: set = set()
+        self.stage_kills = 0
         self.preempts = 0
         self.stalls = 0
         self.topology_changes = 0
@@ -165,6 +201,85 @@ class _ChaosState:
         lo, hi = self.nan_loss_at
         return bool(lo) and lo <= seen <= hi
 
+    # ---- ingest-stage hooks --------------------------------------------
+
+    def on_record_read(self, index: int) -> None:
+        """Called by the ingest reader stage with each record's 0-based
+        stream position BEFORE handing it downstream.  Raises a transient
+        :class:`ChaosError` for the first ``transientReads`` reads (the
+        retrying reader sees n blips then success) or a
+        :class:`CorruptRecord` for records in the ``corruptRecordAt``
+        span / on the ``corruptRecordEvery`` grid."""
+        with self._lock:
+            if self.transient_reads_raised < self.transient_reads:
+                self.transient_reads_raised += 1
+                raise ChaosError(
+                    f"chaos: transient read failure "
+                    f"{self.transient_reads_raised}/{self.transient_reads} "
+                    f"on record {index}")
+        lo, hi = self.corrupt_record_at
+        if bool(hi >= 0) and lo <= index <= hi:
+            with self._lock:
+                fire = index not in self.record_faults_fired
+                self.record_faults_fired.add(index)
+            if fire:     # each position dirties ONCE per plan — a fresh
+                raise CorruptRecord(index)   # epoch pass is not re-dirtied
+        if (self.corrupt_record_every and
+                index and index % self.corrupt_record_every == 0):
+            raise CorruptRecord(index)
+
+    def on_decode(self, index: int) -> None:
+        """Called with a record's stream position before decode; raises
+        an undecodable-image error inside the ``failDecodeAt`` span
+        (once per position, like ``corruptRecordAt``)."""
+        lo, hi = self.fail_decode_at
+        if bool(hi >= 0) and lo <= index <= hi:
+            with self._lock:
+                fire = index not in self.decode_faults_fired
+                self.decode_faults_fired.add(index)
+            if fire:
+                raise UndecodableImage(index)
+
+    def kill_stage_thread(self, stage: str, items: int) -> bool:
+        """True exactly once, when the named ingest stage has processed
+        its ``killStageThread`` item count — the stage then returns
+        silently (no error, no done flag), simulating a crashed thread
+        the supervisor must notice."""
+        if self.kill_stage != stage or items < self.kill_stage_after:
+            return False
+        with self._lock:
+            if self.stage_kills:
+                return False        # one death per plan, not per restart
+            self.stage_kills = 1
+        return True
+
+
+class CorruptRecord(ChaosError):
+    """An injected corrupt ingest record — a DATA fault: the taxonomy
+    must quarantine it, never retry it (re-reading corrupt bytes yields
+    corrupt bytes)."""
+
+    #: data faults are not blips — the reader's transient retry must
+    #: not absorb them into a retry loop
+    fatal = True
+
+    def __init__(self, index: int):
+        super().__init__(f"chaos: corrupt record at stream position "
+                         f"{index}")
+        self.index = index
+
+
+class UndecodableImage(ChaosError):
+    """An injected decode failure — a record whose bytes parse as a
+    frame but not as an image (the second data-fault class)."""
+
+    fatal = True
+
+    def __init__(self, index: int):
+        super().__init__(
+            f"chaos: undecodable image at stream position {index}")
+        self.index = index
+
 
 class _TornWrite(ChaosError):
     """fail-the-k-th-write: carries the partial prefix so the storage
@@ -203,6 +318,18 @@ def _parse_stall(value) -> Tuple[int, float]:
     return (int(s), 5.0)
 
 
+def _parse_kill(value) -> Tuple[Optional[str], int]:
+    """``"stage"`` -> (stage, 1); ``"stage:k"`` -> (stage, k); falsy ->
+    (None, 0)."""
+    if not value:
+        return (None, 0)
+    s = str(value)
+    if ":" in s:
+        stage, k = s.split(":", 1)
+        return (stage.strip(), int(k))
+    return (s.strip(), 1)
+
+
 _state: Optional[_ChaosState] = None
 
 
@@ -236,6 +363,27 @@ def on_step(neval: int) -> bool:
     if _state is None:
         return False
     return _state.on_step(neval)
+
+
+def on_record_read(index: int) -> None:
+    """Ingest reader-stage hook (no-op when disarmed): transient read
+    blips and corrupt-record injection by stream position."""
+    if _state is not None:
+        _state.on_record_read(index)
+
+
+def on_decode(index: int) -> None:
+    """Ingest decode-stage hook (no-op when disarmed)."""
+    if _state is not None:
+        _state.on_decode(index)
+
+
+def kill_stage_thread(stage: str, items: int) -> bool:
+    """Ingest stage-death hook: True means "die silently NOW" (once per
+    plan).  Disarmed: always False."""
+    if _state is None:
+        return False
+    return _state.kill_stage_thread(stage, items)
 
 
 def write_count() -> int:

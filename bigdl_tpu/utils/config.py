@@ -39,6 +39,12 @@ _DEFAULTS: Dict[str, Any] = {
     "bigdl.chaos.preemptAt": 0,        # iteration k: simulated SIGTERM
     "bigdl.chaos.stallStepAt": None,   # "k:seconds": iteration k hangs
     "bigdl.chaos.topologyChangeAt": 0,  # iteration k: mesh goes away
+    # ingest-stage fault injection (dataset/ingest.py stage threads)
+    "bigdl.chaos.corruptRecordAt": None,  # "k" / "k:m": records read as corrupt
+    "bigdl.chaos.corruptRecordEvery": 0,  # every Nth record reads corrupt
+    "bigdl.chaos.failDecodeAt": None,     # "k" / "k:m": records fail decode
+    "bigdl.chaos.transientReads": 0,      # first n record reads blip + recover
+    "bigdl.chaos.killStageThread": None,  # "stage" / "stage:k": silent death
     # elastic training (utils/elastic.py): topology-elastic restore +
     # graceful preemption
     "bigdl.elastic.gracePeriod": 30.0,  # seconds for the final drain+snapshot
@@ -67,6 +73,11 @@ _DEFAULTS: Dict[str, Any] = {
     "bigdl.ingest.decodedRingDepth": None, # in-flight decode window; None = 2x batch
     "bigdl.ingest.batchRingDepth": 2,      # assembled batches buffered ahead
     "bigdl.ingest.batchesInFlight": 2,     # device uploads in flight (transfer-ahead)
+    # self-healing ingest (error taxonomy + quarantine + supervision)
+    "bigdl.ingest.maxBadRecords": 0,       # data-error quarantine budget; 0 = fail fast
+    "bigdl.ingest.maxStageRestarts": 2,    # dead-stage restarts before escalation
+    "bigdl.ingest.fallbackOnFailure": False,  # dead engine -> sync MT path mid-epoch
+    "bigdl.ingest.stallTimeoutSec": 0,     # wedged-ring detection; 0 = disabled
     # static-analysis / sanitizer passes (bigdl_tpu/analysis): each pass is
     # "strict" (raise), "warn" (log + count), or "off"
     "bigdl.analysis.retrace": "warn",      # recompile sentinel on fused steps

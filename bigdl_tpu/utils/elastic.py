@@ -371,6 +371,31 @@ def clear_preemption_marker(ckpt_path: str) -> None:
 
 # ---- hung-step watchdog --------------------------------------------------
 
+#: name -> () -> dict: subsystem snapshots the watchdog logs when it
+#: fires, so a stall is DIAGNOSED (which ingest stage wedged, how stale
+#: each ring is) rather than just detected.  Providers must be cheap,
+#: lock-light, and never touch device values — they run on the monitor
+#: thread while the driver is presumed hung.
+_STALL_DIAGNOSTICS: Dict[str, Any] = {}
+
+
+def register_stall_diagnostic(name: str, provider) -> None:
+    """Register ``provider() -> dict`` to be reported on every watchdog
+    fire (idempotent by name — re-registering replaces)."""
+    _STALL_DIAGNOSTICS[name] = provider
+
+
+def stall_diagnostics() -> Dict[str, Any]:
+    """Snapshot every registered provider (a failing provider reports
+    its error instead of masking the fire)."""
+    out: Dict[str, Any] = {}
+    for name, provider in list(_STALL_DIAGNOSTICS.items()):
+        try:
+            out[name] = provider()
+        except Exception as e:  # diagnostics must not mask the abort
+            out[name] = {"error": repr(e)}
+    return out
+
 
 def _async_raise(thread_id: int, exc_type) -> bool:
     """Inject ``exc_type`` into the thread with ``thread_id`` (CPython's
@@ -596,6 +621,13 @@ class HungStepWatchdog:
         telemetry.instant("watchdog/hung_step",
                           open_ms=round(open_ns / 1e6, 3),
                           threshold_ms=round(threshold_ns / 1e6, 3))
+        diagnostics = stall_diagnostics()
+        if diagnostics:
+            # name the wedged subsystem while the evidence is fresh: the
+            # ingest engine registers its per-stage stats + ring ages
+            # here, so "the step hung" comes with "the decode ring has
+            # not progressed in 40 s"
+            logger.error("hung-step diagnostics: %s", diagnostics)
         if self.timeline_dir and telemetry.tracing_enabled():
             try:
                 os.makedirs(str(self.timeline_dir), exist_ok=True)

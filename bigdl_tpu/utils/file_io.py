@@ -51,9 +51,15 @@ def _is_transient(e: BaseException) -> bool:
             not isinstance(e, _NON_TRANSIENT))
 
 
-def _retrying(fn, *args, op: str = ""):
-    """Run ``fn(*args)`` with a bounded transient-error retry (remote
-    operations only — local filesystems don't blip, they fail)."""
+def retrying(fn, *args, op: str = ""):
+    """Run ``fn(*args)`` with the bounded capped-backoff transient-error
+    retry (``bigdl.io.retryTimes`` / ``bigdl.io.retryInterval``).  The
+    shared transient-IO policy: every remote operation in this module
+    funnels through it, and the streaming-ingest reader stage wraps its
+    record fetches in it so a storage blip mid-epoch costs a delay, not
+    a training run.  Non-transient failures (missing files, permission
+    errors, anything marked ``fatal`` — chaos data faults) are never
+    retried."""
     from bigdl_tpu.utils import config
     attempts = max(1, config.get_int("bigdl.io.retryTimes", 3))
     base = config.get_float("bigdl.io.retryInterval", 0.1)
@@ -69,6 +75,10 @@ def _retrying(fn, *args, op: str = ""):
                 "%r", op or getattr(fn, "__name__", "io"), attempt,
                 attempts, delay, e)
             _sleep(delay)
+
+
+#: internal alias kept for the module's own call sites
+_retrying = retrying
 
 
 def _is_remote(path: str) -> bool:

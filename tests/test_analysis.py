@@ -420,6 +420,36 @@ class TestLintRules:
                                               src)] == ["swallowed-exception"]
         assert _lint_snippet(tmp_path, "utils/other.py", src) == []
 
+    def test_unguarded_io_in_stage_thread(self, tmp_path):
+        """Raw open() in dataset/ingest.py flags; the same code anywhere
+        else (or routed through file_io/seqfile) stays clean."""
+        src = """
+            import os
+            def reader():
+                with open("/data/shard.seq", "rb") as f:
+                    return f.read()
+            def reader2():
+                fd = os.open("/data/shard.seq", 0)
+        """
+        findings = _lint_snippet(tmp_path, "dataset/ingest.py", src)
+        assert [f.rule for f in findings] == [
+            "unguarded-io-in-stage-thread"] * 2
+        assert _lint_snippet(tmp_path, "dataset/seqfile.py", src) == []
+        guarded = """
+            from bigdl_tpu.utils import file_io
+            def reader():
+                return file_io.read_bytes("/data/shard.seq")
+            def reader2():
+                data = open  # a bare name, not a call
+        """
+        assert _lint_snippet(tmp_path, "dataset/ingest.py", guarded) == []
+        allowed = """
+            def reader():
+                with open("/x", "rb") as f:  # lint: allow(unguarded-io-in-stage-thread)
+                    return f.read()
+        """
+        assert _lint_snippet(tmp_path, "dataset/ingest.py", allowed) == []
+
     def test_lock_order_cycle_detected(self, tmp_path):
         findings = _lint_snippet(tmp_path, "engine.py", """
             def a(self):

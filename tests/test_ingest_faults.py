@@ -1,0 +1,552 @@
+"""Self-healing ingest: error taxonomy, quarantine, supervised stage
+restarts, stall detection, sync fallback, seqfile resync, and the
+prefetcher fault paths — every leg chaos-injected and parity-asserted."""
+
+import io
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.dataset.image import LabeledImageBytes
+from bigdl_tpu.dataset.ingest import (IngestInfraError, IngestStallError,
+                                      QuarantineExceededError,
+                                      RecordQuarantine, ShardedSeqFileReader,
+                                      StreamingIngest)
+from bigdl_tpu.dataset.mt_batch import MTLabeledBGRImgToBatch
+from bigdl_tpu.utils import chaos, config
+from bigdl_tpu.utils.random_generator import RandomGenerator
+
+
+def _png_records(n=12, hw=(40, 48), seed=3):
+    from PIL import Image
+    rng = np.random.RandomState(seed)
+    recs = []
+    for i in range(n):
+        img = rng.randint(0, 256, size=hw + (3,)).astype(np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(img).save(buf, "PNG")
+        recs.append(LabeledImageBytes(f"r{i}", float(i % 5 + 1),
+                                      buf.getvalue()))
+    return recs
+
+
+@pytest.fixture(autouse=True)
+def _fast_retries():
+    """No real backoff sleeps in tier-1; chaos plans reset per test."""
+    config.set_property("bigdl.io.retryInterval", 0.001)
+    yield
+    config.clear_property("bigdl.io.retryInterval")
+    chaos.uninstall()
+
+
+def _chaos(**props):
+    for k, v in props.items():
+        config.set_property(f"bigdl.chaos.{k}", v)
+    chaos.install()
+    for k in props:
+        config.clear_property(f"bigdl.chaos.{k}")
+
+
+def _batches(transformer, records):
+    return [(b.get_input().copy(), b.get_target().copy())
+            for b in transformer(iter(records))]
+
+
+def _sync_batches(records, seed=7, batch=4):
+    RandomGenerator.RNG().set_seed(seed)
+    return _batches(MTLabeledBGRImgToBatch(batch, crop=(32, 32)), records)
+
+
+def _assert_stream_equal(got, want):
+    assert len(got) == len(want)
+    for (xg, yg), (xw, yw) in zip(got, want):
+        np.testing.assert_array_equal(xg, xw)
+        np.testing.assert_array_equal(yg, yw)
+
+
+class TestQuarantine:
+    def test_corrupt_record_skipped_and_stream_matches_survivors(self):
+        """A corrupt record quarantines; the surviving batch stream is
+        bit-identical to the sync path over the surviving records (the
+        skipped record draws no RNG)."""
+        recs = _png_records(12)
+        _chaos(corruptRecordAt="5")
+        RandomGenerator.RNG().set_seed(7)
+        eng = StreamingIngest(4, crop=(32, 32), decode_workers=2,
+                              max_bad_records=3)
+        got = _batches(eng, recs)
+        assert eng.quarantine.count == 1
+        sample = eng.quarantine.samples[0]
+        assert sample["stage"] == "read" and sample["index"] == 5
+        _assert_stream_equal(got, _sync_batches(recs[:5] + recs[6:]))
+
+    def test_decode_failure_quarantined_before_any_draw(self):
+        recs = _png_records(12)
+        _chaos(failDecodeAt="3")
+        RandomGenerator.RNG().set_seed(7)
+        eng = StreamingIngest(4, crop=(32, 32), decode_workers=2,
+                              max_bad_records=3)
+        got = _batches(eng, recs)
+        assert eng.quarantine.count == 1
+        assert eng.quarantine.by_stage == {"decode": 1}
+        _assert_stream_equal(got, _sync_batches(recs[:3] + recs[4:]))
+
+    def test_genuinely_undecodable_bytes_quarantined(self):
+        recs = _png_records(10)
+        recs[5] = LabeledImageBytes("junk", 1.0, b"not an image at all")
+        RandomGenerator.RNG().set_seed(7)
+        eng = StreamingIngest(4, crop=(32, 32), decode_workers=2,
+                              max_bad_records=1)
+        got = _batches(eng, recs)
+        assert eng.quarantine.count == 1
+        _assert_stream_equal(got, _sync_batches(recs[:5] + recs[6:]))
+
+    def test_undersized_record_quarantined_with_budget(self):
+        recs = _png_records(6, hw=(40, 48))
+        recs[2:3] = _png_records(1, hw=(20, 48))
+        RandomGenerator.RNG().set_seed(7)
+        eng = StreamingIngest(4, crop=(32, 32), decode_workers=2,
+                              max_bad_records=1)
+        got = _batches(eng, recs)
+        assert eng.quarantine.by_stage == {"assemble": 1}
+        _assert_stream_equal(got, _sync_batches(recs[:2] + recs[3:]))
+
+    def test_budget_zero_keeps_fail_fast_contract(self):
+        """maxBadRecords=0 (the default) re-raises the ORIGINAL data
+        error — today's behaviour, bit for bit."""
+        recs = _png_records(8)
+        _chaos(corruptRecordAt="2")
+        eng = StreamingIngest(4, crop=(32, 32), decode_workers=2)
+        with pytest.raises(chaos.CorruptRecord):
+            list(eng(iter(recs)))
+
+    def test_budget_exceeded_fails_loudly_with_offender_sample(self):
+        recs = _png_records(12)
+        _chaos(corruptRecordAt="2:8")
+        eng = StreamingIngest(4, crop=(32, 32), decode_workers=2,
+                              max_bad_records=2)
+        with pytest.raises(QuarantineExceededError, match="maxBadRecords"):
+            list(eng(iter(recs)))
+        assert len(eng.quarantine.samples) == 3
+        assert eng.quarantine.samples[0]["index"] == 2
+
+    def test_quarantine_counts_flow_to_metrics_registry(self):
+        from bigdl_tpu import telemetry
+        recs = _png_records(8)
+        _chaos(failDecodeAt="1")
+        before = telemetry.counter("Ingest/quarantined",
+                                   summary=True).value
+        eng = StreamingIngest(4, crop=(32, 32), decode_workers=2,
+                              max_bad_records=2)
+        list(eng(iter(recs)))
+        assert telemetry.counter("Ingest/quarantined",
+                                 summary=True).value == before + 1
+        assert eng.fault_stats()["quarantine"]["count"] == 1
+
+
+class TestTransientReads:
+    def test_transient_read_blips_retry_to_bit_parity(self):
+        """Reader blips absorb into the capped-backoff retry: nothing
+        quarantined, stream bit-identical to an undisturbed run."""
+        recs = _png_records(12)
+        _chaos(transientReads=2)
+        RandomGenerator.RNG().set_seed(7)
+        eng = StreamingIngest(4, crop=(32, 32), decode_workers=2,
+                              max_bad_records=3)
+        got = _batches(eng, recs)
+        assert eng.quarantine.count == 0
+        _assert_stream_equal(got, _sync_batches(recs))
+
+    def test_blips_beyond_retry_budget_surface_as_infra_error(self):
+        recs = _png_records(8)
+        config.set_property("bigdl.io.retryTimes", 2)
+        try:
+            _chaos(transientReads=5)
+            eng = StreamingIngest(4, crop=(32, 32), decode_workers=2,
+                                  max_bad_records=3)
+            with pytest.raises(chaos.ChaosError, match="transient"):
+                list(eng(iter(recs)))
+            assert eng.quarantine.count == 0   # a blip is not dirty data
+        finally:
+            config.clear_property("bigdl.io.retryTimes")
+
+
+class TestSupervisedStages:
+    @pytest.mark.parametrize("plan", ["reader:4", "assembler:6"])
+    def test_killed_stage_thread_restarts_to_bit_parity(self, plan):
+        """A silently-dead stage thread is detected, restarted from
+        shared stage state, and the stream completes bit-identical to
+        the synchronous path — the RNG clone-and-commit contract
+        survives the restart."""
+        recs = _png_records(12)
+        _chaos(killStageThread=plan)
+        RandomGenerator.RNG().set_seed(7)
+        eng = StreamingIngest(4, crop=(32, 32), decode_workers=2)
+        got = _batches(eng, recs)
+        assert eng.supervisor.restarts == 1
+        _assert_stream_equal(got, _sync_batches(recs))
+
+    def test_dead_decode_worker_resubmitted(self):
+        recs = _png_records(12)
+        _chaos(killStageThread="decode:5")
+        RandomGenerator.RNG().set_seed(7)
+        eng = StreamingIngest(4, crop=(32, 32), decode_workers=2)
+        got = _batches(eng, recs)
+        assert eng.supervisor.restarts == 1
+        _assert_stream_equal(got, _sync_batches(recs))
+
+    def test_restart_budget_exhausted_escalates_with_diagnosis(self):
+        recs = _png_records(12)
+        _chaos(killStageThread="assembler:6")
+        eng = StreamingIngest(4, crop=(32, 32), decode_workers=2,
+                              max_stage_restarts=0)
+        with pytest.raises(IngestInfraError, match="assembler") as ei:
+            list(eng(iter(recs)))
+        # the failure carries the per-stage stats, naming the sick stage
+        assert set(ei.value.diagnosis) >= {"read", "decode", "assemble"}
+
+    def test_orderly_completion_never_restarts(self):
+        recs = _png_records(8)
+        eng = StreamingIngest(4, crop=(32, 32), decode_workers=2)
+        assert sum(b.size() for b in eng(iter(recs))) == 8
+        assert eng.supervisor.restarts == 0
+        assert eng.supervisor.failure is None
+
+    def test_teardown_joins_supervisor_and_stage_threads(self):
+        before = threading.active_count()
+        recs = _png_records(8)
+
+        def infinite():
+            while True:
+                yield from recs
+
+        eng = StreamingIngest(4, crop=(32, 32), decode_workers=2)
+        it = eng(infinite())
+        next(it)
+        it.close()
+        deadline = time.monotonic() + 10
+        while (threading.active_count() > before and
+               time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert threading.active_count() <= before, "thread leaked"
+
+
+class TestStallAndFallback:
+    def test_wedged_ring_detected_with_stage_diagnosis(self):
+        """Producer hung + consumer blocked: the per-ring heartbeats
+        declare the engine dead within the stall window instead of
+        hanging forever, and the error names the per-stage stats."""
+        recs = _png_records(4)
+
+        def hung():
+            yield from recs[:2]
+            time.sleep(3600)    # a wedged upstream read, forever
+
+        eng = StreamingIngest(4, crop=(32, 32), decode_workers=2,
+                              stall_timeout=0.5)
+        t0 = time.monotonic()
+        with pytest.raises(IngestStallError, match="stallTimeoutSec") as ei:
+            list(eng(hung()))
+        assert time.monotonic() - t0 < 10
+        assert "read" in ei.value.diagnosis
+
+    def test_fallback_finishes_epoch_on_sync_path_bit_identically(self):
+        """A supervisor-declared-dead engine with fallbackOnFailure
+        switches to the synchronous path mid-epoch: same drawer RNG, so
+        the full stream equals an undisturbed run bit for bit."""
+        recs = _png_records(12)
+        _chaos(killStageThread="assembler:6")
+        RandomGenerator.RNG().set_seed(7)
+        eng = StreamingIngest(4, crop=(32, 32), decode_workers=2,
+                              max_stage_restarts=0,
+                              fallback_on_failure=True)
+        got = _batches(eng, recs)
+        assert eng.fallbacks == 1
+        _assert_stream_equal(got, _sync_batches(recs))
+
+    def test_fallback_after_reader_death_pulls_remaining_upstream(self):
+        recs = _png_records(12)
+        _chaos(killStageThread="reader:4")
+        RandomGenerator.RNG().set_seed(7)
+        eng = StreamingIngest(4, crop=(32, 32), decode_workers=2,
+                              max_stage_restarts=0,
+                              fallback_on_failure=True)
+        got = _batches(eng, recs)
+        assert eng.fallbacks == 1
+        _assert_stream_equal(got, _sync_batches(recs))
+
+    def test_fallback_quarantines_bad_records_in_tail(self):
+        """Quarantine keeps working after the switch: a corrupt record
+        past the failure point still skips instead of raising."""
+        recs = _png_records(12)
+        _chaos(killStageThread="assembler:2", corruptRecordAt="9")
+        RandomGenerator.RNG().set_seed(7)
+        eng = StreamingIngest(4, crop=(32, 32), decode_workers=2,
+                              max_stage_restarts=0,
+                              fallback_on_failure=True,
+                              max_bad_records=2)
+        got = _batches(eng, recs)
+        assert eng.fallbacks == 1
+        assert eng.quarantine.count == 1
+        _assert_stream_equal(got, _sync_batches(recs[:9] + recs[10:]))
+
+    def test_watchdog_stall_diagnostics_include_live_engines(self):
+        """The hung-step watchdog's fire path reports the ingest
+        engines' per-stage stats + ring ages: a driver stall rooted in
+        a wedged data pipeline is diagnosed, not just detected."""
+        from bigdl_tpu.utils import elastic
+        recs = _png_records(8)
+        eng = StreamingIngest(4, crop=(32, 32), decode_workers=2)
+        it = eng(iter(recs))
+        next(it)
+        diag = elastic.stall_diagnostics()
+        try:
+            assert eng.name in diag["ingest"]
+            entry = diag["ingest"][eng.name]
+            assert "read" in entry["stats"]
+            assert set(entry["faults"]["ring_ages_s"]) == {
+                "record_ring", "batch_ring"}
+        finally:
+            it.close()
+
+    def test_mt_transformer_accepts_explicit_drawer(self):
+        """The sync path's injectable drawer (the fallback's RNG hook):
+        an explicit RandomGenerator replaces the thread-local stream."""
+        recs = _png_records(8)
+        rng_a = RandomGenerator(99)
+        got = _batches(MTLabeledBGRImgToBatch(4, crop=(32, 32), rng=rng_a),
+                       recs)
+        rng_b = RandomGenerator(99)
+        again = _batches(MTLabeledBGRImgToBatch(4, crop=(32, 32),
+                                                rng=rng_b), recs)
+        _assert_stream_equal(got, again)
+
+
+class TestSeqfileResync:
+    def _write(self, tmp_path, n=10, payload=1100):
+        from bigdl_tpu.dataset import seqfile
+        path = str(tmp_path / "a.seq")
+        entries = [(f"k{i}", float(i + 1), bytes([i % 256]) * payload)
+                   for i in range(n)]
+        seqfile.write_image_seqfile(path, entries)
+        return path, entries
+
+    def _record_offsets(self, path):
+        from bigdl_tpu.dataset import seqfile
+        offs = []
+        with open(path, "rb") as f:
+            sync = seqfile._read_header(f, path)
+            while True:
+                o = f.tell()
+                raw = f.read(4)
+                if not raw:
+                    return offs
+                (rl,) = struct.unpack(">i", raw)
+                if rl == -1:
+                    f.read(16)
+                    continue
+                offs.append(o)
+                f.read(4 + rl)
+
+    def test_corrupt_error_names_offset_and_record_index(self, tmp_path):
+        from bigdl_tpu.dataset import seqfile
+        path, _ = self._write(tmp_path)
+        offs = self._record_offsets(path)
+        with open(path, "r+b") as f:     # flip the length field of rec 4
+            f.seek(offs[4])
+            f.write(b"\x7f\xff\xff\xff")
+        with pytest.raises(IOError, match=rf"record 4 at offset {offs[4]}"):
+            list(seqfile.read_image_seqfile(path))
+
+    def test_resync_skips_to_next_marker_not_the_whole_shard(self, tmp_path):
+        from bigdl_tpu.dataset import seqfile
+        path, entries = self._write(tmp_path)
+        clean = list(seqfile.read_image_seqfile(path))
+        offs = self._record_offsets(path)
+        with open(path, "r+b") as f:
+            f.seek(offs[4])
+            f.write(b"\x7f\xff\xff\xff")
+        skips = []
+        got = list(seqfile.read_image_seqfile_resilient(
+            path, on_skip=lambda e, resume: skips.append((e, resume))))
+        assert len(skips) == 1
+        err, resume = skips[0]
+        assert isinstance(err, seqfile.CorruptRecordError)
+        assert err.record_index == 4 and err.offset == offs[4]
+        assert resume is not None and resume > offs[4]
+        # the prefix survives exactly; only records between the damage
+        # and the next sync marker are lost — never the shard
+        assert got[:4] == clean[:4]
+        tail = clean[-len(got) + 4:] if len(got) > 4 else []
+        assert got[4:] == tail
+        assert len(got) >= len(clean) - 3
+
+    def test_find_next_sync_none_past_last_marker(self, tmp_path):
+        from bigdl_tpu.dataset import seqfile
+        path, _ = self._write(tmp_path)
+        size = (tmp_path / "a.seq").stat().st_size
+        assert seqfile.find_next_sync(path, size - 4) is None
+
+    def test_sharded_reader_quarantines_corrupt_records(self, tmp_path):
+        from bigdl_tpu.dataset import seqfile
+        good = [(f"k{i}", 1.0, bytes([i]) * 1100) for i in range(8)]
+        seqfile.write_image_seqfile(str(tmp_path / "a.seq"), good)
+        seqfile.write_image_seqfile(str(tmp_path / "b.seq"), good)
+        path_b = str(tmp_path / "b.seq")
+        offs = self._record_offsets(path_b)
+        with open(path_b, "r+b") as f:
+            f.seek(offs[3])
+            f.write(b"\x7f\xff\xff\xff")
+        q = RecordQuarantine(budget=4)
+        reader = ShardedSeqFileReader(str(tmp_path), shards=2, quarantine=q)
+        names = [r.name for r in reader]
+        assert q.count >= 1
+        assert all(n.startswith("k") for n in names)
+        # file a intact: all 8 records; file b loses only the resync gap
+        assert sum(1 for n in names) >= 8 + 5
+        # budget 0 (the default) keeps the historical fail-fast contract
+        with pytest.raises(IOError):
+            list(ShardedSeqFileReader(str(tmp_path), shards=2))
+
+
+class TestPrefetcherFaultPaths:
+    """BatchPrefetcher: a fetch-thread exception during transfer-ahead
+    (in-flight device_put outstanding) must surface the ORIGINAL error
+    at the consuming call site and tear down without deadlock."""
+
+    def _fetcher(self, fail_at, payload_mb=5):
+        import jax.numpy as jnp
+        state = {"n": 0}
+
+        def fetch():
+            state["n"] += 1
+            if state["n"] == fail_at:
+                raise RuntimeError("fetch boom")
+            # large enough to cross READY_BYTES: the transfer stage
+            # really blocks an in-flight upload device-resident
+            return jnp.ones((payload_mb * 256 * 1024,), jnp.float32)
+
+        return fetch, state
+
+    def test_fetch_error_during_transfer_ahead_surfaces_original(self):
+        from bigdl_tpu.engine import BatchPrefetcher
+        fetch, _ = self._fetcher(fail_at=3)
+        p = BatchPrefetcher(fetch, depth=2, transfer_ahead=3)
+        try:
+            got = [p() for _ in range(2)]
+            assert all(g is not None for g in got)
+            with pytest.raises(RuntimeError, match="fetch boom"):
+                p()
+        finally:
+            p.stop()
+
+    def test_teardown_with_outstanding_uploads_does_not_deadlock(self):
+        from bigdl_tpu.engine import BatchPrefetcher
+        fetch, state = self._fetcher(fail_at=10 ** 9)
+        p = BatchPrefetcher(fetch, depth=2, transfer_ahead=3)
+        p()                                  # pipeline primed, uploads live
+        t0 = time.monotonic()
+        p.stop()                             # must join, not hang
+        assert time.monotonic() - t0 < 15
+        assert not p._thread.is_alive()
+        assert not p._transfer_thread.is_alive()
+
+    def test_error_before_first_batch_raises_immediately(self):
+        from bigdl_tpu.engine import BatchPrefetcher
+        fetch, _ = self._fetcher(fail_at=1)
+        p = BatchPrefetcher(fetch, depth=2, transfer_ahead=2)
+        try:
+            with pytest.raises(RuntimeError, match="fetch boom"):
+                p()
+        finally:
+            p.stop()
+
+
+@pytest.mark.slow
+def test_chaos_ingest_soak_trained_weight_parity():
+    """The acceptance soak: training through StreamingIngest with an
+    injected corrupt record, transient reader IO errors, AND one killed
+    stage thread completes and reaches BIT-EXACT trained-weight parity
+    with a clean run over the same surviving records.
+
+    Oracle construction: the faulty run's quarantine log names exactly
+    which record was dropped (positional injectors fire once per plan);
+    the clean run streams the same records through an un-chaosed engine
+    with that one record dropped at its first occurrence — identical
+    surviving stream, identical RNG draws, so the weights must match to
+    the bit."""
+    import jax
+    import bigdl_tpu.nn as nn
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.dataset.dataset import LocalDataSet
+    from bigdl_tpu.dataset.transformer import Transformer
+
+    recs = _png_records(n=48, hw=(40, 48), seed=5)
+
+    class ToSamples(Transformer):
+        def __call__(self, it):
+            from bigdl_tpu.dataset.sample import MiniBatch
+            for b in it:
+                x = b.get_input().reshape(b.size(), -1)[:, :64] / 255.0
+                y = (b.get_target() % 2) + 1
+                yield MiniBatch(x.astype(np.float32),
+                                y.astype(np.float32))
+
+    class DropOnce(Transformer):
+        """Skip the FIRST occurrence of the named record — replays the
+        faulty run's quarantine decision on the clean stream."""
+
+        def __init__(self, name):
+            self.name = name
+            self.dropped = False
+
+        def __call__(self, it):
+            for r in it:
+                if not self.dropped and r.name == self.name:
+                    self.dropped = True
+                    continue
+                yield r
+
+    def train(engine, head):
+        model = (nn.Sequential().add(nn.Linear(64, 16)).add(nn.Tanh())
+                 .add(nn.Linear(16, 2)).add(nn.LogSoftMax()))
+        model.reset(jax.random.PRNGKey(3))
+        ds = LocalDataSet(list(recs), head + [engine, ToSamples()])
+        o = optim.Optimizer.create(model, ds, nn.ClassNLLCriterion())
+        o.set_optim_method(optim.SGD(learning_rate=0.1))
+        o.set_end_when(optim.max_epoch(3))
+        return np.asarray(o.optimize().get_parameters()[0])
+
+    config.set_property("bigdl.io.retryInterval", 0.001)
+    try:
+        # one plan, three fault classes: a corrupt record, transient
+        # reader IO blips, and a silently-killed assembler thread
+        config.set_property("bigdl.chaos.corruptRecordAt", "17")
+        config.set_property("bigdl.chaos.transientReads", 2)
+        config.set_property("bigdl.chaos.killStageThread", "assembler:9")
+        chaos.install()
+        for k in ("corruptRecordAt", "transientReads", "killStageThread"):
+            config.clear_property(f"bigdl.chaos.{k}")
+        RandomGenerator.RNG().set_seed(41)
+        eng = StreamingIngest(8, crop=(32, 32), decode_workers=2,
+                              max_bad_records=4)
+        w_faulty = train(eng, head=[])
+        quarantined = [s for run in eng.run_history
+                       for s in run["quarantine"]["samples"]]
+        restarts = sum(run["stage_restarts"] for run in eng.run_history)
+        assert len(quarantined) == 1, quarantined
+        assert restarts >= 1
+        chaos.uninstall()
+
+        RandomGenerator.RNG().set_seed(41)
+        eng2 = StreamingIngest(8, crop=(32, 32), decode_workers=2)
+        w_clean = train(eng2, head=[DropOnce(quarantined[0]["name"])])
+        np.testing.assert_array_equal(w_faulty, w_clean)
+    finally:
+        chaos.uninstall()
+        for k in ("corruptRecordAt", "transientReads", "killStageThread"):
+            config.clear_property(f"bigdl.chaos.{k}")
+        config.clear_property("bigdl.io.retryInterval")
