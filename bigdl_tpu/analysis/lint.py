@@ -67,6 +67,18 @@ Rules
     real time, and a handler swapped inside the loop can lose the one
     SIGTERM the scheduler will ever send.
 
+``untracked-jit``
+    Anywhere in the package outside the registered cache wrapper
+    (``utils/compile_cache.py``): a ``jax.jit``/``jax.pjit`` call (or
+    decorator), a ``.lower(...)`` call with arguments, or a no-argument
+    ``.compile()`` call.  An untracked jit entry point compiles outside
+    the persistent executable cache, the AOT warmup phase, and the
+    compile watchdog — a cold start it silently re-pays every process
+    and a wedge nothing supervises.  Route fused steps through
+    ``compile_cache.tracked_jit``; genuinely-exempt sites (debug shells,
+    cost-analysis lowerings) carry an inline
+    ``# lint: allow(untracked-jit)`` with the reason.
+
 ``unguarded-io-in-stage-thread``
     In the ingest stage-thread file (``dataset/ingest.py``), raw file IO
     — builtin ``open(...)`` / ``os.open`` / ``io.open`` / an
@@ -114,6 +126,10 @@ NN_SCOPE = os.path.join("nn", "")
 FORWARD_FUNCS = {"apply", "init_hidden", "project_input", "step", "route",
                  "expert_forward"}
 DTYPE_DROP_FACTORIES = {"zeros", "ones", "empty"}
+
+#: the ONE registered jit wrapper: jax.jit/.lower()/.compile() live here
+TRACKED_JIT_FILES = (os.path.join("utils", "compile_cache.py"),)
+JIT_NAMES = {"jit", "pjit"}
 
 THREADED_FILES = (os.path.join("dataset", "ingest.py"), "engine.py")
 #: files whose threads feed the training loop: raw file IO here must
@@ -326,6 +342,59 @@ def _rule_dtype_drop(path: str, rel: str, tree: ast.AST) -> List[Finding]:
             self.generic_visit(node)
 
     V().visit(tree)
+    return out
+
+
+def _rule_untracked_jit(path: str, rel: str, tree: ast.AST) -> List[Finding]:
+    """``jax.jit`` entry points (calls and decorators), ``.lower(...)``
+    with arguments, and argument-less ``.compile()`` outside the
+    registered cache wrapper file: every fused-step compilation must go
+    through ``compile_cache.tracked_jit`` so it is cached, warmed ahead
+    of step 1, and watchdog-supervised."""
+    if any(rel.endswith(t) for t in TRACKED_JIT_FILES):
+        return []
+    out: List[Finding] = []
+
+    def _flag(lineno: int, what: str) -> None:
+        out.append(Finding(
+            rel, lineno, "untracked-jit",
+            f"{what} outside the registered cache wrapper "
+            "(utils/compile_cache.py) compiles with no persistent "
+            "cache, no AOT warmup, and no compile watchdog — route "
+            "fused steps through compile_cache.tracked_jit"))
+
+    #: decorator Call nodes already flagged via decorator_list — ast.walk
+    #: revisits them as plain calls, which must not double-report
+    flagged_decorators: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                name = (target.attr if isinstance(target, ast.Attribute)
+                        else target.id if isinstance(target, ast.Name)
+                        else None)
+                if name in JIT_NAMES:
+                    _flag(dec.lineno, f"@{name} decorator")
+                    if isinstance(dec, ast.Call):
+                        flagged_decorators.add(id(dec))
+            continue
+        if not isinstance(node, ast.Call) or id(node) in flagged_decorators:
+            continue
+        name = _call_name(node)
+        qual = _qualifier(node)
+        if name in JIT_NAMES and (qual == "jax" or
+                                  isinstance(node.func, ast.Name)):
+            _flag(node.lineno, f"{qual + '.' if qual else ''}{name}(...)")
+        elif (isinstance(node.func, ast.Attribute) and name == "lower" and
+                node.args):
+            # str.lower() takes no arguments — only the AOT lowering
+            # protocol passes the step args here
+            _flag(node.lineno, ".lower(<args>)")
+        elif (isinstance(node.func, ast.Attribute) and name == "compile"
+                and not node.args and not node.keywords):
+            # re.compile(pattern) always has arguments; an argument-less
+            # .compile() is the Lowered -> Compiled AOT step
+            _flag(node.lineno, ".compile()")
     return out
 
 
@@ -568,6 +637,7 @@ def lint_paths(targets: Sequence[str],
                          _rule_raw_clock(path, rel, tree) +
                          _rule_signal_handler(path, rel, tree) +
                          _rule_dtype_drop(path, rel, tree) +
+                         _rule_untracked_jit(path, rel, tree) +
                          _rule_unguarded_io(path, rel, tree) +
                          _rule_exceptions(path, rel, tree))
         if any(rel.endswith(t) for t in THREADED_FILES):

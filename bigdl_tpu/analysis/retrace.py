@@ -48,6 +48,12 @@ def _leaf_sig(x) -> Tuple:
                 bool(getattr(aval, "weak_type", False)))
     if isinstance(x, np.ndarray):
         return (tuple(x.shape), str(x.dtype), False)
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        # jax.ShapeDtypeStruct (the AOT bucket-precompile path registers
+        # abstract variants): signature-identical to the concrete array
+        # it stands for
+        return (tuple(x.shape), str(x.dtype),
+                bool(getattr(x, "weak_type", False)))
     if isinstance(x, (bool, int, float, complex)):
         # python scalars trace as weak-typed 0-d values: the VALUE doesn't
         # retrace, but the TYPE does (int→float flips the weak dtype)
@@ -140,12 +146,13 @@ class RetraceSentinel:
 
     # -- observation ------------------------------------------------------
 
-    def observe(self, args: Tuple) -> Optional[List[str]]:
+    def observe(self, args: Tuple, _key=None) -> Optional[List[str]]:
         """Record one call.  Returns the structured diff when the call is a
         post-warmup retrace (or a warmup compile beyond the budget), else
-        None."""
+        None.  ``_key``: the call's precomputed ``abstract_signature``,
+        when the wrapper already walked the args (one walk per call)."""
         self.calls += 1
-        key = abstract_signature(args)
+        key = _key if _key is not None else abstract_signature(args)
         hkey = (key[0], key[1])
         if hkey in self._seen:
             self._last = key
@@ -170,11 +177,39 @@ class RetraceSentinel:
         self.retraces += 1
         return diff
 
+    def register_warmup(self, args: Tuple) -> None:
+        """Record a signature as a WARMUP compile without counting a
+        call: the AOT bucket-precompile path
+        (``utils/compile_cache.CachedStep``) compiles every configured
+        bucket variant ahead of time and registers each here, so a later
+        concrete call with a bucketed signature — however deep into the
+        run it first appears — is a known compile, never a post-warmup
+        retrace.  Idempotent per signature."""
+        key = abstract_signature(args)
+        hkey = (key[0], key[1])
+        if hkey not in self._seen:
+            self._seen[hkey] = 0     # pre-registered ahead of any call
+            self.compiles_in_warmup += 1
+
     # -- wrapping ---------------------------------------------------------
 
     def wrap(self, fn):
+        # a tracked CachedStep consumes the same signature this sentinel
+        # needs — the argument tree is walked ONCE per call and the key
+        # handed to the in-plan pre-check, the observation, and the
+        # dispatch
+        fast = getattr(fn, "call_with_signature", None)
+        # bucket-capable steps pre-register in-plan signatures (an
+        # oversize batch rounded to a multiple of the largest bucket is
+        # planned work, not a retrace) before this sentinel judges them
+        inplan = getattr(fn, "register_if_bucketed", None)
+
         def wrapped(*args):
-            diff = self.observe(args)
+            key = (abstract_signature(args)
+                   if fast is not None or inplan is not None else None)
+            if inplan is not None:
+                inplan(args, key)
+            diff = self.observe(args, _key=key)
             if diff is not None:
                 msg = (
                     f"{self.name}: jitted step retraced at call "
@@ -189,6 +224,8 @@ class RetraceSentinel:
                 if self.mode == "strict":
                     raise RetraceError(msg)
                 logger.warning("%s", msg)
+            if fast is not None:
+                return fast(args, key)
             return fn(*args)
 
         wrapped.sentinel = self

@@ -322,7 +322,10 @@ class Module:
         if self._jit_apply is None:
             def fn(params, input, state, rng, training):
                 return self.apply(params, input, state, training=training, rng=rng)
-            jitted = jax.jit(fn, static_argnums=(4,))
+            # the imperative debugging/parity shell, not a fused step:
+            # every hot-path compile routes through
+            # utils.compile_cache.tracked_jit
+            jitted = jax.jit(fn, static_argnums=(4,))  # lint: allow(untracked-jit)
             self._jit_apply = lambda p, x, s, r: jitted(p, x, s, r, self.train_mode)
         return self._jit_apply
 

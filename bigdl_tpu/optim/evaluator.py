@@ -21,6 +21,7 @@ import numpy as np
 from bigdl_tpu.analysis.hostsync import host_pull
 from bigdl_tpu.engine import DispatchPipeline
 from bigdl_tpu.engine import to_device as _to_device
+from bigdl_tpu.utils import compile_cache
 from bigdl_tpu.dataset.dataset import AbstractDataSet
 from bigdl_tpu.dataset.sample import MiniBatch, Sample
 from bigdl_tpu.dataset.transformer import SampleToMiniBatch
@@ -30,9 +31,11 @@ from bigdl_tpu.optim.validation_method import (ValidationMethod,
 
 
 def _eval_forward(model: Module, mesh=None, host_params: bool = False):
-    """Jitted eval-mode forward, cached on the model so repeated validation
-    triggers / predict calls reuse one compilation (params/state enter as
-    arguments — value changes don't retrace).
+    """Eval-mode forward through the tracked compile cache, memoized on
+    the model so repeated validation triggers / predict calls reuse one
+    compilation (params/state enter as arguments — value changes don't
+    retrace; with ``bigdl.compile.cacheDir`` armed a second process
+    warm-loads the executable instead of compiling).
 
     With a ``mesh`` the outputs are pinned replicated: the batch shards
     over the ``data`` axis, and under multi-host training the raw sharded
@@ -40,7 +43,13 @@ def _eval_forward(model: Module, mesh=None, host_params: bool = False):
     the host could not read them.  Replicated outputs (one all-gather XLA
     schedules with the forward) are host-readable on every process, so all
     processes compute identical validation scores (the reference reduces
-    metrics to the driver the same way, ``optim/Evaluator.scala:37-74``)."""
+    metrics to the driver the same way, ``optim/Evaluator.scala:37-74``).
+
+    Shape bucketing (``bigdl.compile.buckets``): the ``inputs`` argument
+    is flagged as the batch-bucketed one, so the first compile of a new
+    signature family AOT-precompiles every configured bucket variant and
+    registers it with a retrace sentinel — the PR 4 strict sentinel then
+    proves a ragged validation run retains zero post-warmup retraces."""
     cache = getattr(model, "_eval_jit", None)
     if cache is None:
         cache = model._eval_jit = {}
@@ -50,11 +59,29 @@ def _eval_forward(model: Module, mesh=None, host_params: bool = False):
             out, _ = model.apply(params, inputs, mstate, training=False,
                                  rng=None)
             return out
+        from bigdl_tpu.utils import elastic
+        topology = elastic.describe_topology(mesh, step="eval")
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
-            fn = jax.jit(fwd, out_shardings=NamedSharding(mesh, P()))
+            fn = compile_cache.tracked_jit(
+                fwd, label="eval_sharded", topology=topology,
+                bucket_argnums=(2,),
+                out_shardings=NamedSharding(mesh, P()))
         else:
-            fn = jax.jit(fwd)
+            fn = compile_cache.tracked_jit(fwd, label="eval",
+                                           topology=topology,
+                                           bucket_argnums=(2,))
+        if compile_cache.configured_buckets():
+            # the retrace gate: bucket variants registered as warmup
+            # compiles by the AOT precompile, any OTHER post-warmup
+            # signature — a shape that escaped the bucket plan — is a
+            # retrace event (strict raises, warn logs + counts)
+            from bigdl_tpu.analysis.retrace import RetraceSentinel
+            sentinel = RetraceSentinel.from_config(
+                f"eval[{'sharded' if mesh is not None else 'local'}]")
+            if sentinel is not None:
+                fn.register_sentinel(sentinel)
+                fn = sentinel.wrap(fn)
         cache[id(mesh)] = fn
     params, mstate = model.params, model.state
     if host_params:
@@ -137,25 +164,41 @@ def evaluate_dataset(model: Module, dataset,
         # flight with async device→host copies so each batch doesn't pay
         # a full device round-trip (bigdl.pipeline.depth, default 8)
         def drain(item, _nxt):
-            out_dev, tgt = item
+            out_dev, tgt, true_n = item
             # ONE explicit device_get per validation step: every metric
             # then works on host arrays — N methods cost one pull, not N
             # implicit ones (and none per method inside apply)
             out = host_pull(out_dev, what="validation outputs")
+            # bucketed batches were padded going in; the padded rows are
+            # sliced off HERE, host-side, so metrics score exactly the
+            # true records (bit-identical to an unpadded forward)
+            out = compile_cache.slice_rows(out, true_n)
             for i, m in enumerate(methods):
                 r = m.apply(out, tgt)
                 totals[i] = r if totals[i] is None else totals[i] + r
 
+        buckets = compile_cache.configured_buckets()
         pipeline = DispatchPipeline(drain)
         for batch in it:
-            if batch_sharding is not None and batch.size() % axis_size == 0:
+            n = batch.size()
+            inputs = batch.get_input()
+            eff = n
+            if buckets:
+                # shape bucketing: ragged batches (the validation
+                # remainder) pad up to a configured bucket so the
+                # forward hits a pre-compiled signature instead of
+                # retracing — the choke point the ISSUE names
+                eff = compile_cache.bucket_size(n, buckets)
+                if eff != n:
+                    inputs = compile_cache.pad_batch(inputs, n, eff)
+            if batch_sharding is not None and eff % axis_size == 0:
                 inputs = jax.tree_util.tree_map(
                     lambda x: jax.device_put(np.asarray(x), batch_sharding),
-                    batch.get_input())
+                    inputs)
                 out = fwd(inputs)
             else:
-                out = fwd_local(_to_device(batch.get_input()))
-            pipeline.push(out, batch.get_target())
+                out = fwd_local(_to_device(inputs))
+            pipeline.push(out, batch.get_target(), n)
         pipeline.flush()
         if distributed_partials:
             totals = _merge_partials_across_processes(methods, totals)

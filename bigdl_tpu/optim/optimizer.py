@@ -598,7 +598,10 @@ class Optimizer:
                 return x
 
             specs = jax.tree_util.tree_map(spec, args)
-            self._step_flops = telemetry.step_flops(fn.lower(*specs))
+            # cost-analysis lowering only — never compiled, so it stays
+            # outside the executable cache
+            self._step_flops = telemetry.step_flops(
+                fn.lower(*specs))  # lint: allow(untracked-jit)
             if self._step_flops:
                 logger.info("Fused step cost estimate: %.3f GFLOP/step",
                             self._step_flops / 1e9)
@@ -613,6 +616,39 @@ class Optimizer:
         args_fn = getattr(self, "_cost_args_fn", None)
         if args_fn is not None:
             self._estimate_step_flops(args_fn(inputs, targets, hyper, rng))
+
+    def _warmup_compiles(self, inputs, targets, hyper, rng) -> None:
+        """The AOT warmup phase: compile — or warm-load from the
+        persistent cache — the fused step for the first batch's
+        signature BEFORE step 1 dispatches, in an explicit
+        telemetry-spanned phase (``driver/compile_warmup``,
+        ``Compile/warmup_ms``).  Every trace/load/compile inside runs
+        under the compile watchdog (``bigdl.compile.timeoutSec``), so a
+        wedged compile aborts with a diagnosed
+        :class:`~bigdl_tpu.utils.compile_cache.CompileTimeoutError`
+        that the retry loop treats like divergence — restore and retry
+        — instead of hanging the driver.  Trainers that cannot
+        reproduce their step's argument tuple (no ``_cost_args_fn``)
+        simply compile at step 1 as before."""
+        from bigdl_tpu.utils import compile_cache
+        args_fn = getattr(self, "_cost_args_fn", None)
+        step = self._step_fn
+        target = getattr(step, "__wrapped__", step)
+        if args_fn is None or not isinstance(target,
+                                             compile_cache.CachedStep):
+            return
+        was_warm = target.warm
+        with telemetry.span("driver/compile_warmup"):
+            t0 = telemetry.clock_ns()
+            target.warmup(*args_fn(inputs, targets, hyper, rng))
+            warm_ms = (telemetry.clock_ns() - t0) / 1e6
+        telemetry.gauge("Compile/warmup_ms").set(warm_ms)
+        if not was_warm:
+            logger.info(
+                "Compile warmup complete in %.0f ms: fused step %r "
+                "ready before step 1 (%d cache hit(s), %d fresh "
+                "compile(s))", warm_ms, target.label, target.cache_hits,
+                target.compiles)
 
     def _params_dead(self) -> bool:
         """True if any live model parameter buffer was donated-and-deleted
@@ -939,6 +975,8 @@ class Optimizer:
         fetch = BatchPrefetcher(
             fetch_batch, on_batch=on_batch,
             guard=fetch_guard if fetch_guard.enabled else None)
+        #: the AOT compile-warmup phase runs once, at the first iteration
+        warmed = {"done": False}
         profiling = False
         profiled = False   # the window fires once, even across resumes
 
@@ -1047,6 +1085,13 @@ class Optimizer:
                            jax.random.PRNGKey(0))
                     rng_counter += 1
 
+                    if not warmed["done"]:
+                        # AOT warmup: the fused step is compiled (or
+                        # cache-loaded) HERE, supervised and spanned, so
+                        # the dispatch below is a device step — never an
+                        # unguarded 15-45 s implicit compile
+                        warmed["done"] = True
+                        self._warmup_compiles(inputs, targets, hyper, rng)
                     if self._want_step_flops:
                         self._probe_step_flops(inputs, targets, hyper, rng)
                     t0 = telemetry.clock_ns()
@@ -1425,7 +1470,10 @@ class LocalOptimizer(Optimizer):
                 loss = jnp.where(ok, loss, jnp.nan)
             return new_params, new_slots, new_mstate, loss
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        from bigdl_tpu.utils import compile_cache
+        return compile_cache.tracked_jit(step, label="local",
+                                         topology=self._topology_meta(),
+                                         donate_argnums=(0, 1, 2))
 
     def _build_feval_step(self):
         """Host-driven step for multi-evaluation methods (LBFGS line
@@ -1435,15 +1483,19 @@ class LocalOptimizer(Optimizer):
         reference too (``optim/LBFGS.scala``)."""
         model, criterion = self.model, self.criterion
         optim = self.optim_method
+        from bigdl_tpu.utils import compile_cache
 
-        @jax.jit
-        def value_and_grad(params, mstate, inputs, targets, rng):
+        def _value_and_grad(params, mstate, inputs, targets, rng):
             def loss_fn(p):
                 out, _ = model.apply(p, inputs, mstate, training=True,
                                      rng=rng)
                 loss = criterion.apply(out, targets)
                 return loss + regularization_penalty(model, p)
             return jax.value_and_grad(loss_fn)(params)
+
+        value_and_grad = compile_cache.tracked_jit(
+            _value_and_grad, label="local_feval",
+            topology=self._topology_meta())
 
         def step(params, slots, mstate, inputs, targets, hyper, rng):
             def feval(p):

@@ -16,6 +16,7 @@ from bigdl_tpu.dataset.sample import Sample
 from bigdl_tpu.dataset.transformer import SampleToMiniBatch
 from bigdl_tpu.nn.module import Module
 from bigdl_tpu.optim.evaluator import _eval_forward, _to_device
+from bigdl_tpu.utils import compile_cache
 
 
 class Predictor:
@@ -68,12 +69,25 @@ class Predictor:
 
             def drain(item, _nxt):
                 # one explicit device_get per batch (the same choke-point
-                # discipline as evaluate_dataset's drain)
-                outs.append(host_pull(item[0], what="predict outputs"))
+                # discipline as evaluate_dataset's drain); padded rows
+                # from a bucketed batch are sliced off host-side
+                out = host_pull(item[0], what="predict outputs")
+                outs.append(compile_cache.slice_rows(out, item[1]))
 
+            buckets = compile_cache.configured_buckets()
             pipeline = DispatchPipeline(drain)
             for batch in self._batches(dataset, batch_size):
-                pipeline.push(fwd(_to_device(batch.get_input())))
+                n = batch.size()
+                inputs = batch.get_input()
+                if buckets:
+                    # shape bucketing: the ragged final batch (and any
+                    # caller-fed odd sizes) pad up to a configured
+                    # bucket so serving hits only pre-compiled
+                    # signatures — no per-request retrace
+                    eff = compile_cache.bucket_size(n, buckets)
+                    if eff != n:
+                        inputs = compile_cache.pad_batch(inputs, n, eff)
+                pipeline.push(fwd(_to_device(inputs)), n)
             pipeline.flush()
             if not outs:
                 return np.zeros((0,))

@@ -55,7 +55,10 @@ def replicate_tree(mesh):
     ``optim/DistriOptimizer.scala:818``).  All processes must call it
     together: XLA lowers the resharding to collectives."""
     from jax.sharding import NamedSharding, PartitionSpec as P
-    return jax.jit(lambda t: t, out_shardings=NamedSharding(mesh, P()))
+    # a resharding identity, not a fused step: XLA lowers it to one
+    # all-gather with no compute worth caching
+    return jax.jit(  # lint: allow(untracked-jit)
+        lambda t: t, out_shardings=NamedSharding(mesh, P()))
 
 
 def gather_to_host(tree, mesh):

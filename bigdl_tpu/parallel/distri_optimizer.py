@@ -298,7 +298,10 @@ class DistriOptimizer(Optimizer):
                       pspec_rep, pspec_rep),              # hyper, rng
             out_specs=(pspec_rep, pspec_slots, pspec_rep, pspec_rep),
             check_rep=False)
-        return jax.jit(sharded, donate_argnums=(0, 1, 2))
+        from bigdl_tpu.utils import compile_cache
+        return compile_cache.tracked_jit(sharded, label="shard_map",
+                                         topology=self._topology_meta(),
+                                         donate_argnums=(0, 1, 2))
 
     # ---- driver loop ----------------------------------------------------
 
@@ -635,8 +638,11 @@ class DistriOptimizer(Optimizer):
                 loss = jnp.where(ok, loss, jnp.nan)
             return new_params, new_slots, new_mstate, loss
 
-        return jax.jit(step, donate_argnums=(0, 1, 2),
-                       out_shardings=out_shardings)
+        from bigdl_tpu.utils import compile_cache
+        return compile_cache.tracked_jit(step, label="gspmd",
+                                         topology=self._topology_meta(),
+                                         donate_argnums=(0, 1, 2),
+                                         out_shardings=out_shardings)
 
     def _wire_sequence_parallel(self, module) -> None:
         """Point every MultiHeadAttention at the mesh's seq axis.  The ring

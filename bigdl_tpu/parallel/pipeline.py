@@ -394,8 +394,11 @@ class PipelineOptimizer(Optimizer):
                                      self._slot_specs)
             out_shardings = (param_sh, slot_sh, None)
 
-        return jax.jit(step, donate_argnums=(0, 1),
-                       out_shardings=out_shardings)
+        from bigdl_tpu.utils import compile_cache
+        return compile_cache.tracked_jit(step, label="pipeline",
+                                         topology=self._topology_meta(),
+                                         donate_argnums=(0, 1),
+                                         out_shardings=out_shardings)
 
     def _optimize(self):
         import numpy as np
@@ -520,6 +523,11 @@ class PipelineOptimizer(Optimizer):
              loss) = self._step_fn(carry["params"], carry["slots"],
                                    inputs, targets, hyper, rng)
             return loss
+
+        # AOT warmup + telemetry MFU probe: the pipeline step's full
+        # argument tuple for the driver's pre-step-1 compile phase
+        self._cost_args_fn = lambda inputs, targets, hyper, rng: (
+            carry["params"], carry["slots"], inputs, targets, hyper, rng)
 
         from bigdl_tpu.parallel.all_reduce import (gather_to_host,
                                                    replicate_tree)

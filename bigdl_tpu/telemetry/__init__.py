@@ -9,10 +9,12 @@ archaeology.  Three pillars, one package:
    ``with telemetry.span("optim/device_step"): ...`` writes to per-thread
    ring buffers; :func:`export_chrome_trace` merges the driver hot loop,
    every ``StreamingIngest`` stage thread, the ``BatchPrefetcher``
-   fetch/transfer threads, and the async checkpoint writer into one
-   Perfetto-loadable timeline.  Free when disarmed; allocation-light and
-   device-value-free when armed (the strict host-sync guard stays green
-   over traced runs).
+   fetch/transfer threads, the async checkpoint writer, and the
+   compile-warmup phase (``driver/compile_warmup`` wrapping one
+   ``compile/<step>`` span per trace/cache-load/compile, from
+   ``utils/compile_cache``) into one Perfetto-loadable timeline.  Free
+   when disarmed; allocation-light and device-value-free when armed
+   (the strict host-sync guard stays green over traced runs).
 2. **Step-time decomposition** (:mod:`~bigdl_tpu.telemetry.step_stats`)
    — every optimizer step is accounted into data-wait / compute /
    host-pull / bookkeeping plus an explicit signed ``unaccounted``

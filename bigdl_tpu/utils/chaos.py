@@ -73,6 +73,18 @@ identically under pytest, a soak script, or a real cluster rehearsal:
                                 item (default 1) — no error surfaced, no
                                 done flag: exactly the failure the stage
                                 supervisor must detect and restart.
+``bigdl.chaos.corruptCompileCacheAt`` k: the k-th compile-cache entry
+                                written gets one bit flipped AFTER its
+                                manifest checksum was computed — a
+                                committed-but-rotten entry the warm-start
+                                verification must catch and degrade to a
+                                recompile.
+``bigdl.chaos.hangCompileAt``   "k" or "k:seconds": the k-th XLA compile
+                                wedges for ``seconds`` (default 5.0) —
+                                the compile watchdog must detect it
+                                within ``bigdl.compile.timeoutSec`` and
+                                abort with a diagnosed
+                                ``CompileTimeoutError``.
 ==============================  =============================================
 
 Counters are process-local and monotonically increasing from
@@ -121,6 +133,10 @@ class _ChaosState:
             "bigdl.chaos.transientReads", 0)
         self.kill_stage, self.kill_stage_after = _parse_kill(
             config.get_property("bigdl.chaos.killStageThread"))
+        self.corrupt_cache_at = config.get_int(
+            "bigdl.chaos.corruptCompileCacheAt", 0)
+        self.hang_compile_at, self.hang_compile_seconds = _parse_stall(
+            config.get_property("bigdl.chaos.hangCompileAt"))
         self.writes = 0
         self.steps_failed = 0
         self.steps_seen = 0
@@ -132,6 +148,9 @@ class _ChaosState:
         self.preempts = 0
         self.stalls = 0
         self.topology_changes = 0
+        self.cache_writes = 0
+        self.compiles = 0
+        self.compile_hangs = 0
         self._lock = threading.Lock()
 
     # ---- storage-layer hooks -------------------------------------------
@@ -200,6 +219,44 @@ class _ChaosState:
                 time.sleep(self.stall_seconds)
         lo, hi = self.nan_loss_at
         return bool(lo) and lo <= seen <= hi
+
+    # ---- compile-subsystem hooks ---------------------------------------
+
+    def on_compile_cache_write(self, key: str, payload: bytes) -> bytes:
+        """Called by the compile cache with every entry payload about to
+        be stored; the ``corruptCompileCacheAt``-th entry gets ONE bit
+        flipped AFTER its manifest checksum was computed — the worst
+        case: a committed entry whose payload silently rotted, which
+        only the checksum verification at load time can catch."""
+        with self._lock:
+            self.cache_writes += 1
+            k = self.cache_writes
+        if k == self.corrupt_cache_at and payload:
+            flipped = bytearray(payload)
+            flipped[len(flipped) // 2] ^= 0x40
+            return bytes(flipped)
+        return payload
+
+    def on_compile(self, label: str) -> None:
+        """Called immediately before the ``hangCompileAt``-th XLA
+        compile: wedge the compiling thread for ``seconds`` (default
+        5.0), sleeping in short slices so the compile watchdog's
+        injected :class:`CompileTimeoutError` lands within one slice of
+        the abort — the interruptible stand-in for a hung remote
+        compilation.  One wedge per plan."""
+        if not self.hang_compile_at:
+            return
+        with self._lock:
+            self.compiles += 1
+            fire = (self.compiles == self.hang_compile_at and
+                    self.compile_hangs == 0)
+            if fire:
+                self.compile_hangs = 1
+        if fire:
+            import time
+            end = time.monotonic() + self.hang_compile_seconds
+            while time.monotonic() < end:
+                time.sleep(0.02)
 
     # ---- ingest-stage hooks --------------------------------------------
 
@@ -363,6 +420,21 @@ def on_step(neval: int) -> bool:
     if _state is None:
         return False
     return _state.on_step(neval)
+
+
+def on_compile_cache_write(key: str, payload: bytes) -> bytes:
+    """Compile-cache entry-write hook (identity when disarmed): the
+    ``corruptCompileCacheAt``-th entry is bit-flipped post-checksum."""
+    if _state is None:
+        return payload
+    return _state.on_compile_cache_write(key, payload)
+
+
+def on_compile(label: str) -> None:
+    """Compile hook (no-op when disarmed): the ``hangCompileAt``-th
+    compile wedges for the configured seconds."""
+    if _state is not None:
+        _state.on_compile(label)
 
 
 def on_record_read(index: int) -> None:

@@ -45,6 +45,9 @@ _DEFAULTS: Dict[str, Any] = {
     "bigdl.chaos.failDecodeAt": None,     # "k" / "k:m": records fail decode
     "bigdl.chaos.transientReads": 0,      # first n record reads blip + recover
     "bigdl.chaos.killStageThread": None,  # "stage" / "stage:k": silent death
+    # compile-subsystem fault injection (utils/compile_cache.py)
+    "bigdl.chaos.corruptCompileCacheAt": 0,  # k: bit-flip the k-th cache entry
+    "bigdl.chaos.hangCompileAt": None,    # "k" / "k:seconds": wedge k-th compile
     # elastic training (utils/elastic.py): topology-elastic restore +
     # graceful preemption
     "bigdl.elastic.gracePeriod": 30.0,  # seconds for the final drain+snapshot
@@ -61,7 +64,20 @@ _DEFAULTS: Dict[str, Any] = {
     "bigdl.watchdog.timelineDir": None,  # dump telemetry timeline here on fire
     "bigdl.check.singleton": False,
     "bigdl.summary.flushSecs": 2.0,
-    "bigdl.compilation.cacheDir": None,    # jax persistent compile cache
+    # SUPERSEDED and unread: kept only so existing setters don't error —
+    # the executable cache below (bigdl.compile.cacheDir) is the one that
+    # works; jax's own compile cache is armed via jax.config
+    # jax_compilation_cache_dir, not through this table
+    "bigdl.compilation.cacheDir": None,
+    # resilient compilation (utils/compile_cache.py): persistent fused-step
+    # executable cache + AOT warmup watchdog + shape buckets.  NOT the
+    # near-namesake bigdl.compilation.cacheDir above.
+    "bigdl.compile.cacheDir": None,        # executable cache dir; None = off
+    "bigdl.compile.timeoutSec": 0,         # compile watchdog abort; 0 = off
+    "bigdl.compile.keepLast": 0,           # cache entries retained; 0 = all
+    "bigdl.compile.buckets": None,         # "8,16,32": ragged eval/predict batches pad up
+    "bigdl.compile.lockTimeoutSec": 30.0,  # single-writer lock wait cap
+    "bigdl.compile.lockStaleSec": 600.0,   # steal writer locks older than this
     "bigdl.pipeline.depth": 8,             # driver-loop dispatch pipeline
     # streaming ingest engine (dataset/ingest.py): stage-pipelined
     # real-data path — sharded seqfile readers -> record ring -> decode

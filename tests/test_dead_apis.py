@@ -79,6 +79,13 @@ class TestNms:
 
 class TestLBFGSTrainerPath:
     def test_lbfgs_through_optimizer_create(self):
+        # weight init draws from the thread-local RandomGenerator, whose
+        # state depends on every test that ran before this file — an
+        # 8-hidden-unit LBFGS fit converges from most but not all draws,
+        # so pin the stream (the test_layout _pin_init_stream pattern)
+        # instead of inheriting whatever the suite left behind
+        from bigdl_tpu.utils.random_generator import RandomGenerator
+        RandomGenerator.RNG().set_seed(5489)
         samples = synthetic_separable(128, 4, n_classes=2, seed=3)
         ds = LocalDataSet(samples).transform(SampleToMiniBatch(128))
         model = (nn.Sequential().add(nn.Linear(4, 8)).add(nn.Tanh())

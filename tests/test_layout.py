@@ -151,27 +151,41 @@ class TestModelParityAcrossLayouts:
         m1 = resnet(10, depth=20, dataset=DatasetType.CIFAR10,
                     layout="NCHW")
         m2 = self._converted_clone(m1)
+        # m3 is a SAME-layout clone of m1: the m1-vs-m3 delta measures
+        # this machine's run-to-run nondeterminism (XLA:CPU's threaded
+        # conv reductions reassociate differently compile-to-compile,
+        # and under full-suite CPU contention the jitter can exceed any
+        # fixed atol — the PR 7 flake: passes solo, fails under load).
+        # The cross-layout tolerance is referenced to that measured
+        # noise floor, which makes the check load-immune while keeping
+        # its power: a genuine layout bug corrupts m2 by O(1) without
+        # moving the m1-vs-m3 floor.
+        m3 = m1.clone_module()
         x = _x(2, 3, 32, 32)
-        m1.training()
-        m2.training()
-        o1, o2 = m1.forward(x), m2.forward(x)
-        np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
-                                   rtol=0, atol=1e-4)
-        # backward/grad tolerance is 1e-3, not 1e-4: XLA:CPU's threaded
-        # conv reductions are not run-to-run deterministic, and a
-        # last-ulp forward difference can flip a pooling tie and reroute
-        # one gradient (~2e-4 at a handful of elements).  A genuine
-        # layout bug corrupts the whole tensor by O(1), so the check
-        # keeps its power.
+        for m in (m1, m2, m3):
+            m.training()
+        o1, o2, o3 = m1.forward(x), m2.forward(x), m3.forward(x)
         g = jnp.ones_like(o1)
-        gi1, gi2 = m1.backward(x, g), m2.backward(x, g)
-        np.testing.assert_allclose(np.asarray(gi1), np.asarray(gi2),
-                                   rtol=0, atol=1e-3)
+        gi1, gi2, gi3 = (m1.backward(x, g), m2.backward(x, g),
+                         m3.backward(x, g))
         _, g1 = m1.get_parameters()
         _, g2 = m2.get_parameters()
+        _, g3 = m3.get_parameters()
         assert g1.shape == g2.shape  # boundary modules are parameter-free
-        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
-                                   rtol=0, atol=1e-3)
+
+        def maxdiff(a, b):
+            return float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+
+        for ref, other, same, base in (
+                (o1, o2, o3, 1e-4),      # forward
+                (gi1, gi2, gi3, 1e-3),   # input gradients
+                (g1, g2, g3, 1e-3)):     # parameter gradients
+            floor = maxdiff(ref, same)
+            tol = max(base, 10.0 * floor)
+            diff = maxdiff(ref, other)
+            assert diff <= tol, (
+                f"cross-layout diff {diff:.2e} exceeds tolerance "
+                f"{tol:.2e} (same-layout noise floor {floor:.2e})")
 
     def test_resnet_shortcut_a_channel_pad_concat(self):
         # type-A shortcuts concatenate a zeroed copy along channels — the
