@@ -27,4 +27,5 @@ from bigdl_tpu import dataset
 from bigdl_tpu import parallel
 from bigdl_tpu import utils
 from bigdl_tpu import models
+from bigdl_tpu import serving
 from bigdl_tpu import visualization

@@ -79,6 +79,17 @@ Rules
     cost-analysis lowerings) carry an inline
     ``# lint: allow(untracked-jit)`` with the reason.
 
+``unbounded-queue-in-serving``
+    In the serving package (``bigdl_tpu/serving/``) and the threaded
+    engine file (``engine.py``): a ``queue.Queue()`` /
+    ``queue.SimpleQueue()`` / ``collections.deque()`` constructed
+    without a bound (no ``maxsize=``/``maxlen=``, or an explicit
+    0/None).  An unbounded ring on the request path turns overload into
+    silent memory growth and unbounded tail latency — the admission
+    controller is the ONE place allowed to say no, and it can only do
+    that if every queue behind it is bounded.  (``SimpleQueue`` cannot
+    be bounded at all and always flags.)
+
 ``unguarded-io-in-stage-thread``
     In the ingest stage-thread file (``dataset/ingest.py``), raw file IO
     — builtin ``open(...)`` / ``os.open`` / ``io.open`` / an
@@ -132,6 +143,10 @@ TRACKED_JIT_FILES = (os.path.join("utils", "compile_cache.py"),)
 JIT_NAMES = {"jit", "pjit"}
 
 THREADED_FILES = (os.path.join("dataset", "ingest.py"), "engine.py")
+#: the serving request path: every queue/ring here must be bounded (the
+#: admission controller is the only place allowed to say no)
+SERVING_SCOPE = os.path.join("serving", "")
+QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue"}
 #: files whose threads feed the training loop: raw file IO here must
 #: route through utils.file_io / dataset.seqfile (retry + taxonomy)
 STAGE_THREAD_FILES = (os.path.join("dataset", "ingest.py"),)
@@ -424,6 +439,55 @@ def _rule_unguarded_io(path: str, rel: str, tree: ast.AST) -> List[Finding]:
     return out
 
 
+def _rule_unbounded_queue(path: str, rel: str, tree: ast.AST) -> List[Finding]:
+    """Unbounded ``queue.Queue()``/``deque()`` construction on the
+    serving path: every ring behind the admission controller must carry
+    an explicit bound, or overload becomes silent memory growth."""
+    if not (SERVING_SCOPE in rel or rel.endswith("engine.py")):
+        return []
+    out: List[Finding] = []
+
+    def _flag(node: ast.Call, what: str, fix: str) -> None:
+        out.append(Finding(
+            rel, node.lineno, "unbounded-queue-in-serving",
+            f"{what} without a bound on the serving path — overload must "
+            "be rejected at admission, not absorbed into an unbounded "
+            f"ring; {fix}"))
+
+    def _kw(node: ast.Call, name: str) -> Optional[ast.expr]:
+        for kw in node.keywords:
+            if kw.arg == name:
+                return kw.value
+        return None
+
+    def _unbounding(value: Optional[ast.expr]) -> bool:
+        """True when the bound expression is missing or explicitly
+        0/None (both mean 'infinite' to Queue/deque)."""
+        if value is None:
+            return True
+        return (isinstance(value, ast.Constant) and
+                value.value in (0, None))
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        qual = _qualifier(node)
+        if name in QUEUE_CTORS and qual in ("queue", None):
+            bound = node.args[0] if node.args else _kw(node, "maxsize")
+            if _unbounding(bound):
+                _flag(node, f"{name}()", "pass maxsize=<bound>")
+        elif name == "SimpleQueue" and qual in ("queue", None):
+            _flag(node, "SimpleQueue()",
+                  "it cannot be bounded — use Queue(maxsize=<bound>)")
+        elif name == "deque" and qual in ("collections", None):
+            bound = (node.args[1] if len(node.args) > 1
+                     else _kw(node, "maxlen"))
+            if _unbounding(bound):
+                _flag(node, "deque()", "pass maxlen=<bound>")
+    return out
+
+
 def _handler_swallows(handler: ast.ExceptHandler) -> bool:
     body = [n for n in handler.body
             if not (isinstance(n, ast.Expr) and
@@ -639,6 +703,7 @@ def lint_paths(targets: Sequence[str],
                          _rule_dtype_drop(path, rel, tree) +
                          _rule_untracked_jit(path, rel, tree) +
                          _rule_unguarded_io(path, rel, tree) +
+                         _rule_unbounded_queue(path, rel, tree) +
                          _rule_exceptions(path, rel, tree))
         if any(rel.endswith(t) for t in THREADED_FILES):
             lv = _LockVisitor(rel)

@@ -45,11 +45,20 @@ class DispatchPipeline:
         self.depth = max(1, depth if depth is not None
                          else config.get_int("bigdl.pipeline.depth", 8))
         self._drain = drain
-        self._q = deque()
+        # bounded ring (the unbounded-queue-in-serving lint rule); the
+        # pre-append drain in push() keeps len < depth == maxlen at
+        # every append, so deque's eviction can never actually trigger
+        # and silently drop an undrained item
+        self._q = deque(maxlen=self.depth)
 
     def push(self, out_dev, *meta) -> None:
         if hasattr(out_dev, "copy_to_host_async"):
             out_dev.copy_to_host_async()
+        # drain BEFORE append: even if some future path breaks the
+        # len <= depth-1 post-condition, append happens below capacity
+        # and maxlen never evicts (an invariant guard, not a policy)
+        while len(self._q) >= self.depth:
+            self._pop()
         self._q.append((out_dev,) + meta)
         while len(self._q) >= self.depth:
             self._pop()
@@ -57,6 +66,17 @@ class DispatchPipeline:
     def flush(self) -> None:
         while self._q:
             self._pop()
+
+    def abandon(self) -> int:
+        """Drop every in-flight item WITHOUT draining it — the serving
+        shed path: a consumer that stopped caring must not pay a
+        device→host pull per result it will discard.  Outstanding async
+        copies complete (or are dropped) inside the runtime; ``drain``
+        is never called for them.  Returns how many items were
+        abandoned."""
+        n = len(self._q)
+        self._q.clear()
+        return n
 
     def _pop(self) -> None:
         item = self._q.popleft()
@@ -131,6 +151,11 @@ class BatchPrefetcher:
         # prefetch is enabled
         from bigdl_tpu.utils.random_generator import RandomGenerator
         self._rng = RandomGenerator.RNG()
+        #: producer failure recovered by stop() after the consumer
+        #: abandoned mid-stream (never raised at a call site) — the
+        #: original error must survive the teardown, not vanish with
+        #: the drained queues
+        self.error: Optional[BaseException] = None
         if self.depth <= 0:
             return
         self._q: "queue.Queue" = queue.Queue(maxsize=self.depth)
@@ -217,6 +242,7 @@ class BatchPrefetcher:
             except BaseException as e:  # noqa: BLE001 — re-raised at call
                 item = (e, None)
             if not self._put(out_q, item):
+                self._stash_error(item)
                 return
             if item[0] is not None:
                 return
@@ -235,9 +261,19 @@ class BatchPrefetcher:
                 except BaseException as e:  # noqa: BLE001 — re-raised
                     item = (e, None)
             if not self._put(self._q, item):
+                self._stash_error(item)
                 return
             if item[0] is not None:
                 return
+
+    def _stash_error(self, item) -> None:
+        """A producer stopped while holding an item it could not hand
+        downstream: an ERROR item dropped here would vanish — the one
+        window stop()'s post-join queue drain cannot see — so park it on
+        ``self.error`` directly (threads are joined before the drain
+        reads it)."""
+        if item[0] is not None and self.error is None:
+            self.error = item[0]
 
     def __call__(self):
         if self.depth <= 0:
@@ -250,12 +286,28 @@ class BatchPrefetcher:
     def stop(self):
         """Stop and JOIN the producers: a retry-from-failure restart must
         not race a still-running old producer over the same dataset
-        iterators."""
-        if self.depth > 0:
-            self._stop.set()
-            self._thread.join(timeout=10)
-            if self._transfer_thread is not None:
-                self._transfer_thread.join(timeout=10)
+        iterators.  A consumer ABANDONING mid-stream (the serving shed
+        path) calls this too — after the join, any producer error still
+        parked in the rings is recovered onto ``self.error`` so the
+        original failure surfaces instead of being torn down with the
+        queues."""
+        if self.depth <= 0:
+            return
+        self._stop.set()
+        self._thread.join(timeout=10)
+        if self._transfer_thread is not None:
+            self._transfer_thread.join(timeout=10)
+        import queue as _queue
+        for q in (self._q, getattr(self, "_issued_q", None)):
+            if q is None:
+                continue
+            while True:
+                try:
+                    err, _ = q.get(block=False)
+                except _queue.Empty:
+                    break
+                if err is not None and self.error is None:
+                    self.error = err
 
 
 class _EngineState:

@@ -202,6 +202,16 @@ def evaluate_dataset(model: Module, dataset,
         pipeline.flush()
         if distributed_partials:
             totals = _merge_partials_across_processes(methods, totals)
+        if methods and all(t is None for t in totals):
+            # zero batches (globally, in the distributed case — the
+            # merge leaves every slot None only when no process saw a
+            # record, so all processes raise together): a metric over
+            # nothing is a silent lie, not a score.  Raise a CLEAR error
+            # instead of returning [] for callers to trip over later.
+            raise ValueError(
+                "evaluate_dataset got an empty dataset: no batches to "
+                "score — feed at least one record, or skip validation "
+                "for this trigger")
         return [(m, t) for m, t in zip(methods, totals) if t is not None]
     finally:
         if was_training:

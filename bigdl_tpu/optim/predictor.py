@@ -90,6 +90,10 @@ class Predictor:
                 pipeline.push(fwd(_to_device(inputs)), n)
             pipeline.flush()
             if not outs:
+                # an empty dataset predicts an empty array, not None:
+                # ``_batches`` ends without yielding, so nothing above
+                # ran — callers doing ``len(out)`` / ``np.concatenate``
+                # downstream must keep working
                 return np.zeros((0,))
             return np.concatenate(outs, axis=0)
         finally:
@@ -99,4 +103,8 @@ class Predictor:
     def predict_class(self, dataset, batch_size: int = 32) -> np.ndarray:
         """1-based argmax class ids (reference ``predictClass``)."""
         out = self.predict(dataset, batch_size)
+        if out.size == 0:
+            # argmax over a zero-length axis raises; an empty dataset
+            # classifies to an empty id array, mirroring predict()
+            return np.zeros((0,), dtype=np.int64)
         return out.argmax(axis=-1) + 1

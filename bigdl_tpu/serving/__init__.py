@@ -1,0 +1,46 @@
+"""Overload-tolerant serving: the admission-controlled request path.
+
+The repo's ``Predictor`` serves an *array*; production serves a *queue*.
+This package is the request path built on top of ``Predictor``/
+``fold_bn`` whose headline property is that it degrades gracefully
+instead of falling over:
+
+- :class:`~bigdl_tpu.serving.engine.ServingEngine` — a bounded admission
+  queue with per-request deadlines feeding a continuous micro-batching
+  dispatcher: requests coalesce up to ``bigdl.serving.maxBatch``, pad to
+  the ``bigdl.compile.buckets`` shape plan (zero post-warmup retraces
+  under arbitrary arrival patterns), execute through the tracked compile
+  cache, and fan back per-request.
+- Robustness is the build, not a bolt-on: admission rejects fast with a
+  structured :class:`~bigdl_tpu.serving.engine.Overloaded` (reject at
+  the door, never silent tail-latency collapse); expired requests are
+  shed at dequeue time before wasting a device slot; a poison-request
+  quarantine (:class:`~bigdl_tpu.serving.engine.ServingDataError` vs
+  :class:`~bigdl_tpu.serving.engine.ServingInfraError` — the PR 7
+  taxonomy) fails the one offending request and keeps the batch alive;
+  a hung-dispatch watchdog aborts a wedged dispatch and cools the
+  engine down; SIGTERM drains in-flight work within
+  ``bigdl.serving.gracePeriod`` and rejects late arrivals retriably.
+- :mod:`~bigdl_tpu.serving.loadgen` — the Poisson open-loop load
+  generator the bench leg (``bench.py --serving-only``) and the chaos
+  proofs drive the engine with, including the ``bigdl.chaos.
+  burstArrivals`` thundering-herd injector.
+
+Everything is instrumented through the PR 5 metrics registry
+(``Serving/*``: latency percentiles, queue depth, outcome counters,
+batch-occupancy histogram) with Prometheus export, and chaos-proven by
+the ``bigdl.chaos.slowRequestAt`` / ``poisonRequestAt`` /
+``hangDispatchAt`` / ``burstArrivals`` injectors.
+"""
+
+from bigdl_tpu.serving.engine import (HungDispatchError, Overloaded,
+                                      RequestHandle, ServingDataError,
+                                      ServingEngine, ServingError,
+                                      ServingInfraError)
+from bigdl_tpu.serving.loadgen import run_open_loop
+
+__all__ = [
+    "ServingEngine", "RequestHandle", "ServingError", "Overloaded",
+    "ServingDataError", "ServingInfraError", "HungDispatchError",
+    "run_open_loop",
+]

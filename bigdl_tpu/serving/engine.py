@@ -1,0 +1,710 @@
+"""The serving engine: bounded admission, micro-batching, graceful decay.
+
+Request lifecycle (every submitted request terminates with EXACTLY one
+outcome — the accounting identity the chaos proofs assert)::
+
+    submit ──► rejected   (Overloaded at the door: queue full, projected
+       │                   wait past the deadline budget, cooldown after
+       │                   a watchdog fire, or draining — always fast,
+       │                   always structured, retriable where retrying
+       │                   elsewhere can help)
+       ▼
+    admission queue (bounded: bigdl.serving.maxQueueDepth)
+       │
+       ▼  batcher thread coalesces up to bigdl.serving.maxBatch
+    ── shed        (deadline expired at DEQUEUE time — before the
+       │            request wastes a device slot; also: in-flight
+       │            victims of a hung-dispatch abort, and requests left
+       │            queued when the drain grace period lapses)
+    ── quarantined (poison payload: undecodable / ill-shaped — a
+       │            ServingDataError fails the ONE offending request
+       │            and the batch stays alive)
+       ▼
+    dispatch (pad to the compile-bucket plan → tracked executable →
+       │      one explicit host pull) ──► completed (per-row fan-out)
+
+The dispatcher pads every batch to ``bigdl.compile.buckets`` (falling
+back to a single ``maxBatch`` bucket when unset), so arbitrary request
+arrival patterns hit only pre-compiled signatures — the PR 4 strict
+retrace sentinel proves zero post-warmup retraces.  A hung dispatch is
+aborted by :class:`HungDispatchWatchdog` (the PR 6 async-raise machinery
+with the PR 5 warmup-minimum EMA seeding) and the engine re-admits after
+``bigdl.serving.cooldownSteps`` batches (or as soon as the backlog
+clears).  SIGTERM — via the PR 6 ``elastic`` preemption flag — stops
+admission, drains in-flight batches within ``bigdl.serving.gracePeriod``
+seconds, and rejects late arrivals with a retriable marker.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import queue
+import threading
+import time
+from contextlib import nullcontext
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from bigdl_tpu import telemetry
+from bigdl_tpu.utils import elastic
+
+logger = logging.getLogger("bigdl_tpu")
+
+
+class ServingError(RuntimeError):
+    """Base class of the serving-path taxonomy.  ``retriable`` tells the
+    client whether the same payload can succeed later / elsewhere."""
+
+    retriable = False
+
+
+class Overloaded(ServingError):
+    """Admission control said no — at the door, in microseconds.  The
+    structured alternative to silent tail-latency collapse: the client
+    learns queue depth, the projected wait, and whether a retry can help
+    (it can, except when its own deadline already cannot be met)."""
+
+    retriable = True
+
+    def __init__(self, reason: str, queue_depth: int = 0,
+                 max_depth: int = 0,
+                 projected_wait_ms: Optional[float] = None,
+                 deadline_ms: Optional[float] = None):
+        self.reason = reason
+        self.queue_depth = queue_depth
+        self.max_depth = max_depth
+        self.projected_wait_ms = projected_wait_ms
+        self.deadline_ms = deadline_ms
+        detail = f"rejected at admission ({reason}): depth " \
+                 f"{queue_depth}/{max_depth}"
+        if projected_wait_ms is not None:
+            detail += (f", projected wait {projected_wait_ms:.1f} ms vs "
+                       f"deadline {deadline_ms:.1f} ms")
+        super().__init__(detail + " — retriable")
+
+
+class DeadlineExceeded(ServingError):
+    """The request aged past its deadline while queued and was shed at
+    dequeue time — it never occupied a device slot."""
+
+    retriable = True
+
+    def __init__(self, waited_ms: float, deadline_ms: float):
+        self.waited_ms = waited_ms
+        self.deadline_ms = deadline_ms
+        super().__init__(
+            f"shed: waited {waited_ms:.1f} ms in queue, deadline was "
+            f"{deadline_ms:.1f} ms — retriable (but mind your own deadline)")
+
+
+class ServingDataError(ServingError):
+    """A poison request: undecodable or ill-shaped payload.  A DATA
+    fault — quarantined, never retried (re-decoding poison yields
+    poison), and never allowed to kill the batch it rode in with."""
+
+    retriable = False
+
+
+class ServingInfraError(ServingError):
+    """An infrastructure fault on the serving path (dispatch failure,
+    drain timeout): the request payload is fine — retry it."""
+
+    retriable = True
+
+
+class HungDispatchError(ServingInfraError):
+    """Injected into the batcher thread by the hung-dispatch watchdog: a
+    dispatch exceeded ``bigdl.serving.stallFactor`` x the batch-time
+    EMA.  In-flight requests fail with this diagnosis; the engine cools
+    down before re-admitting."""
+
+
+class HungDispatchWatchdog(elastic.HungStepWatchdog):
+    """The PR 6 hung-step machinery pointed at the serving batcher: same
+    monitor thread, same warmup-minimum EMA seeding, same async-raise
+    abort — but it injects :class:`HungDispatchError` and counts under
+    ``Serving/watchdog_*``."""
+
+    EXC = HungDispatchError
+    METRIC_PREFIX = "Serving"
+    INSTANT_NAME = "serving/hung_dispatch"
+
+
+#: terminal request outcomes — the accounting identity is
+#: completed + shed + rejected + quarantined == submitted
+OUTCOMES = ("completed", "shed", "rejected", "quarantined")
+
+
+class RequestHandle:
+    """One admitted request: a one-shot future whose terminal state is
+    exactly one of :data:`OUTCOMES` (``_finish`` is first-wins, so a
+    request can never be both shed by the drain and completed by a
+    racing dispatch)."""
+
+    __slots__ = ("raw", "index", "submit_ns", "deadline_ns", "finish_ns",
+                 "outcome", "_result", "_error", "_done")
+
+    def __init__(self, raw, index: int, submit_ns: int, deadline_ns: int):
+        self.raw = raw
+        self.index = index            # admission position (chaos plans key on it)
+        self.submit_ns = submit_ns
+        self.deadline_ns = deadline_ns
+        self.finish_ns: Optional[int] = None
+        self.outcome: Optional[str] = None
+        self._result = None
+        self._error: Optional[BaseException] = None
+        self._done = threading.Event()
+
+    def _finish(self, outcome: str, result=None,
+                error: Optional[BaseException] = None) -> bool:
+        if self._done.is_set():
+            return False
+        self.outcome = outcome
+        self._result = result
+        self._error = error
+        self.finish_ns = telemetry.clock_ns()
+        self._done.set()
+        return True
+
+    def latency_ms(self) -> Optional[float]:
+        """Submit-to-terminal-state latency; None while in flight."""
+        if self.finish_ns is None:
+            return None
+        return (self.finish_ns - self.submit_ns) / 1e6
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        """The per-request model output, or raises the terminal error
+        (:class:`DeadlineExceeded` / :class:`ServingDataError` / ...)."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.index} still in flight after {timeout} s")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def error(self) -> Optional[BaseException]:
+        return self._error if self._done.is_set() else None
+
+
+def _service_ema(warmup: int):
+    """The admission controller's batch service-time estimator: a PR 5
+    :class:`~bigdl_tpu.telemetry.step_stats.SlowStepDetector` used as a
+    pure warmup-minimum-seeded EMA (``factor=inf`` — nothing is ever
+    'slow'; detection is the watchdog's job, this one only projects
+    queue waits).  One implementation of the compile-exemption seeding,
+    not a parallel copy."""
+    from bigdl_tpu.telemetry import SlowStepDetector
+    return SlowStepDetector(math.inf, warmup=warmup, cooldown=0)
+
+
+class ServingEngine:
+    """Continuous micro-batching inference server over one model.
+
+    ``fold_bn=True`` serves a clone with every conv+BN pair folded (the
+    ``Predictor`` contract); the forward executes through the tracked
+    compile cache, so with ``bigdl.compile.cacheDir`` armed a second
+    process warm-loads instead of compiling.  All knobs default from
+    ``bigdl.serving.*`` (see ``docs/configuration.md``); constructor
+    arguments override per-engine.
+    """
+
+    def __init__(self, model, fold_bn: bool = False,
+                 max_batch: Optional[int] = None,
+                 max_queue_depth: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 admission_factor: Optional[float] = None,
+                 stall_factor: Optional[float] = None,
+                 grace_period: Optional[float] = None,
+                 cooldown_batches: Optional[int] = None,
+                 start: bool = True):
+        from bigdl_tpu.utils import compile_cache, config
+        from bigdl_tpu.optim.predictor import Predictor
+        self.model = Predictor(model, fold_bn=fold_bn).model
+        self.max_batch = int(max_batch if max_batch is not None else
+                             config.get_int("bigdl.serving.maxBatch", 16))
+        self.max_queue_depth = int(
+            max_queue_depth if max_queue_depth is not None else
+            config.get_int("bigdl.serving.maxQueueDepth", 128))
+        self.deadline_ms = float(
+            deadline_ms if deadline_ms is not None else
+            config.get_float("bigdl.serving.deadlineMs", 1000.0))
+        self.admission_factor = float(
+            admission_factor if admission_factor is not None else
+            config.get_float("bigdl.serving.admissionDeadlineFactor", 1.0))
+        self.stall_factor = float(
+            stall_factor if stall_factor is not None else
+            config.get_float("bigdl.serving.stallFactor", 0.0))
+        self.grace_period = float(
+            grace_period if grace_period is not None else
+            config.get_float("bigdl.serving.gracePeriod", 5.0))
+        self.cooldown_batches = int(
+            cooldown_batches if cooldown_batches is not None else
+            config.get_int("bigdl.serving.cooldownSteps", 8))
+        self.linger_ms = config.get_float("bigdl.serving.lingerMs", 0.0)
+        self.poll_interval = config.get_float("bigdl.serving.pollInterval",
+                                              0.05)
+        self.warmup_batches = config.get_int("bigdl.serving.warmupBatches",
+                                             3)
+        # the shape plan: every dispatch pads to a bucket, so arrival
+        # patterns can never mint a new signature.  maxBatch is always
+        # IN the plan — otherwise an occupancy past the largest
+        # configured bucket would round to a multiple warmup never
+        # compiled and pay a full compile against its batch's deadlines
+        self._buckets = sorted(set(
+            (compile_cache.configured_buckets() or []) + [self.max_batch]))
+        from bigdl_tpu.optim.evaluator import _eval_forward
+        self._forward = _eval_forward(self.model)
+        # the admission queue IS the bound: put_nowait + Full -> Overloaded
+        self._q: "queue.Queue[RequestHandle]" = queue.Queue(
+            maxsize=self.max_queue_depth)
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = dict.fromkeys(OUTCOMES, 0)
+        self._counts["submitted"] = 0
+        self._next_index = 0
+        self._cooldown = 0
+        self._draining = False
+        self._drain_deadline: Optional[float] = None
+        self._drain_reason = ""
+        self._closed = False
+        self._started = False
+        self._stop_event = threading.Event()
+        self._template: Optional[Tuple[Tuple[int, ...], str]] = None
+        self._ema = _service_ema(self.warmup_batches)
+        self.batches = 0
+        self.watchdog: Optional[HungDispatchWatchdog] = None
+        self._thread: Optional[threading.Thread] = None
+        window = config.get_int("bigdl.telemetry.percentileWindow", 512)
+        self._latency = telemetry.histogram(
+            "Serving/latency_ms", window=window,
+            help="per-request submit-to-result latency")
+        self._occupancy = telemetry.histogram(
+            "Serving/batch_occupancy",
+            help="true (unpadded) requests per dispatched batch")
+        if start:
+            self.start()
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "ServingEngine":
+        if self._started:
+            return self
+        self._started = True
+        self._thread = threading.Thread(target=self._batcher_loop,
+                                        daemon=True,
+                                        name="serving-batcher")
+        self._thread.start()
+        return self
+
+    def warmup(self, example_row: np.ndarray) -> None:
+        """AOT: run one forward per facts-on-the-ground bucket so the
+        first real request never pays a compile against its deadline.
+        ``example_row`` is one request payload; it also pins the row
+        template (shape+dtype) later requests are validated against."""
+        row = np.asarray(example_row)
+        self._template = (row.shape, str(row.dtype))
+        biggest = max(self._buckets)
+        batch = np.broadcast_to(row, (biggest,) + row.shape).copy()
+        # one call per bucket: with configured buckets the first call's
+        # AOT precompile covers the rest, but calling each keeps the
+        # no-bucket (single maxBatch bucket) path identical
+        for b in self._buckets:
+            self._run_forward(batch[:b])
+
+    def stop(self, grace: Optional[float] = None) -> None:
+        """Graceful shutdown: admission closes (late arrivals get a
+        retriable :class:`Overloaded`), queued work drains within
+        ``grace`` (default ``bigdl.serving.gracePeriod``) and leftovers
+        are shed retriably.  Idempotent."""
+        if not self._started or self._closed:
+            self._closed = True     # before the sweep — see _batcher_loop
+            self._drain_leftovers()
+            return
+        with self._lock:
+            if not self._draining:
+                self._begin_drain_locked("stop", time.monotonic(),
+                                         grace)
+            elif grace is not None:
+                # a drain is already running (e.g. preemption started
+                # it) — an explicit stop(grace=...) re-budgets it, so
+                # the caller's window and the join timeout below agree
+                self._drain_deadline = time.monotonic() + grace
+        self._stop_event.set()
+        t = self._thread
+        if t is not None:
+            budget = (grace if grace is not None else self.grace_period)
+            t.join(timeout=budget + 10.0)
+        self._drain_leftovers()
+        self._closed = True
+
+    def close(self) -> None:
+        self.stop()
+
+    def __enter__(self) -> "ServingEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- admission --------------------------------------------------------
+
+    def submit(self, inputs, deadline_ms: Optional[float] = None
+               ) -> RequestHandle:
+        """Admit one request or raise :class:`Overloaded` — fast, at the
+        door, before the request can rot in a queue it will never leave
+        in time.  Returns a :class:`RequestHandle` future."""
+        now = telemetry.clock_ns()
+        deadline = float(deadline_ms if deadline_ms is not None
+                         else self.deadline_ms)
+        telemetry.counter("Serving/submitted").inc()
+        with self._lock:
+            self._counts["submitted"] += 1
+            if self._closed or (self._stop_event.is_set() and
+                                not self._draining):
+                raise self._reject_locked("closed")
+            if self._draining:
+                raise self._reject_locked("draining")
+            if self._cooldown > 0:
+                raise self._reject_locked("cooldown")
+            depth = self._q.qsize()
+            if depth >= self.max_queue_depth:
+                raise self._reject_locked("queue full", depth)
+            ema = self._ema.ema
+            if ema is not None:
+                waves = math.ceil((depth + 1) / self.max_batch)
+                projected = waves * ema
+                if projected > self.admission_factor * deadline:
+                    raise self._reject_locked(
+                        "projected wait", depth, projected_wait_ms=projected,
+                        deadline_ms=deadline)
+            req = RequestHandle(inputs, self._next_index, now,
+                                now + int(deadline * 1e6))
+            self._next_index += 1
+        try:
+            self._q.put_nowait(req)
+        except queue.Full:
+            # a racing submit filled the last slot between the depth
+            # check and here — same answer, same speed (the request's
+            # admission index is abandoned; positions may skip, never
+            # repeat)
+            with self._lock:
+                raise self._reject_locked("queue full",
+                                          self.max_queue_depth)
+        if self._closed:
+            # the batcher exited between the admission check and the
+            # enqueue (it marks _closed BEFORE its final leftover sweep,
+            # so whichever of the two sweeps runs last sees this
+            # request): nobody will ever pop the queue again — shed it
+            # retriably NOW rather than strand it unaccounted
+            self._drain_leftovers()
+        telemetry.gauge("Serving/queue_depth").set(self._q.qsize())
+        return req
+
+    def _reject_locked(self, reason: str, depth: Optional[int] = None,
+                       **kw) -> Overloaded:
+        """Build the structured rejection and account it (caller raises).
+        Runs under ``self._lock``."""
+        self._counts["rejected"] += 1
+        telemetry.counter("Serving/rejected").inc()
+        telemetry.counter("Serving/rejected",
+                          labels={"reason": reason.replace(" ", "_")}).inc()
+        return Overloaded(reason,
+                          queue_depth=(depth if depth is not None
+                                       else self._q.qsize()),
+                          max_depth=self.max_queue_depth, **kw)
+
+    # -- accounting -------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        """Outcome counters plus the accounting identity residual
+        (``unaccounted`` includes requests still in flight — read after
+        quiescing for the exact identity)."""
+        with self._lock:
+            out: Dict[str, Any] = dict(self._counts)
+        out["unaccounted"] = out["submitted"] - sum(out[o] for o in OUTCOMES)
+        out["batches"] = self.batches
+        out["queue_depth"] = self._q.qsize()
+        out["batch_ema_ms"] = self._ema.ema
+        out["cooldown"] = self._cooldown
+        out["draining"] = self._draining
+        return out
+
+    @property
+    def sentinel(self):
+        """The retrace sentinel guarding the serving forward (present
+        when ``bigdl.compile.buckets`` is configured) — the chaos proof
+        reads ``sentinel.retraces`` to assert zero post-warmup
+        retraces."""
+        fn = getattr(self.model, "_eval_jit", {}).get(id(None))
+        return getattr(fn, "sentinel", None)
+
+    def _account(self, req: RequestHandle, outcome: str,
+                 error: Optional[BaseException] = None,
+                 result=None, reason: Optional[str] = None) -> bool:
+        if not req._finish(outcome, result=result, error=error):
+            return False
+        with self._lock:
+            self._counts[outcome] += 1
+        telemetry.counter(f"Serving/{outcome}").inc()
+        if reason:
+            telemetry.counter(f"Serving/{outcome}",
+                              labels={"reason": reason}).inc()
+        if outcome == "completed":
+            self._latency.observe(req.latency_ms())
+        return True
+
+    # -- the batcher thread -----------------------------------------------
+
+    def _batcher_loop(self) -> None:
+        telemetry.name_thread("serving-batcher")
+        wd = None
+        if self.stall_factor > 0:
+            wd = HungDispatchWatchdog(
+                self.stall_factor, warmup=self.warmup_batches,
+                cooldown=self.cooldown_batches,
+                poll_interval=min(self.poll_interval, 0.05))
+            wd.start()                    # driver tid = this thread
+            self.watchdog = wd
+        try:
+            while True:
+                if not self._draining:
+                    if elastic.preemption_requested():
+                        with self._lock:
+                            self._begin_drain_locked(
+                                "preemption",
+                                elastic.preemption_requested_at() or
+                                time.monotonic())
+                    elif self._stop_event.is_set():
+                        with self._lock:
+                            self._begin_drain_locked("stop",
+                                                     time.monotonic())
+                if self._draining:
+                    if self._q.empty():
+                        break
+                    if time.monotonic() > self._drain_deadline:
+                        self._drain_leftovers()
+                        break
+                try:
+                    with (wd.paused() if wd is not None else nullcontext()):
+                        first = self._q.get(timeout=self.poll_interval)
+                except queue.Empty:
+                    with self._lock:
+                        if self._cooldown:
+                            # backlog clear: nothing left to prove — a
+                            # cooldown with no traffic would never end
+                            self._cooldown = 0
+                    continue
+                batch: List[RequestHandle] = []
+                try:
+                    self._assemble(first, batch, wd)
+                    if batch:
+                        self._dispatch_batch(batch, wd)
+                    elif wd is not None:
+                        # a round that shed/quarantined everything it
+                        # popped supervised no dispatch: restart the
+                        # open interval so shed-storm bookkeeping can
+                        # never accumulate into a spurious fire
+                        wd.reset_interval()
+                except HungDispatchError:
+                    # re-raise the injected (argument-less) class as a
+                    # DIAGNOSED instance: clients see why their request
+                    # died, not just that it did
+                    ema = self._ema.ema
+                    baseline = (f"{ema:.1f} ms EMA" if ema is not None
+                                else "unseeded EMA")
+                    diag = HungDispatchError(
+                        f"dispatch wedged past {self.stall_factor:.1f}x "
+                        f"the batch-time baseline ({baseline}) — the "
+                        "hung-dispatch watchdog aborted it")
+                    self._abort_inflight(batch, diag, "hung_dispatch", wd,
+                                         cool=True)
+                except Exception as e:  # noqa: BLE001 — engine must outlive
+                    self._abort_inflight(
+                        batch,
+                        ServingInfraError(f"dispatch failed: {e!r}"),
+                        "infra", wd, cool=False)
+        finally:
+            if wd is not None:
+                wd.stop()
+            # _closed BEFORE the sweep: a racing submit that enqueued
+            # past the drain either observes _closed (and sheds its own
+            # request) or enqueued before this sweep (which sheds it) —
+            # exactly one of the two, never neither
+            self._closed = True
+            self._drain_leftovers()
+
+    def _begin_drain_locked(self, reason: str, started_at: float,
+                            grace: Optional[float] = None) -> None:
+        """Enter drain mode (callers hold ``self._lock``): admission now
+        rejects retriably, the batcher keeps dispatching until the queue
+        empties or the grace clock — started when the preemption/stop was
+        REQUESTED, not when the batcher noticed — runs out.  The
+        deadline is published BEFORE the flag: the batcher reads both
+        lock-free, and flag-first would let it compare against a still-
+        None deadline."""
+        budget = grace if grace is not None else self.grace_period
+        self._drain_deadline = started_at + budget
+        self._drain_reason = reason
+        self._draining = True
+        logger.info("serving engine draining (%s): grace %.1f s, "
+                    "%d request(s) queued", reason, budget,
+                    self._q.qsize())
+
+    def _drain_leftovers(self) -> None:
+        """Shed everything still queued (drain deadline lapsed, or the
+        engine is going down) — retriable by construction: the payloads
+        were never the problem."""
+        shed = 0
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                break
+            err = ServingInfraError(
+                "engine draining: request was not dispatched within the "
+                "grace period — retriable")
+            shed += self._account(req, "shed", error=err, reason="drained")
+        if shed:
+            logger.warning("serving drain shed %d queued request(s)", shed)
+        telemetry.gauge("Serving/queue_depth").set(self._q.qsize())
+
+    def _assemble(self, first: RequestHandle, batch: List[RequestHandle],
+                  wd) -> None:
+        """Coalesce up to ``maxBatch`` VALID requests into ``batch``:
+        expired ones are shed (cheap, before any device work), poison
+        ones quarantined — neither consumes a slot."""
+        from bigdl_tpu.utils import chaos
+        req: Optional[RequestHandle] = first
+        linger_until = (time.monotonic() + self.linger_ms / 1e3
+                        if self.linger_ms > 0 else None)
+        while True:
+            if req is not None:
+                now = telemetry.clock_ns()
+                if now > req.deadline_ns:
+                    waited = (now - req.submit_ns) / 1e6
+                    deadline = (req.deadline_ns - req.submit_ns) / 1e6
+                    self._account(
+                        req, "shed",
+                        error=DeadlineExceeded(waited, deadline),
+                        reason="expired")
+                else:
+                    try:
+                        row = self._decode(req, chaos)
+                    except ServingDataError as e:
+                        self._account(req, "quarantined", error=e)
+                    else:
+                        req.raw = row
+                        batch.append(req)
+            if len(batch) >= self.max_batch:
+                break
+            try:
+                req = self._q.get_nowait()
+                continue
+            except queue.Empty:
+                req = None
+            if linger_until is None or not batch:
+                break
+            remaining = linger_until - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                with (wd.paused() if wd is not None else nullcontext()):
+                    req = self._q.get(timeout=remaining)
+            except queue.Empty:
+                break
+        telemetry.gauge("Serving/queue_depth").set(self._q.qsize())
+
+    def _decode(self, req: RequestHandle, chaos) -> np.ndarray:
+        """Per-request validation — the taxonomy choke point: anything
+        wrong with the PAYLOAD raises :class:`ServingDataError` here,
+        where it can fail one request instead of a batch."""
+        chaos.on_serving_request(req.index)
+        if chaos.poison_request(req.index):
+            raise ServingDataError(
+                f"chaos: poison request at admission position {req.index}")
+        try:
+            row = np.asarray(req.raw)
+        except Exception as e:
+            raise ServingDataError(
+                f"undecodable request payload: {e!r}") from e
+        if not np.issubdtype(row.dtype, np.number):
+            raise ServingDataError(
+                f"non-numeric request payload (dtype {row.dtype})")
+        if self._template is None:
+            self._template = (row.shape, str(row.dtype))
+        elif (row.shape, str(row.dtype)) != self._template:
+            raise ServingDataError(
+                f"ill-shaped request: got {row.shape} {row.dtype}, this "
+                f"engine serves {self._template[0]} {self._template[1]} "
+                "(a mismatched row would retrace the fused step for "
+                "everyone)")
+        return row
+
+    def _run_forward(self, rows: np.ndarray):
+        """Pad to the bucket plan, execute the tracked executable, pull
+        host results once, slice the padding back off."""
+        from bigdl_tpu.analysis.hostsync import host_pull
+        from bigdl_tpu.engine import to_device
+        from bigdl_tpu.utils import compile_cache
+        n = rows.shape[0]
+        eff = compile_cache.bucket_size(n, self._buckets)
+        inputs = (compile_cache.pad_batch(rows, n, eff)
+                  if eff != n else rows)
+        out_dev = self._forward(to_device(inputs))
+        out = host_pull(out_dev, what="serving outputs")
+        return compile_cache.slice_rows(out, n)
+
+    def _dispatch_batch(self, batch: List[RequestHandle], wd) -> None:
+        from bigdl_tpu.utils import chaos
+        t0 = telemetry.clock_ns()
+        self.batches += 1
+        chaos.on_dispatch(f"batch {self.batches}")
+        out = self._run_forward(np.stack([r.raw for r in batch]))
+        import jax
+        for i, req in enumerate(batch):
+            row_out = jax.tree_util.tree_map(lambda x, _i=i: x[_i], out)
+            self._account(req, "completed", result=row_out)
+        ms = (telemetry.clock_ns() - t0) / 1e6
+        self._ema.observe(ms)
+        if wd is not None:
+            wd.heartbeat()
+        with self._lock:
+            if self._cooldown > 0:
+                self._cooldown -= 1
+        self._occupancy.observe(len(batch))
+        telemetry.counter("Serving/batches").inc()
+        g = telemetry.gauge
+        g("Serving/batch_ms").set(ms)
+        for q in (50, 95, 99):
+            g(f"Serving/p{q}_ms").set(self._latency.percentile(q))
+
+    def _abort_inflight(self, batch: List[RequestHandle],
+                        error: ServingError, reason: str, wd,
+                        cool: bool) -> None:
+        """A dispatch died under the batch: fail every unfinished
+        in-flight request with the diagnosis and — for a hung dispatch —
+        close admission until the engine proves itself again
+        (``cooldownSteps`` clean batches, or the backlog clearing).
+        Each victim gets its OWN exception instance: concurrent
+        ``result()`` raises on a shared object would interleave
+        tracebacks across client threads."""
+        failed = sum(
+            self._account(r, "shed", error=type(error)(*error.args),
+                          reason=reason)
+            for r in batch)
+        if cool:
+            with self._lock:
+                self._cooldown = max(self._cooldown, self.cooldown_batches)
+        logger.error(
+            "serving dispatch aborted (%s): %d in-flight request(s) "
+            "failed with %s%s", reason, failed, type(error).__name__,
+            f"; cooling down for {self.cooldown_batches} batches"
+            if cool else "")
+        if wd is not None:
+            # the stall is over from the monitor's view: reset its open
+            # interval so the NEXT dispatch is judged on its own clock
+            wd.heartbeat()

@@ -1,0 +1,113 @@
+"""Poisson open-loop load generation with exact outcome accounting.
+
+Open loop is the load model that exposes overload: arrivals come on
+their own clock (exponential inter-arrival gaps), never waiting for
+completions, so a server that slows down faces a GROWING queue instead
+of a conveniently self-throttling client.  The generator is also the
+consumer of the ``bigdl.chaos.burstArrivals`` injector — a thundering
+herd is an *arrival-process* fault, so it is injected where arrivals
+are made.
+
+Accounting: every submission lands in exactly one bucket — ``completed``
+/ ``shed`` / ``rejected`` / ``quarantined`` — and the returned record
+carries the identity residual (``unaccounted``, asserted zero by the
+chaos proofs and the bench leg).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.serving.engine import OUTCOMES, Overloaded, ServingEngine
+
+
+def run_open_loop(engine: ServingEngine, payloads: Sequence[Any],
+                  rate_hz: float, deadline_ms: Optional[float] = None,
+                  seed: int = 0,
+                  on_arrival: Optional[Callable[[int], None]] = None,
+                  result_timeout_s: float = 30.0) -> Dict[str, Any]:
+    """Drive ``engine`` with one Poisson open-loop pass over
+    ``payloads``.
+
+    ``rate_hz``: mean arrival rate (0 = back-to-back, the pure burst).
+    ``on_arrival(i)`` runs before arrival ``i`` is submitted — the chaos
+    proofs hook preemption signals here.  Returns the accounting
+    record::
+
+        {submitted, completed, shed, rejected, quarantined, unaccounted,
+         latency_ms: [...], reject_latency_ms: [...],
+         results: {arrival_key: np.ndarray},
+         errors: {arrival_key: Exception},
+         handles: [(arrival_key, RequestHandle | None)]}
+
+    ``arrival_key`` is ``str(i)`` for scheduled arrivals and ``"i+bj"``
+    for the j-th extra arrival of a ``bigdl.chaos.burstArrivals`` herd
+    at position ``i``.
+    """
+    from bigdl_tpu.utils import chaos
+    rng = np.random.default_rng(seed)
+    handles: List = []
+    reject_latency_ms: List[float] = []
+    errors: Dict[str, BaseException] = {}
+    submitted = 0
+    next_due = time.monotonic()
+
+    def _arrive(key: str, payload) -> None:
+        nonlocal submitted
+        submitted += 1
+        t0 = time.monotonic()
+        try:
+            h = engine.submit(payload, deadline_ms=deadline_ms)
+        except Overloaded as e:
+            # the reject path must be FAST — its latency is a headline
+            # claim of the bench leg
+            reject_latency_ms.append((time.monotonic() - t0) * 1e3)
+            errors[key] = e
+            handles.append((key, None))
+        else:
+            handles.append((key, h))
+
+    for i, payload in enumerate(payloads):
+        if on_arrival is not None:
+            on_arrival(i)
+        now = time.monotonic()
+        if now < next_due:
+            time.sleep(next_due - now)
+        _arrive(str(i), payload)
+        for j in range(chaos.burst_arrivals(i)):
+            # a herd arrives back-to-back, on top of the schedule
+            _arrive(f"{i}+b{j}", payload)
+        if rate_hz > 0:
+            next_due = max(next_due, now) + float(
+                rng.exponential(1.0 / rate_hz))
+
+    # quiesce: every admitted request must reach its one terminal state
+    results: Dict[str, Any] = {}
+    latency_ms: List[float] = []
+    counts = dict.fromkeys(OUTCOMES, 0)
+    for key, h in handles:
+        if h is None:
+            counts["rejected"] += 1
+            continue
+        try:
+            results[key] = h.result(timeout=result_timeout_s)
+        except TimeoutError:
+            pass            # stays unaccounted — the identity will flag it
+        except Exception as e:  # terminal serving error
+            errors[key] = e
+        if h.outcome in counts:
+            counts[h.outcome] += 1
+        if h.outcome == "completed":
+            latency_ms.append(h.latency_ms())
+
+    record: Dict[str, Any] = {"submitted": submitted, **counts}
+    record["unaccounted"] = submitted - sum(counts[o] for o in OUTCOMES)
+    record["latency_ms"] = latency_ms
+    record["reject_latency_ms"] = reject_latency_ms
+    record["results"] = results
+    record["errors"] = errors
+    record["handles"] = handles
+    return record

@@ -85,6 +85,29 @@ identically under pytest, a soak script, or a real cluster rehearsal:
                                 within ``bigdl.compile.timeoutSec`` and
                                 abort with a diagnosed
                                 ``CompileTimeoutError``.
+``bigdl.chaos.slowRequestAt``   "k" or "k:seconds": the k-th serving request
+                                PROCESSED by the batcher stalls its handling
+                                for ``seconds`` (default 5.0) — requests
+                                queued behind it blow their deadlines, which
+                                the dequeue-time shed must absorb (once per
+                                plan).
+``bigdl.chaos.poisonRequestAt`` "k" or "k:m": serving requests with admission
+                                position k..m (0-based) read as poison — the
+                                per-request quarantine must fail exactly
+                                those with ``ServingDataError`` and keep
+                                their batches alive (once per position).
+``bigdl.chaos.hangDispatchAt``  "k" or "k:seconds": the k-th serving batch
+                                dispatch wedges for ``seconds`` (default
+                                5.0) — the hung-dispatch watchdog must
+                                abort it, fail the in-flight requests with
+                                diagnosis, and cool the engine down (once
+                                per plan).
+``bigdl.chaos.burstArrivals``   "k" or "k:n": the open-loop load generator
+                                fires n extra back-to-back arrivals (default
+                                8) at arrival position k (0-based) — a
+                                thundering herd the admission control must
+                                reject fast instead of collapsing tail
+                                latency (once per position).
 ==============================  =============================================
 
 Counters are process-local and monotonically increasing from
@@ -137,6 +160,14 @@ class _ChaosState:
             "bigdl.chaos.corruptCompileCacheAt", 0)
         self.hang_compile_at, self.hang_compile_seconds = _parse_stall(
             config.get_property("bigdl.chaos.hangCompileAt"))
+        self.slow_request_at, self.slow_request_seconds = _parse_stall(
+            config.get_property("bigdl.chaos.slowRequestAt"))
+        self.poison_request_at = _parse_span(
+            config.get_property("bigdl.chaos.poisonRequestAt"))
+        self.hang_dispatch_at, self.hang_dispatch_seconds = _parse_stall(
+            config.get_property("bigdl.chaos.hangDispatchAt"))
+        self.burst_arrivals_at, self.burst_arrivals_n = _parse_burst(
+            config.get_property("bigdl.chaos.burstArrivals"))
         self.writes = 0
         self.steps_failed = 0
         self.steps_seen = 0
@@ -151,6 +182,12 @@ class _ChaosState:
         self.cache_writes = 0
         self.compiles = 0
         self.compile_hangs = 0
+        self.serving_requests = 0
+        self.request_stalls = 0
+        self.poison_fired: set = set()
+        self.dispatches = 0
+        self.dispatch_hangs = 0
+        self.bursts_fired: set = set()
         self._lock = threading.Lock()
 
     # ---- storage-layer hooks -------------------------------------------
@@ -257,6 +294,75 @@ class _ChaosState:
             end = time.monotonic() + self.hang_compile_seconds
             while time.monotonic() < end:
                 time.sleep(0.02)
+
+    # ---- serving-path hooks --------------------------------------------
+
+    def on_serving_request(self, index: int) -> None:
+        """Called by the serving batcher as it begins handling each
+        dequeued request (``index`` is its admission position, for
+        logs).  The ``slowRequestAt``-th request HANDLED stalls the
+        batcher for ``seconds`` (default 5.0) — everything queued behind
+        it ages toward its deadline, exercising the dequeue-time shed.
+        One stall per plan."""
+        if not self.slow_request_at:
+            return
+        with self._lock:
+            self.serving_requests += 1
+            fire = (self.serving_requests == self.slow_request_at and
+                    self.request_stalls == 0)
+            if fire:
+                self.request_stalls = 1
+        if fire:
+            import time
+            time.sleep(self.slow_request_seconds)
+
+    def poison_request(self, index: int) -> bool:
+        """True when the request at admission position ``index``
+        (0-based) should read as poison — the serving quarantine must
+        fail exactly that request with ``ServingDataError`` and keep the
+        batch alive.  Once per position per plan (a client retrying a
+        rejected request is not re-poisoned)."""
+        lo, hi = self.poison_request_at
+        if bool(hi >= 0) and lo <= index <= hi:
+            with self._lock:
+                fire = index not in self.poison_fired
+                self.poison_fired.add(index)
+            return fire
+        return False
+
+    def on_dispatch(self, label: str = "") -> None:
+        """Called immediately before each serving batch dispatch: the
+        ``hangDispatchAt``-th dispatch wedges for ``seconds`` (default
+        5.0), sleeping in short slices so the hung-dispatch watchdog's
+        injected ``HungDispatchError`` lands within one slice — the
+        interruptible stand-in for a wedged device step.  One wedge per
+        plan."""
+        if not self.hang_dispatch_at:
+            return
+        with self._lock:
+            self.dispatches += 1
+            fire = (self.dispatches == self.hang_dispatch_at and
+                    self.dispatch_hangs == 0)
+            if fire:
+                self.dispatch_hangs = 1
+        if fire:
+            import time
+            end = time.monotonic() + self.hang_dispatch_seconds
+            while time.monotonic() < end:
+                time.sleep(0.02)
+
+    def burst_arrivals(self, index: int) -> int:
+        """Extra back-to-back arrivals the open-loop load generator
+        should fire at arrival position ``index`` (0-based): ``n`` at
+        the configured position (default 8), else 0.  Once per position
+        per plan."""
+        at, n = self.burst_arrivals_at, self.burst_arrivals_n
+        if at < 0 or index != at:
+            return 0
+        with self._lock:
+            fire = index not in self.bursts_fired
+            self.bursts_fired.add(index)
+        return n if fire else 0
 
     # ---- ingest-stage hooks --------------------------------------------
 
@@ -375,6 +481,18 @@ def _parse_stall(value) -> Tuple[int, float]:
     return (int(s), 5.0)
 
 
+def _parse_burst(value) -> Tuple[int, int]:
+    """``"k"`` -> (k, 8); ``"k:n"`` -> (k, n); falsy -> (-1, 0) — the
+    position sentinel is -1 so arrival position 0 stays armable."""
+    if value is None or value == "":
+        return (-1, 0)
+    s = str(value)
+    if ":" in s:
+        k, n = s.split(":", 1)
+        return (int(k), int(n))
+    return (int(s), 8)
+
+
 def _parse_kill(value) -> Tuple[Optional[str], int]:
     """``"stage"`` -> (stage, 1); ``"stage:k"`` -> (stage, k); falsy ->
     (None, 0)."""
@@ -435,6 +553,36 @@ def on_compile(label: str) -> None:
     compile wedges for the configured seconds."""
     if _state is not None:
         _state.on_compile(label)
+
+
+def on_serving_request(index: int) -> None:
+    """Serving batcher per-request hook (no-op when disarmed): the
+    ``slowRequestAt``-th handled request stalls the batcher."""
+    if _state is not None:
+        _state.on_serving_request(index)
+
+
+def poison_request(index: int) -> bool:
+    """Serving per-request poison test (False when disarmed): True means
+    "this admission position reads as poison NOW" (once per position)."""
+    if _state is None:
+        return False
+    return _state.poison_request(index)
+
+
+def on_dispatch(label: str = "") -> None:
+    """Serving batch-dispatch hook (no-op when disarmed): the
+    ``hangDispatchAt``-th dispatch wedges interruptibly."""
+    if _state is not None:
+        _state.on_dispatch(label)
+
+
+def burst_arrivals(index: int) -> int:
+    """Load-generator arrival hook: extra back-to-back arrivals to fire
+    at this position (0 when disarmed; once per position)."""
+    if _state is None:
+        return 0
+    return _state.burst_arrivals(index)
 
 
 def on_record_read(index: int) -> None:

@@ -48,6 +48,11 @@ _DEFAULTS: Dict[str, Any] = {
     # compile-subsystem fault injection (utils/compile_cache.py)
     "bigdl.chaos.corruptCompileCacheAt": 0,  # k: bit-flip the k-th cache entry
     "bigdl.chaos.hangCompileAt": None,    # "k" / "k:seconds": wedge k-th compile
+    # serving-path fault injection (bigdl_tpu/serving)
+    "bigdl.chaos.slowRequestAt": None,    # "k" / "k:seconds": k-th request handled slow
+    "bigdl.chaos.poisonRequestAt": None,  # "k" / "k:m": admission positions k..m poison
+    "bigdl.chaos.hangDispatchAt": None,   # "k" / "k:seconds": k-th batch dispatch wedges
+    "bigdl.chaos.burstArrivals": None,    # "k" / "k:n": n extra arrivals at position k
     # elastic training (utils/elastic.py): topology-elastic restore +
     # graceful preemption
     "bigdl.elastic.gracePeriod": 30.0,  # seconds for the final drain+snapshot
@@ -79,6 +84,19 @@ _DEFAULTS: Dict[str, Any] = {
     "bigdl.compile.lockTimeoutSec": 30.0,  # single-writer lock wait cap
     "bigdl.compile.lockStaleSec": 600.0,   # steal writer locks older than this
     "bigdl.pipeline.depth": 8,             # driver-loop dispatch pipeline
+    # overload-tolerant serving (bigdl_tpu/serving): admission-controlled
+    # request path — bounded queue, per-request deadlines, shedding,
+    # poison quarantine, hung-dispatch watchdog, graceful drain
+    "bigdl.serving.maxBatch": 16,          # batcher coalesce ceiling
+    "bigdl.serving.maxQueueDepth": 128,    # admission queue bound (reject past it)
+    "bigdl.serving.deadlineMs": 1000.0,    # default per-request deadline
+    "bigdl.serving.admissionDeadlineFactor": 1.0,  # reject when projected wait > f x deadline
+    "bigdl.serving.lingerMs": 0.0,         # batcher waits this long to fill a batch
+    "bigdl.serving.pollInterval": 0.05,    # batcher idle/monitor wake period, seconds
+    "bigdl.serving.stallFactor": 0,        # hung-dispatch watchdog: abort > k x EMA; 0 off
+    "bigdl.serving.warmupBatches": 3,      # dispatch-EMA warmup (compile exemption)
+    "bigdl.serving.cooldownSteps": 8,      # batches after a watchdog fire before re-admission
+    "bigdl.serving.gracePeriod": 5.0,      # drain window for SIGTERM / stop, seconds
     # streaming ingest engine (dataset/ingest.py): stage-pipelined
     # real-data path — sharded seqfile readers -> record ring -> decode
     # pool -> decoded window -> native assembler -> batch ring -> device

@@ -432,7 +432,19 @@ class HungStepWatchdog:
     ``on_fire`` (tests/bench probes), and — when ``abort`` is on —
     injects :class:`HungStepError` into the driver thread so the retry
     loop can restore the newest valid snapshot.
+
+    Subclasses supervising a different loop override :attr:`EXC` /
+    :attr:`METRIC_PREFIX` / :attr:`INSTANT_NAME` (the serving engine's
+    hung-dispatch watchdog injects its own error class and counts under
+    ``Serving/*``); the monitor/suppression machinery is shared.
     """
+
+    #: exception class injected into the supervised thread on a fire
+    EXC = HungStepError
+    #: registry namespace for the fired/detect_ms metrics
+    METRIC_PREFIX = "Elastic"
+    #: tracer instant-event name emitted on a fire
+    INSTANT_NAME = "watchdog/hung_step"
 
     def __init__(self, factor: float, warmup: int = 5, cooldown: int = 50,
                  poll_interval: float = 0.25, abort: bool = True,
@@ -528,6 +540,18 @@ class HungStepWatchdog:
             # the detector is only ever touched from the driver thread
             self.detector.observe(float(carry + now - last))
 
+    def reset_interval(self) -> None:
+        """Restart the open interval WITHOUT feeding the EMA — for loop
+        rounds that did bookkeeping but no supervised step (a serving
+        dequeue round that shed everything and assembled an empty
+        batch): their duration is neither a completed step nor a hang,
+        and letting it accumulate across rounds would eventually fire
+        the watchdog on a healthy thread."""
+        from bigdl_tpu import telemetry
+        with self._lock:
+            self._last_beat_ns = telemetry.clock_ns()
+            self._carry_ns = 0
+
     @contextmanager
     def paused(self):
         """Suspend stall detection over a legitimately-long driver phase
@@ -612,13 +636,15 @@ class HungStepWatchdog:
         detect_ms = (open_ns - threshold_ns) / 1e6
         logger.error(
             "Hung step detected: current step open for %.1f ms "
-            "(> %.1f ms = %.1f x EMA); aborting to restore the newest "
-            "valid snapshot (watchdog fire %d this run)",
-            open_ns / 1e6, threshold_ns / 1e6, self.factor, self.fired)
-        telemetry.counter("Elastic/watchdog_fired",
+            "(> %.1f ms = %.1f x EMA); aborting with %s "
+            "(watchdog fire %d this run)",
+            open_ns / 1e6, threshold_ns / 1e6, self.factor,
+            self.EXC.__name__, self.fired)
+        telemetry.counter(f"{self.METRIC_PREFIX}/watchdog_fired",
                           help="hung-step watchdog aborts").inc()
-        telemetry.gauge("Elastic/watchdog_detect_ms").set(detect_ms)
-        telemetry.instant("watchdog/hung_step",
+        telemetry.gauge(f"{self.METRIC_PREFIX}/watchdog_detect_ms").set(
+            detect_ms)
+        telemetry.instant(self.INSTANT_NAME,
                           open_ms=round(open_ns / 1e6, 3),
                           threshold_ms=round(threshold_ns / 1e6, 3))
         diagnostics = stall_diagnostics()
@@ -658,8 +684,8 @@ class HungStepWatchdog:
                     logger.info("hung-step abort suppressed: the step "
                                 "completed during fire diagnostics")
                     return
-                injected = _async_raise(self._driver_tid, HungStepError)
+                injected = _async_raise(self._driver_tid, self.EXC)
             if not injected:
                 logger.error(
-                    "watchdog could not inject HungStepError into the "
-                    "driver thread (already exited?)")
+                    "watchdog could not inject %s into the "
+                    "driver thread (already exited?)", self.EXC.__name__)

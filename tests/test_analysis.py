@@ -497,6 +497,38 @@ class TestLintRules:
         """)
         assert findings == []
 
+    def test_unbounded_queue_in_serving(self, tmp_path):
+        """queue.Queue()/deque() without a bound flags in serving/ and
+        engine.py; bounded forms and out-of-scope files stay clean."""
+        src = """
+            import queue
+            from collections import deque
+            def build(self):
+                self.q = queue.Queue()
+                self.q2 = queue.Queue(maxsize=0)
+                self.sq = queue.SimpleQueue()
+                self.d = deque()
+                self.d2 = deque([1, 2])
+        """
+        findings = _lint_snippet(tmp_path, "serving/server.py", src)
+        assert [f.rule for f in findings] == \
+            ["unbounded-queue-in-serving"] * 5
+        assert [f.rule for f in _lint_snippet(tmp_path, "engine.py", src)
+                ].count("unbounded-queue-in-serving") == 5
+        # out of scope: the same constructions elsewhere are not the
+        # serving path's problem
+        assert _lint_snippet(tmp_path, "utils/misc.py", src) == []
+        bounded = """
+            import queue
+            from collections import deque
+            def build(self):
+                self.q = queue.Queue(maxsize=8)
+                self.q2 = queue.Queue(16)
+                self.d = deque(maxlen=4)
+                self.d2 = deque([1, 2], 4)
+        """
+        assert _lint_snippet(tmp_path, "serving/server.py", bounded) == []
+
     def test_inline_allow_silences(self, tmp_path):
         findings = _lint_snippet(tmp_path, "optim/opt.py", """
             def drain(item, nxt):

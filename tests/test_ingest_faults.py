@@ -465,6 +465,67 @@ class TestPrefetcherFaultPaths:
             p.stop()
 
 
+class TestConsumerAbandonment:
+    """The serving shed path: a consumer that stops consuming mid-stream
+    must be able to tear down in-flight device_put/dispatch work without
+    deadlock — and a producer failure it never got around to reading
+    must still SURFACE, not vanish with the drained rings."""
+
+    def test_prefetcher_abandon_surfaces_unconsumed_error(self):
+        from bigdl_tpu.engine import BatchPrefetcher
+        import jax.numpy as jnp
+        state = {"n": 0}
+
+        def fetch():
+            state["n"] += 1
+            if state["n"] == 3:
+                raise RuntimeError("fetch boom")
+            return jnp.ones((64,), jnp.float32)
+
+        p = BatchPrefetcher(fetch, depth=2, transfer_ahead=3)
+        p()                           # consume ONE, then abandon
+        deadline = time.monotonic() + 10
+        while p._thread.is_alive() and time.monotonic() < deadline:
+            time.sleep(0.01)          # producer runs to its failure
+        t0 = time.monotonic()
+        p.stop()                      # must join, not hang
+        assert time.monotonic() - t0 < 15
+        assert isinstance(p.error, RuntimeError)
+        assert "fetch boom" in str(p.error)
+
+    def test_prefetcher_abandon_with_blocked_producer_no_deadlock(self):
+        """Abandon while the producer is BLOCKED pushing into full rings
+        (the worst case: nothing is consuming, every queue is at
+        capacity, uploads in flight)."""
+        from bigdl_tpu.engine import BatchPrefetcher
+        import jax.numpy as jnp
+        p = BatchPrefetcher(lambda: jnp.ones((2 * 1024 * 1024,),
+                                             jnp.float32),
+                            depth=2, transfer_ahead=3)
+        time.sleep(0.3)               # rings fill, producer wedges in put
+        t0 = time.monotonic()
+        p.stop()
+        assert time.monotonic() - t0 < 15
+        assert not p._thread.is_alive()
+        assert not p._transfer_thread.is_alive()
+        assert p.error is None        # no failure happened — none invented
+
+    def test_dispatch_pipeline_abandon_skips_drain(self):
+        from bigdl_tpu.engine import DispatchPipeline
+        drained = []
+        p = DispatchPipeline(lambda item, nxt: drained.append(item[0]),
+                             depth=8)
+        for i in range(5):
+            p.push(i)
+        assert p.abandon() == 5
+        p.flush()
+        assert drained == [], "abandoned items must never hit drain"
+        # the pipeline keeps working for a consumer that comes back
+        p.push(7)
+        p.flush()
+        assert drained == [7]
+
+
 @pytest.mark.slow
 def test_chaos_ingest_soak_trained_weight_parity():
     """The acceptance soak: training through StreamingIngest with an
