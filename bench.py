@@ -1631,11 +1631,91 @@ def bench_serving(out_path: str = None, soak: bool = False,
     return record
 
 
+def _probe_cache(cache_dir: str) -> None:
+    """Populate ``cache_dir`` with one compile-probe child lifecycle
+    (the same hidden ``--compile-probe`` mode the --compile-only leg
+    uses).  The audit passes default to warn, so every committed entry
+    lands with its program census recorded in the manifest — exactly
+    what the offline auditor consumes."""
+    import subprocess
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = os.path.join(cache_dir, "probe.json")
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--compile-probe", cache_dir, out],
+        cwd=here, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=600)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"compile probe failed ({proc.returncode}):\n"
+            f"{proc.stdout}{proc.stderr}")
+
+
+def bench_audit(out_path: str = None):
+    """``--audit-only``: the HLO-audit leg → bench_audit.json.
+
+    Populates a probe compile cache in a REAL child process, runs the
+    offline auditor over the persisted entries (contract replay + the
+    committed ``audit_baselines.json`` regression check), and records
+    the per-step census — collective bytes by kind, transpose counts,
+    peak-buffer estimates — so the bench trajectory tracks the
+    communication budget over time instead of rediscovering it in an
+    incident."""
+    import shutil
+    import tempfile
+    from bigdl_tpu.analysis import hlo_audit
+    here = os.path.dirname(os.path.abspath(__file__))
+    cache_dir = tempfile.mkdtemp(prefix="bench_audit_")
+    try:
+        _probe_cache(cache_dir)
+        # worst entry per fused-step label (bucket variants share a
+        # label; the budget tracks the most expensive signature)
+        steps = {}
+        for name in sorted(os.listdir(cache_dir)):
+            if not name.endswith(".commit"):
+                continue
+            with open(os.path.join(
+                    cache_dir, name[:-len(".commit")] + ".json")) as f:
+                a = json.load(f).get("audit")
+            if a is None:
+                continue
+            prev = steps.get(a["label"])
+            if prev is None or \
+                    (a["collective_bytes"], a.get("peak_bytes") or 0) > \
+                    (prev["collective_bytes"], prev.get("peak_bytes") or 0):
+                steps[a["label"]] = a
+        baselines_path = os.path.join(here, "audit_baselines.json")
+        baselines = (hlo_audit.load_baselines(baselines_path)
+                     if os.path.exists(baselines_path) else None)
+        lines, problems = hlo_audit.audit_cache_dir(cache_dir, baselines)
+        for ln in lines:
+            _log(ln)
+        for p in problems:
+            _log(f"VIOLATION: {p}")
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    record = {
+        "steps": steps,
+        "problems": problems,
+        "baselines_checked": baselines is not None,
+        "note": "per-fused-step program census from the probe cache "
+                "(worst signature per label); problems non-empty means "
+                "a contract or baseline regression",
+    }
+    out_path = out_path or os.path.join(here, "bench_audit.json")
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=1)
+    _log(f"audit record -> {out_path}")
+    assert not problems, "offline HLO audit found problems:\n" + \
+        "\n".join(problems)
+    return record
+
+
 def preflight() -> int:
     """Static preflight: lint the package (host-sync/dtype/exception/lock
-    rules) and verify the native pipeline build — a broken tree or a
-    missing native symbol fails here in seconds, before any device time
-    is spent."""
+    rules), verify the native pipeline build, and run the offline HLO
+    audit over a freshly-populated probe compile cache — a broken tree,
+    a missing native symbol, or a fused step breaking its program
+    contract fails here, before any real device time is spent."""
     from bigdl_tpu.analysis.lint import DEFAULT_ALLOWLIST, lint_paths, \
         load_allowlist
     pkg = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -1653,6 +1733,27 @@ def preflight() -> int:
     except Exception as e:
         _log(f"preflight: native build FAILED: {e}")
         rc = 1
+    # offline HLO audit over a probe cache: the child compiles the probe
+    # trainer with the audit armed (warn by default), the offline pass
+    # then replays every persisted census against its step contract
+    import shutil
+    import tempfile
+    cache_dir = tempfile.mkdtemp(prefix="preflight_audit_")
+    try:
+        _probe_cache(cache_dir)
+        from bigdl_tpu.analysis import hlo_audit
+        _, problems = hlo_audit.audit_cache_dir(cache_dir)
+        for p in problems:
+            _log(f"VIOLATION: {p}")
+        _log(f"preflight: HLO audit {'FAILED' if problems else 'OK'} "
+             f"({len(problems)} problem(s))")
+        if problems:
+            rc = 1
+    except Exception as e:
+        _log(f"preflight: HLO audit FAILED: {e}")
+        rc = 1
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
     return rc
 
 
@@ -1684,8 +1785,16 @@ def main():
                          "bench_chaos.json")
     ap.add_argument("--lint-only", action="store_true",
                     help="preflight only: AST-lint bigdl_tpu/ "
-                         "(bigdl_tpu.analysis.lint) + native.check_build(), "
-                         "no device work — exit 0 iff both pass")
+                         "(bigdl_tpu.analysis.lint) + native.check_build() "
+                         "+ offline HLO audit over a probe compile cache "
+                         "— exit 0 iff all pass")
+    ap.add_argument("--audit-only", action="store_true",
+                    help="HLO-audit leg: per-fused-step program census "
+                         "(collective bytes by kind, transpose counts, "
+                         "peak-buffer estimates) from a probe compile "
+                         "cache, contract-replayed offline and regression-"
+                         "checked against audit_baselines.json -> "
+                         "bench_audit.json")
     ap.add_argument("--telemetry-only", action="store_true",
                     help="telemetry leg: tracer overhead armed vs disarmed "
                          "(<1%% of step time asserted) + a validated sample "
@@ -1718,6 +1827,16 @@ def main():
 
     if args.lint_only:
         sys.exit(preflight())
+
+    if args.audit_only:
+        # subprocess-populated cache + host-side offline audit: no
+        # device work in THIS process
+        rec = bench_audit()
+        total = sum(s.get("collective_bytes", 0)
+                    for s in rec["steps"].values())
+        print(json.dumps({"metric": "audit_collective_bytes",
+                          "value": total, "unit": "bytes"}))
+        return
 
     if args.compile_probe:
         # hidden child mode of --compile-only: one trainer lifecycle

@@ -28,6 +28,12 @@ post-mortem archaeology:
    factories in forward paths under ``nn/``, bare/swallowed exceptions in
    ingest threads, and lock-acquisition-order violations in the ring
    handoffs.  ``tests/test_lint_clean.py`` gates CI on a clean tree.
+5. **HLO program auditor** (:mod:`~bigdl_tpu.analysis.hlo_audit` +
+   :mod:`~bigdl_tpu.analysis.program_contracts`,
+   ``python -m bigdl_tpu.analysis.hlo_audit <cacheDir>``) — static
+   passes over every fused step's lowered StableHLO at compile/cache-
+   load time: collective contract checker, precision-drift pass, and
+   memory/layout budgets.  Modes under ``bigdl.audit.*``.
 
 Modes per pass (``bigdl.analysis.*`` in ``utils/config.py``): ``strict``
 (raise), ``warn`` (log + count), ``off``.
@@ -60,10 +66,16 @@ from bigdl_tpu.analysis.hostsync import (HostSyncError, HostSyncGuard,  # noqa: 
                                          allow_host_sync, host_pull)
 from bigdl_tpu.analysis.contracts import (ContractError, ContractReport,  # noqa: E402
                                           ModuleContract, check_model)
+from bigdl_tpu.analysis.program_contracts import (CollectiveBound,  # noqa: E402
+                                                  ProgramContractError,
+                                                  ProgramContractViolation,
+                                                  StepContract)
 
 __all__ = [
     "pass_mode",
     "RetraceError", "RetraceSentinel", "abstract_signature",
     "HostSyncError", "HostSyncGuard", "allow_host_sync", "host_pull",
     "ContractError", "ContractReport", "ModuleContract", "check_model",
+    "CollectiveBound", "ProgramContractError", "ProgramContractViolation",
+    "StepContract",
 ]

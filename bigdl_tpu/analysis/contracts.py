@@ -74,12 +74,31 @@ class ModuleContract:
 
 @dataclass
 class Violation:
-    module: str            # module name (instance .name)
+    module: str            # container path, e.g. Sequential[3].SpatialConvolution
     kind: str              # "ndim" | "dtype" | "promotion" | "x64" | "layout"
     detail: str
 
     def __str__(self):
         return f"[{self.kind}] {self.module}: {self.detail}"
+
+
+def _module_paths(model) -> dict:
+    """id(module) -> container path (``Sequential[3].SpatialConvolution``,
+    nested containers chain: ``Sequential[0].Sequential[2].Linear``) for
+    every module reachable through container children.  A bare class
+    name locates nothing in a zoo-sized model; the indexed path does."""
+    from bigdl_tpu.nn.module import Container
+    paths: dict = {}
+
+    def walk(m, prefix: str) -> None:
+        if isinstance(m, Container):
+            for i, child in enumerate(m.children):
+                cp = f"{prefix}[{i}].{type(child).__name__}"
+                paths[id(child)] = cp
+                walk(child, cp)
+
+    walk(model, type(model).__name__)
+    return paths
 
 
 @dataclass
@@ -148,6 +167,7 @@ def check_model(model, sample_input, *, training: bool = False,
     from bigdl_tpu.nn.layout import NCHWToNHWC, NHWCToNCHW
 
     model._ensure_init()
+    paths = _module_paths(model)
     report = ContractReport()
     region = {"layout": "NCHW"}    # facade layout at the model boundary
     instrumented: List[Any] = []
@@ -157,6 +177,7 @@ def check_model(model, sample_input, *, training: bool = False,
         is on record even when the mismatch kills the trace a moment
         later with an opaque shape error."""
         report.modules_checked += 1
+        where = paths.get(id(m), m.name)
         in_leaves = _array_leaves(inputs)
         contract: Optional[ModuleContract] = getattr(m, "contract", None)
         if contract is not None:
@@ -164,12 +185,12 @@ def check_model(model, sample_input, *, training: bool = False,
                 if (contract.input_ndim is not None and
                         len(l.shape) not in contract.input_ndim):
                     report.violations.append(Violation(
-                        m.name, "ndim",
+                        where, "ndim",
                         f"input rank {len(l.shape)} (shape {tuple(l.shape)}) "
                         f"not in declared {contract.input_ndim}"))
                 if not contract.allows_dtype(np.dtype(l.dtype)):
                     report.violations.append(Violation(
-                        m.name, "dtype",
+                        where, "dtype",
                         f"input dtype {l.dtype} violates declared policy "
                         f"{contract.dtypes!r}"))
         # layout: a spatial op must match the region the boundary
@@ -179,12 +200,13 @@ def check_model(model, sample_input, *, training: bool = False,
             if any(len(l.shape) in (3, 4) for l in in_leaves) and \
                     fmt != region["layout"]:
                 report.violations.append(Violation(
-                    m.name, "layout",
+                    where, "layout",
                     f"{fmt}-configured spatial op inside an "
                     f"{region['layout']} region — the boundary transposes "
                     "and the op's data format disagree"))
 
     def _check_outputs(m, inputs, outputs) -> None:
+        where = paths.get(id(m), m.name)
         in_leaves = _array_leaves(inputs)
         out_leaves = _array_leaves(outputs)
         contract: Optional[ModuleContract] = getattr(m, "contract", None)
@@ -193,14 +215,14 @@ def check_model(model, sample_input, *, training: bool = False,
         for l in out_leaves:
             if str(l.dtype) in ("float64", "complex128"):
                 report.violations.append(Violation(
-                    m.name, "x64",
+                    where, "x64",
                     f"output leaf is {l.dtype} — x64 promotion drift"))
         # precision promotion: float out wider than float in
         if contract is None or contract.follows_input_dtype:
             win, wout = _widest_float(in_leaves), _widest_float(out_leaves)
             if win is not None and wout is not None and wout > win:
                 report.violations.append(Violation(
-                    m.name, "promotion",
+                    where, "promotion",
                     f"float output widens {win * 8}-bit input to "
                     f"{wout * 8}-bit — promotion drift (a constant or "
                     "state leaf is pinning a wider dtype)"))

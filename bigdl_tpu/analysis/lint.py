@@ -101,6 +101,21 @@ Rules
     taxonomy + resync), or carry an explicit
     ``# lint: allow(unguarded-io-in-stage-thread)``.
 
+``undeclared-collective``
+    In the trainer step-constructor files (``optim/optimizer.py`` /
+    ``optim/evaluator.py`` / ``optim/predictor.py`` /
+    ``parallel/distri_optimizer.py`` / ``parallel/pipeline.py``), raw
+    collective calls — ``lax.psum`` / ``psum_scatter`` / ``pmean`` /
+    ``pmin`` / ``pmax`` / ``ppermute`` / ``all_gather`` / ``all_to_all``
+    / ``pbroadcast`` (``lax.axis_index`` is positional, not a
+    collective, and exempt).  The AST-level companion to the HLO
+    auditor's collective contract pass: every collective a step body
+    performs must go through the declared-contract helpers in
+    ``parallel/all_reduce.py`` (``axis_sum`` / ``axis_mean`` /
+    ``axis_min`` / ``ring_permute`` / ``pmean_floats`` /
+    ``AllReduceParameter``), so the declared contract and the source
+    stay greppably in sync.  The allowlist stays empty.
+
 Silencing: append ``# lint: allow(<rule-name>)`` to the offending line,
 or list ``<relpath>:<rule-name>`` in an allowlist file (one per line,
 ``#`` comments) — the CI gate keeps the repo allowlist empty, so every
@@ -156,7 +171,28 @@ BLOCKING_METHODS = {"put", "get", "join", "wait", "sleep", "acquire"}
 #: or os.environ.get under a lock is not a handoff
 _QUEUEISH = re.compile(r"(^q$|_q$|queue|ring)", re.IGNORECASE)
 
+#: trainer step-constructor files: every collective a step body performs
+#: must route through the declared-contract helpers in
+#: parallel/all_reduce.py (the HLO audit contract's source-level mirror)
+TRAINER_STEP_FILES = (os.path.join("optim", "optimizer.py"),
+                      os.path.join("optim", "evaluator.py"),
+                      os.path.join("optim", "predictor.py"),
+                      os.path.join("parallel", "distri_optimizer.py"),
+                      os.path.join("parallel", "pipeline.py"))
+#: raw lax collectives (axis_index is positional lookup, not traffic)
+COLLECTIVE_CALLS = {"psum", "psum_scatter", "pmean", "pmin", "pmax",
+                    "ppermute", "all_gather", "all_to_all", "pbroadcast"}
+
 _ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([a-z0-9_,\- ]+)\)")
+
+#: every rule the linter can emit — the CLI validates --rule against it
+KNOWN_RULES = frozenset({
+    "host-sync-in-hot-path", "raw-clock-in-hot-path",
+    "signal-handler-in-hot-path", "jnp-dtype-drop", "untracked-jit",
+    "undeclared-collective", "unguarded-io-in-stage-thread",
+    "unbounded-queue-in-serving", "bare-except", "swallowed-exception",
+    "blocking-under-lock", "lock-order", "syntax",
+})
 
 
 @dataclass(frozen=True)
@@ -410,6 +446,41 @@ def _rule_untracked_jit(path: str, rel: str, tree: ast.AST) -> List[Finding]:
             # re.compile(pattern) always has arguments; an argument-less
             # .compile() is the Lowered -> Compiled AOT step
             _flag(node.lineno, ".compile()")
+    return out
+
+
+def _rule_undeclared_collective(path: str, rel: str,
+                                tree: ast.AST) -> List[Finding]:
+    """Raw ``lax`` collectives in trainer step-constructor files: the
+    HLO auditor checks the LOWERED program against the step's declared
+    contract; this rule keeps the SOURCE reconcilable with it — a
+    collective that doesn't go through ``parallel/all_reduce.py``'s
+    helpers is invisible to the contract declaration next to them."""
+    if not any(rel.endswith(t) for t in TRAINER_STEP_FILES):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if name not in COLLECTIVE_CALLS:
+            continue
+        f = node.func
+        # lax.psum(...), jax.lax.psum(...), or a bare psum(...) import —
+        # the helper module's own wrappers are out of scope by file
+        if isinstance(f, ast.Attribute):
+            q = f.value
+            lax_qual = ((isinstance(q, ast.Name) and q.id == "lax") or
+                        (isinstance(q, ast.Attribute) and q.attr == "lax"))
+            if not lax_qual:
+                continue
+        out.append(Finding(
+            rel, node.lineno, "undeclared-collective",
+            f"raw {name}(...) in a trainer step body — route it through "
+            "the declared-contract helpers in parallel/all_reduce.py "
+            "(axis_sum/axis_mean/axis_min/ring_permute/pmean_floats or "
+            "AllReduceParameter) so the step's program contract stays "
+            "in sync with the source"))
     return out
 
 
@@ -702,6 +773,7 @@ def lint_paths(targets: Sequence[str],
                          _rule_signal_handler(path, rel, tree) +
                          _rule_dtype_drop(path, rel, tree) +
                          _rule_untracked_jit(path, rel, tree) +
+                         _rule_undeclared_collective(path, rel, tree) +
                          _rule_unguarded_io(path, rel, tree) +
                          _rule_unbounded_queue(path, rel, tree) +
                          _rule_exceptions(path, rel, tree))
@@ -736,8 +808,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--allowlist", default=DEFAULT_ALLOWLIST,
                     help="grandfathered '<relpath>:<rule>' entries "
                          "(default: the in-repo allowlist, kept empty)")
+    ap.add_argument("--rule", action="append", default=None,
+                    metavar="NAME",
+                    help="report only this rule (repeatable); an unknown "
+                         "name is an error, not an empty report")
     args = ap.parse_args(argv)
+    if args.rule:
+        unknown = sorted(set(args.rule) - KNOWN_RULES)
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)}\n"
+                  f"known rules: {', '.join(sorted(KNOWN_RULES))}",
+                  file=sys.stderr)
+            return 2
     findings = lint_paths(args.targets, load_allowlist(args.allowlist))
+    if args.rule:
+        findings = [f for f in findings if f.rule in set(args.rule)]
     for f in findings:
         print(f)
     if findings:

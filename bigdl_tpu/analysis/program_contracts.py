@@ -1,0 +1,266 @@
+"""Per-step program contracts: what the compiled HLO is ALLOWED to do.
+
+Every fused step family (``local`` / ``local_feval`` / ``shard_map`` /
+``gspmd`` / ``pipeline`` / ``eval``) declares a :class:`StepContract` at
+construction time and passes it through ``compile_cache.tracked_jit``;
+the HLO auditor (:mod:`bigdl_tpu.analysis.hlo_audit`) checks every
+lowered program against it at compile (or cache warm-load) time.  A
+contract is the program-level counterpart of PR 4's module contracts:
+instead of "this layer takes rank-4 float inputs" it says "this step
+performs exactly one reduce-scatter over the gradient vector and one
+all-gather over the parameter vector, computes in bf16, and nothing
+else crosses the interconnect".
+
+The collective vocabulary is the StableHLO one: ``all-reduce`` (psum /
+pmean / pmin / pmax all lower here), ``all-gather``, ``reduce-scatter``
+(psum_scatter), ``all-to-all`` (MoE expert dispatch), and
+``collective-permute`` (ppermute rings — pipeline stages, ring
+attention).  An op kind the contract does not declare, or a declared
+kind whose aggregate traffic exceeds its byte budget, is a
+:class:`ProgramContractViolation` naming the HLO op, its shapes, and
+the owning step.
+
+The canonical per-family builders at the bottom are what the trainers
+call — each computes its byte bounds from the live model (flat
+parameter bytes, module-state bytes), so the budget tightens with the
+model instead of being a loose global constant.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+#: the StableHLO collective vocabulary the auditor extracts
+COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
+                    "all-to-all", "collective-permute",
+                    "collective-broadcast")
+
+#: headroom added to computed byte budgets: scalar all-reduces (loss
+#: pmean, divergence-verdict pmin) and padding round-off ride under it
+SCALAR_SLACK_BYTES = 4096
+
+
+class ProgramContractError(ValueError):
+    """A compiled step violated its program contract (strict mode).
+    ``violations`` carries the structured findings."""
+
+    def __init__(self, message: str, violations=None):
+        super().__init__(message)
+        self.violations = list(violations or [])
+
+
+@dataclass(frozen=True)
+class ProgramContractViolation:
+    """One structured audit finding.
+
+    ``step``: the owning fused-step label; ``pass_name``: which audit
+    family flagged it (``collective`` / ``precision`` / ``memory``);
+    ``op``: the HLO op (``stablehlo.all_gather``, ``stablehlo.
+    dot_general``, ...); ``detail``: shapes, byte counts, and the
+    violated bound."""
+
+    step: str
+    pass_name: str
+    op: str
+    detail: str
+
+    def __str__(self):
+        return (f"[audit/{self.pass_name}] step '{self.step}': {self.op} "
+                f"— {self.detail}")
+
+
+@dataclass(frozen=True)
+class CollectiveBound:
+    """Budget for one collective kind inside one step's program.
+
+    ``max_ops``: static op-count ceiling (None = any number — e.g. a
+    ppermute ring whose op count is a schedule detail); ``max_bytes``:
+    aggregate traffic ceiling over all ops of the kind, where one op's
+    traffic is max(operand bytes, result bytes) (None = unbounded);
+    ``reason``: why the step legitimately performs this collective —
+    printed with violations so the reader sees what WAS declared."""
+
+    kind: str
+    max_ops: Optional[int] = None
+    max_bytes: Optional[int] = None
+    reason: str = ""
+
+    def __post_init__(self):
+        if self.kind not in COLLECTIVE_KINDS:
+            raise ValueError(
+                f"unknown collective kind {self.kind!r} "
+                f"(one of {COLLECTIVE_KINDS})")
+
+
+@dataclass(frozen=True)
+class StepContract:
+    """The declared program envelope for one fused step family.
+
+    ``collectives``: every collective kind the program may contain,
+    each with its budget — a kind absent here is a violation outright.
+    ``activation_dtype``: the declared compute precision (``"bf16"`` or
+    None = fp32); under bf16 an f32 ``dot_general``/``convolution`` is
+    precision drift.  ``max_rank4_transposes``: layout budget — rank-4
+    transposes beyond it (boundary NCHW<->NHWC flips are expected, a
+    growing interior census is a regressing layout) are a violation;
+    None leaves the census uncapped (still exported as a metric)."""
+
+    label: str
+    collectives: Tuple[CollectiveBound, ...] = ()
+    activation_dtype: Optional[str] = None
+    max_rank4_transposes: Optional[int] = None
+
+    def bound_for(self, kind: str) -> Optional[CollectiveBound]:
+        for b in self.collectives:
+            if b.kind == kind:
+                return b
+        return None
+
+
+# ---- registry ---------------------------------------------------------------
+
+#: label -> the most recently declared contract (latest wins: tests build
+#: several trainers per process and the audit runs at compile time,
+#: immediately after the owning declaration)
+_REGISTRY: Dict[str, StepContract] = {}
+
+
+def declare(contract: StepContract) -> StepContract:
+    """Register ``contract`` for its label and return it (what
+    ``tracked_jit(..., contract=...)`` calls)."""
+    _REGISTRY[contract.label] = contract
+    return contract
+
+
+def lookup(label: str) -> Optional[StepContract]:
+    """The live contract declared for ``label`` this process, else the
+    canonical default for a known family, else None."""
+    c = _REGISTRY.get(label)
+    if c is not None:
+        return c
+    return default_contracts().get(label)
+
+
+def reset() -> None:
+    """Drop live declarations (test isolation)."""
+    _REGISTRY.clear()
+
+
+# ---- canonical per-family builders ------------------------------------------
+
+
+def local_contract(precision: Optional[str] = None) -> StepContract:
+    """Single-process fused train step: everything on one device, no
+    interconnect traffic at all."""
+    return StepContract(label="local", collectives=(),
+                        activation_dtype=precision)
+
+
+def feval_contract() -> StepContract:
+    """Host-driven loss+grad function (LBFGS line search): local and
+    fp32-only by construction."""
+    return StepContract(label="local_feval", collectives=())
+
+
+def shard_map_contract(precision: Optional[str], param_bytes: int,
+                       state_bytes: int, *, seq_axis: bool = False,
+                       expert_axis: bool = False) -> StepContract:
+    """The ZeRO-1 data-parallel shard_map step: exactly one
+    reduce-scatter over the summed gradient vector, exactly one
+    all-gather reassembling the updated weights, and a small all-reduce
+    family (loss pmean, module-state pmean per float leaf, the
+    divergence-verdict pmin).  A ``seq``/``expert`` axis adds one full
+    gradient psum per extra axis (all-reduce bytes) plus the ring /
+    all-to-all exchange the wired layers perform inside the step."""
+    extra_axes = int(seq_axis) + int(expert_axis)
+    bounds: List[CollectiveBound] = [
+        CollectiveBound(
+            "reduce-scatter", max_ops=1, max_bytes=param_bytes,
+            reason="gradient sum + shard-scatter "
+                   "(arp.reduce_scatter_gradients)"),
+        CollectiveBound(
+            "all-gather", max_ops=1, max_bytes=param_bytes,
+            reason="updated-weight reassembly (arp.all_gather_weights)"),
+        CollectiveBound(
+            "all-reduce", max_ops=None,
+            # the mstate pmean repeats once per mesh axis the step
+            # reduces over (data + each extra axis), the full-gradient
+            # psum once per EXTRA axis only
+            max_bytes=(state_bytes * (1 + extra_axes) + SCALAR_SLACK_BYTES +
+                       param_bytes * extra_axes),
+            reason="loss/module-state pmean + divergence pmin"
+                   + (" + per-extra-axis gradient psum" if extra_axes
+                      else "")),
+    ]
+    if seq_axis:
+        bounds.append(CollectiveBound(
+            "collective-permute", reason="ring attention k/v rotation "
+                                         "over the seq axis"))
+    if expert_axis:
+        bounds.append(CollectiveBound(
+            "all-to-all", reason="MoE expert dispatch/return over the "
+                                 "expert axis"))
+    return StepContract(label="shard_map", collectives=tuple(bounds),
+                        activation_dtype=precision)
+
+
+def gspmd_contract(precision: Optional[str] = None) -> StepContract:
+    """The dp x tp GSPMD step: the traced program is collective-free —
+    gradient all-reduces and tensor-parallel exchanges are inserted by
+    XLA's partitioner AFTER StableHLO, so any explicit collective in the
+    lowered text is a hand-written stray."""
+    return StepContract(label="gspmd", collectives=(),
+                        activation_dtype=precision)
+
+
+def pipeline_contract() -> StepContract:
+    """The GPipe step: activations (and their cotangents, in the
+    backward the autodiff transpose inserts) rotate around the stage
+    ring with collective-permute.  The backward ALSO carries all-reduce:
+    the autodiff transpose of values replicated across the stage axis
+    (the microbatch input fan-out, the scalar loss) psums their
+    cotangents over the ring — empirically 2 activation-sized psums plus
+    the scalar loss reduction, a schedule detail whose size tracks the
+    microbatch, so the bound declares the kind without a byte cap."""
+    return StepContract(label="pipeline", collectives=(
+        CollectiveBound("collective-permute",
+                        reason="stage-ring activation (and cotangent) "
+                               "rotation"),
+        CollectiveBound("all-reduce",
+                        reason="autodiff-transpose psum of stage-"
+                               "replicated values (microbatch cotangents, "
+                               "scalar loss)"),))
+
+
+def eval_contract(sharded: bool = False) -> StepContract:
+    """The eval/predict forward: collective-free as traced (the sharded
+    variant replicates its output through the GSPMD partitioner, not
+    through explicit collectives)."""
+    return StepContract(label="eval_sharded" if sharded else "eval",
+                        collectives=())
+
+
+def default_contracts() -> Dict[str, StepContract]:
+    """Canonical contracts for every known family — what the OFFLINE
+    auditor (``python -m bigdl_tpu.analysis.hlo_audit <cacheDir>``)
+    checks persisted cache entries against when no live trainer has
+    declared byte bounds: kind membership is model-independent, byte
+    budgets are not, so the defaults declare kinds with unbounded
+    bytes."""
+    unbounded = dict(max_ops=None, max_bytes=None)
+    return {
+        "local": local_contract(),
+        "local_feval": feval_contract(),
+        "shard_map": StepContract(label="shard_map", collectives=(
+            CollectiveBound("reduce-scatter", **unbounded),
+            CollectiveBound("all-gather", **unbounded),
+            CollectiveBound("all-reduce", **unbounded),
+            CollectiveBound("collective-permute", **unbounded),
+            CollectiveBound("all-to-all", **unbounded),
+        )),
+        "gspmd": gspmd_contract(),
+        "pipeline": pipeline_contract(),
+        "eval": eval_contract(False),
+        "eval_sharded": eval_contract(True),
+    }

@@ -59,18 +59,21 @@ def _eval_forward(model: Module, mesh=None, host_params: bool = False):
             out, _ = model.apply(params, inputs, mstate, training=False,
                                  rng=None)
             return out
+        from bigdl_tpu.analysis import program_contracts
         from bigdl_tpu.utils import elastic
         topology = elastic.describe_topology(mesh, step="eval")
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             fn = compile_cache.tracked_jit(
                 fwd, label="eval_sharded", topology=topology,
+                contract=program_contracts.eval_contract(sharded=True),
                 bucket_argnums=(2,),
                 out_shardings=NamedSharding(mesh, P()))
         else:
-            fn = compile_cache.tracked_jit(fwd, label="eval",
-                                           topology=topology,
-                                           bucket_argnums=(2,))
+            fn = compile_cache.tracked_jit(
+                fwd, label="eval", topology=topology,
+                contract=program_contracts.eval_contract(sharded=False),
+                bucket_argnums=(2,))
         if compile_cache.configured_buckets():
             # the retrace gate: bucket variants registered as warmup
             # compiles by the AOT precompile, any OTHER post-warmup

@@ -111,8 +111,17 @@ def mixed_precision_forward(model: Module, params, inputs, mstate,
         # round away, and fp32 state promotes the EMA arithmetic itself
         out, new_mstate = model.apply(cp, cx, mstate, training=training,
                                       rng=rng)
-        return (cast_floats(out, jnp.float32),
-                cast_floats(new_mstate, jnp.float32))
+        out = cast_floats(out, jnp.float32)
+        from bigdl_tpu.utils import config
+        if (config.get_bool("bigdl.chaos.f32Upcast", False)
+                and getattr(out, "ndim", 0) >= 2):
+            # audit fault injection: an f32 matmul smuggled into a
+            # declared-bf16 program — numerically an identity, but an
+            # f32 dot_general in the lowered text, exactly the drift
+            # the precision pass exists to catch
+            eye = jnp.eye(jnp.shape(out)[-1], dtype=jnp.float32)
+            out = out @ eye
+        return out, cast_floats(new_mstate, jnp.float32)
     return model.apply(params, inputs, mstate, training=training, rng=rng)
 
 
@@ -1470,10 +1479,12 @@ class LocalOptimizer(Optimizer):
                 loss = jnp.where(ok, loss, jnp.nan)
             return new_params, new_slots, new_mstate, loss
 
+        from bigdl_tpu.analysis import program_contracts
         from bigdl_tpu.utils import compile_cache
-        return compile_cache.tracked_jit(step, label="local",
-                                         topology=self._topology_meta(),
-                                         donate_argnums=(0, 1, 2))
+        return compile_cache.tracked_jit(
+            step, label="local", topology=self._topology_meta(),
+            contract=program_contracts.local_contract(precision),
+            donate_argnums=(0, 1, 2))
 
     def _build_feval_step(self):
         """Host-driven step for multi-evaluation methods (LBFGS line
@@ -1493,9 +1504,11 @@ class LocalOptimizer(Optimizer):
                 return loss + regularization_penalty(model, p)
             return jax.value_and_grad(loss_fn)(params)
 
+        from bigdl_tpu.analysis import program_contracts
         value_and_grad = compile_cache.tracked_jit(
             _value_and_grad, label="local_feval",
-            topology=self._topology_meta())
+            topology=self._topology_meta(),
+            contract=program_contracts.feval_contract())
 
         def step(params, slots, mstate, inputs, targets, hyper, rng):
             def feval(p):

@@ -139,3 +139,48 @@ class AllReduceParameter:
         """Reassemble the full flat vector from per-device shards
         (reference ``getWeights`` / ``sendWeightPartition``)."""
         return lax.all_gather(shard, axis, tiled=True)
+
+
+# ---- declared-contract collective helpers -----------------------------------
+#
+# Every collective a trainer STEP BODY performs goes through this module
+# (or :class:`AllReduceParameter` above): each helper corresponds to a
+# collective kind the step's program contract declares, so the HLO
+# auditor's census and the source are reconcilable by grep.  The
+# ``undeclared-collective`` lint rule flags raw ``lax.psum``/``pmean``/
+# ``pmin``/``ppermute``/``all_gather``/``all_to_all`` calls in trainer
+# step constructors — route them here instead.
+
+
+def axis_sum(tree, axis: str):
+    """psum over ``axis`` → one all-reduce per leaf (gradient
+    contributions summed over a seq/expert axis)."""
+    return lax.psum(tree, axis)
+
+
+def axis_mean(tree, axis: str):
+    """pmean over ``axis`` → all-reduce (loss averaging)."""
+    return lax.pmean(tree, axis)
+
+
+def axis_min(tree, axis: str):
+    """pmin over ``axis`` → all-reduce (the global divergence verdict:
+    every shard must agree to apply or skip a step)."""
+    return lax.pmin(tree, axis)
+
+
+def ring_permute(x, axis: str, perm):
+    """ppermute over ``axis`` → collective-permute (pipeline stage ring,
+    ring-attention rotation)."""
+    return lax.ppermute(x, axis, perm)
+
+
+def pmean_floats(tree, axis: str):
+    """Average float leaves across the axis (keeps BatchNorm running
+    stats consistent between replicas); non-float leaves pass through
+    (they evolve identically on every shard)."""
+    def f(x):
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+            return lax.pmean(x, axis)
+        return x
+    return jax.tree_util.tree_map(f, tree)

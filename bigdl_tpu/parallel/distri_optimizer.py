@@ -65,7 +65,8 @@ from bigdl_tpu.optim.optimizer import (Optimizer, all_finite,
                                        mixed_precision_forward,
                                        moe_aux_penalty,
                                        regularization_penalty, select_tree)
-from bigdl_tpu.parallel.all_reduce import AllReduceParameter
+from bigdl_tpu.parallel.all_reduce import (AllReduceParameter, axis_mean,
+                                           axis_min, axis_sum, pmean_floats)
 
 logger = logging.getLogger("bigdl_tpu")
 
@@ -129,15 +130,10 @@ def map_over_slots(optim_method, fn, slots, per_param_tree):
          for st in subtrees])
 
 
-def _pmean_float(tree, axis: str):
-    """Average float leaves across the axis (keeps BatchNorm running stats
-    consistent between replicas); non-float leaves pass through (they evolve
-    identically on every shard)."""
-    def f(x):
-        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
-            return lax.pmean(x, axis)
-        return x
-    return jax.tree_util.tree_map(f, tree)
+# the BatchNorm-state averaging helper now lives with the other declared
+# collectives in all_reduce.py (pmean_floats); this alias keeps the old
+# import path working
+_pmean_float = pmean_floats
 
 
 class DistriOptimizer(Optimizer):
@@ -215,6 +211,9 @@ class DistriOptimizer(Optimizer):
         aux_weight = self.moe_aux_weight
         from bigdl_tpu.utils import config
         guard = config.get_bool("bigdl.divergence.guard", True)
+        # audit fault injection: duplicate the weight all-gather so the
+        # step's program breaks its declared max_ops=1 all-gather bound
+        extra_ag = config.get_bool("bigdl.chaos.extraAllGather", False)
 
         def shard_step(flat_params, slots, mstate, inputs, targets, hyper, rng):
             # distinct dropout masks per shard, like the reference's
@@ -240,11 +239,11 @@ class DistriOptimizer(Optimizer):
                 # sequence shards each saw a chunk of every sequence: their
                 # gradient contributions sum (ring attention's backward is
                 # already chunk-local)
-                flat_grads = lax.psum(flat_grads, seq_axis)
+                flat_grads = axis_sum(flat_grads, seq_axis)
             if expert_axis:
                 # expert shards saw disjoint tokens AND ran disjoint expert
                 # blocks: contributions sum over the axis
-                flat_grads = lax.psum(flat_grads, expert_axis)
+                flat_grads = axis_sum(flat_grads, expert_axis)
             # reduce-scatter: own gradient slice, summed over shards
             grad_shard = arp.reduce_scatter_gradients(flat_grads, axis) / n
             # ZeRO-1: update only this device's parameter slice + slots
@@ -259,10 +258,10 @@ class DistriOptimizer(Optimizer):
                 # verdicts would silently fork the model
                 ok = jnp.logical_and(all_finite(loss),
                                      all_finite(grad_shard))
-                ok = lax.pmin(ok.astype(jnp.int32), axis)
+                ok = axis_min(ok.astype(jnp.int32), axis)
                 for extra in (seq_axis, expert_axis):
                     if extra:   # seq/expert replicas must agree too
-                        ok = lax.pmin(ok, extra)
+                        ok = axis_min(ok, extra)
                 ok = ok.astype(bool)
                 new_shard = select_tree(ok, new_shard, param_shard)
                 new_slots = select_tree(ok, new_slots, slots)
@@ -272,13 +271,19 @@ class DistriOptimizer(Optimizer):
                 loss = jnp.where(ok, loss, jnp.nan)
             # all-gather the updated weights for the next forward
             new_flat = arp.all_gather_weights(new_shard, axis)
+            if extra_ag:
+                # the redundant gather returns the identical vector, so
+                # (x + x) / 2 is bit-exact — but the program now carries
+                # a second all-gather for the auditor to catch
+                new_flat = (new_flat
+                            + arp.all_gather_weights(new_shard, axis)) / 2
 
-            loss = lax.pmean(loss, axis)
-            new_mstate = _pmean_float(new_mstate, axis)
+            loss = axis_mean(loss, axis)
+            new_mstate = pmean_floats(new_mstate, axis)
             for extra in (seq_axis, expert_axis):
                 if extra:
-                    loss = lax.pmean(loss, extra)
-                    new_mstate = _pmean_float(new_mstate, extra)
+                    loss = axis_mean(loss, extra)
+                    new_mstate = pmean_floats(new_mstate, extra)
             return new_flat, new_slots, new_mstate, loss
 
         pspec_rep = P()
@@ -298,9 +303,24 @@ class DistriOptimizer(Optimizer):
                       pspec_rep, pspec_rep),              # hyper, rng
             out_specs=(pspec_rep, pspec_slots, pspec_rep, pspec_rep),
             check_rep=False)
+        from bigdl_tpu.analysis import program_contracts
         from bigdl_tpu.utils import compile_cache
+        # byte budgets from the live model: the padded flat parameter
+        # vector bounds the reduce-scatter/all-gather wire, the float
+        # module-state leaves (BatchNorm stats, MoE diagnostics) bound
+        # the mstate pmean all-reduces
+        param_bytes = arp.padded_size * jnp.dtype(arp.dtype).itemsize
+        state_bytes = sum(
+            x.size * jnp.dtype(x.dtype).itemsize
+            for x in map(jnp.asarray,
+                         jax.tree_util.tree_leaves(model.state))
+            if jnp.issubdtype(x.dtype, jnp.floating))
+        contract = program_contracts.shard_map_contract(
+            precision, param_bytes, state_bytes,
+            seq_axis=bool(seq_axis), expert_axis=bool(expert_axis))
         return compile_cache.tracked_jit(sharded, label="shard_map",
                                          topology=self._topology_meta(),
+                                         contract=contract,
                                          donate_argnums=(0, 1, 2))
 
     # ---- driver loop ----------------------------------------------------
@@ -638,11 +658,12 @@ class DistriOptimizer(Optimizer):
                 loss = jnp.where(ok, loss, jnp.nan)
             return new_params, new_slots, new_mstate, loss
 
+        from bigdl_tpu.analysis import program_contracts
         from bigdl_tpu.utils import compile_cache
-        return compile_cache.tracked_jit(step, label="gspmd",
-                                         topology=self._topology_meta(),
-                                         donate_argnums=(0, 1, 2),
-                                         out_shardings=out_shardings)
+        return compile_cache.tracked_jit(
+            step, label="gspmd", topology=self._topology_meta(),
+            contract=program_contracts.gspmd_contract(precision),
+            donate_argnums=(0, 1, 2), out_shardings=out_shardings)
 
     def _wire_sequence_parallel(self, module) -> None:
         """Point every MultiHeadAttention at the mesh's seq axis.  The ring

@@ -40,6 +40,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from bigdl_tpu.nn.module import Module
 from bigdl_tpu.optim.optimizer import Optimizer
+from bigdl_tpu.parallel.all_reduce import axis_mean, axis_sum, ring_permute
 
 
 def stack_stage_params(per_stage: List):
@@ -220,7 +221,7 @@ def pipeline_apply(block: Module, stacked_params, x: jnp.ndarray,
             diags = collect_diagnostics(block, new_state, "aux_loss")
             aux = sum(diags) if diags else jnp.zeros(())
             valid = ((i >= idx) & (i < idx + n_micro)).astype(aux.dtype)
-            nxt = lax.ppermute(y, axis, perm)
+            nxt = ring_permute(y, axis, perm)
             return nxt, (y, aux * valid)
 
         _, (ys, auxs) = lax.scan(step, jnp.zeros_like(xs[0]),
@@ -228,16 +229,16 @@ def pipeline_apply(block: Module, stacked_params, x: jnp.ndarray,
         # the last stage emits microbatch m at tick m + S - 1
         outs = ys[n_stages - 1:]
         # broadcast the last stage's outputs to every device
-        outs = lax.psum(
+        outs = axis_sum(
             jnp.where(idx == n_stages - 1, outs, jnp.zeros_like(outs)),
             axis)
         outs = outs.reshape((n_micro * mb,) + outs.shape[2:])
         # mean aux over the n_micro real executions per stage and over
         # the S stages (psum over the stage axis); data replicas each
         # routed different samples, so mean across them too
-        aux_mean = lax.psum(jnp.sum(auxs) / n_micro, axis) / n_stages
+        aux_mean = axis_sum(jnp.sum(auxs) / n_micro, axis) / n_stages
         if data_axis is not None:
-            aux_mean = lax.pmean(aux_mean, data_axis)
+            aux_mean = axis_mean(aux_mean, data_axis)
         return outs, aux_mean
 
     x_spec = P(data_axis) if data_axis is not None else P()
@@ -394,11 +395,12 @@ class PipelineOptimizer(Optimizer):
                                      self._slot_specs)
             out_shardings = (param_sh, slot_sh, None)
 
+        from bigdl_tpu.analysis import program_contracts
         from bigdl_tpu.utils import compile_cache
-        return compile_cache.tracked_jit(step, label="pipeline",
-                                         topology=self._topology_meta(),
-                                         donate_argnums=(0, 1),
-                                         out_shardings=out_shardings)
+        return compile_cache.tracked_jit(
+            step, label="pipeline", topology=self._topology_meta(),
+            contract=program_contracts.pipeline_contract(),
+            donate_argnums=(0, 1), out_shardings=out_shardings)
 
     def _optimize(self):
         import numpy as np

@@ -422,7 +422,8 @@ class CompileCache:
 
     def store(self, key: str, payload: bytes, label: str,
               signature_hash: str, topology: Optional[Dict[str, Any]],
-              fingerprint: Dict[str, str]) -> bool:
+              fingerprint: Dict[str, str],
+              audit: Optional[Dict[str, Any]] = None) -> bool:
         """Write one entry as a verified unit (payload → manifest →
         commit marker last) under the single-writer lock.  Returns False
         — with the executable still serving from memory — when the lock
@@ -463,6 +464,11 @@ class CompileCache:
                     "bytes": len(payload),
                     "created": time.time(),
                 }
+                if audit is not None:
+                    # the HLO auditor's census digest — what the offline
+                    # auditor reads back; an additive key, so version 1
+                    # readers without it stay loadable
+                    manifest["audit"] = audit
                 self._atomic_write(bin_p, payload)
                 mbytes = json.dumps(manifest, sort_keys=True).encode("utf-8")
                 self._atomic_write(man_p, mbytes)
@@ -640,10 +646,14 @@ class CachedStep:
     def __init__(self, jitted, label: str,
                  topology: Optional[Dict[str, Any]] = None,
                  cache: Optional[CompileCache] = None,
-                 bucket_argnums: Sequence[int] = ()):
+                 bucket_argnums: Sequence[int] = (),
+                 contract=None):
         self._jitted = jitted
         self.label = label
         self.topology = topology
+        #: the StepContract the HLO auditor checks every lowered
+        #: program of this step against (None = lookup by label)
+        self.contract = contract
         self._cache = cache if cache is not None else CompileCache.from_config()
         self.bucket_argnums = tuple(bucket_argnums)
         self.sentinel = None          # retrace sentinel fed by precompiles
@@ -727,19 +737,24 @@ class CachedStep:
             trace_ms = (telemetry.clock_ns() - t0) / 1e6
             telemetry.gauge("Compile/trace_ms").set(trace_ms)
 
+            from bigdl_tpu.analysis import hlo_audit
+            audit_armed = hlo_audit.armed()
             fingerprint = backend_fingerprint()
             exe = None
             cache_key = None
-            if self._cache is not None:
-                # the StableHLO text digest keys the entry exactly; it is
-                # only worth serializing (tens of MB for big steps) when
-                # a persistent cache will actually consume it
+            hlo = None
+            if self._cache is not None or audit_armed:
+                # the StableHLO text digest keys the entry exactly (and
+                # the armed auditor scans the same text); the executable
+                # is only worth serializing (tens of MB for big steps)
+                # when a persistent cache will actually consume it
                 try:
                     with compile_watchdog(self.label, "trace", timeout,
                                           diagnosis):
                         hlo = lowered.as_text()
                 except CompileTimeoutError as e:
                     raise self._diagnosed(e, "trace", timeout, diagnosis)
+            if self._cache is not None:
                 hlo_digest = hashlib.sha256(
                     hlo.encode("utf-8")).hexdigest()
                 cache_key = CompileCache.entry_key(
@@ -747,6 +762,7 @@ class CachedStep:
                     fingerprint)
                 exe = self._try_cache_load(cache_key, fingerprint, timeout,
                                            diagnosis, _se)
+            loaded = exe is not None
             if exe is None:
                 if self._cache is not None:
                     self._count_miss()
@@ -770,8 +786,20 @@ class CachedStep:
                     "%.0f ms, compile %.0f ms%s", self.label, sig_hash,
                     trace_ms, compile_ms,
                     "" if self._cache is None else " — caching")
-                if self._cache is not None and cache_key is not None:
-                    self._store(cache_key, exe, sig_hash, fingerprint, _se)
+            audit_summary = None
+            if audit_armed and hlo is not None:
+                # audit BEFORE the store: a contract-violating program
+                # must never enter the persistent cache, and the census
+                # rides in the entry manifest for the offline auditor
+                report = hlo_audit.audit_step(
+                    self.label, hlo, compiled=exe, contract=self.contract,
+                    topology=self.topology)
+                audit_summary = report.census.summary()
+                report.raise_or_warn()
+            if (not loaded and self._cache is not None
+                    and cache_key is not None):
+                self._store(cache_key, exe, sig_hash, fingerprint, _se,
+                            audit=audit_summary)
         self._mem[key] = exe
         if self.bucket_argnums:
             self._families.add(self._family_key(args))
@@ -844,7 +872,8 @@ class CachedStep:
         return exe
 
     def _store(self, cache_key: str, exe, sig_hash: str,
-               fingerprint: Dict[str, str], _se) -> None:
+               fingerprint: Dict[str, str], _se,
+               audit: Optional[Dict[str, Any]] = None) -> None:
         try:
             payload = pickle.dumps(_se.serialize(exe),
                                    protocol=pickle.HIGHEST_PROTOCOL)
@@ -855,7 +884,7 @@ class CachedStep:
                 "entry", self.label, type(e).__name__, e)
             return
         self._cache.store(cache_key, payload, self.label, sig_hash,
-                          self.topology, fingerprint)
+                          self.topology, fingerprint, audit=audit)
 
     def _family_key(self, args: Tuple):
         """The signature with the batch dim of every bucket-arg leaf
@@ -997,13 +1026,19 @@ class CachedStep:
 def tracked_jit(fn, label: str, topology: Optional[Dict[str, Any]] = None,
                 cache: Optional[CompileCache] = None,
                 bucket_argnums: Sequence[int] = (),
-                **jit_kwargs) -> CachedStep:
+                contract=None, **jit_kwargs) -> CachedStep:
     """``jax.jit`` + :class:`CachedStep` in one call — THE registered
     entry point for fused-step compilation (the ``untracked-jit`` lint
     rule flags any ``jax.jit``/``.lower()``/``.compile()`` outside this
-    module).  ``jit_kwargs`` pass through to ``jax.jit``
-    (``donate_argnums``, ``out_shardings``, ...)."""
+    module).  ``contract`` is the step's program contract
+    (:class:`~bigdl_tpu.analysis.program_contracts.StepContract`) —
+    declared in the live registry and checked by the HLO auditor on
+    every compile/cache-load.  ``jit_kwargs`` pass through to
+    ``jax.jit`` (``donate_argnums``, ``out_shardings``, ...)."""
     import jax
+    if contract is not None:
+        from bigdl_tpu.analysis import program_contracts
+        program_contracts.declare(contract)
     return CachedStep(jax.jit(fn, **jit_kwargs), label=label,
                       topology=topology, cache=cache,
-                      bucket_argnums=bucket_argnums)
+                      bucket_argnums=bucket_argnums, contract=contract)

@@ -120,6 +120,16 @@ _DEFAULTS: Dict[str, Any] = {
     "bigdl.analysis.hostSync": "warn",     # implicit device→host pulls in hot loop
     "bigdl.analysis.hotLoopScope": "iteration",  # sanitize fetch+step, or "step"
     "bigdl.analysis.contracts": "warn",    # module contract checker strictness
+    # HLO program auditor (bigdl_tpu/analysis/hlo_audit): static passes
+    # over every fused step's lowered StableHLO, same strict/warn/off
+    # vocabulary as bigdl.analysis.*
+    "bigdl.audit.collectives": "warn",  # collective contract checker
+    "bigdl.audit.precision": "warn",    # f64 / f32-in-bf16 drift pass
+    "bigdl.audit.memory": "warn",       # peak-buffer + transpose budget pass
+    # audit fault injection: provoke the violations the auditor exists
+    # to catch (step-BUILD time, unlike the runtime chaos hooks above)
+    "bigdl.chaos.extraAllGather": False,  # redundant all-gather in shard_map
+    "bigdl.chaos.f32Upcast": False,       # f32 matmul inside a bf16 program
     # runtime telemetry (bigdl_tpu/telemetry): span tracer + step-time
     # decomposition + metrics registry
     "bigdl.telemetry.trace": False,        # arm the span tracer

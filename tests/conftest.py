@@ -46,9 +46,18 @@ def _sanitizers_armed():
 
     config.set_property("bigdl.analysis.retrace", "strict")
     config.set_property("bigdl.analysis.hostSync", "strict")
+    # the HLO program auditor, strict for every tier-1 compile: any
+    # fused step whose lowered program breaks its declared collective
+    # contract, drifts precision, or blows its layout budget raises
+    # ProgramContractError at warmup
+    config.set_property("bigdl.audit.collectives", "strict")
+    config.set_property("bigdl.audit.precision", "strict")
+    config.set_property("bigdl.audit.memory", "strict")
     yield
     config.clear_property("bigdl.analysis.retrace")
     config.clear_property("bigdl.analysis.hostSync")
+    for k in ("collectives", "precision", "memory"):
+        config.clear_property(f"bigdl.audit.{k}")
 
 
 @pytest.fixture(autouse=True)

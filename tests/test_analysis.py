@@ -339,6 +339,74 @@ class TestContractChecker:
             check_model(_convnet(), jnp.zeros((2, 3, 8, 8), jnp.int32),
                         mode="strict")
 
+    def test_violation_names_container_path(self):
+        """A violation must carry the indexed container path (zoo-sized
+        models have dozens of Linears — a bare class name locates
+        nothing), including through nested containers."""
+        inner = nn.Sequential().add(nn.Linear(4, 4))
+        inner[0].declare_contract(input_ndim=(2,), dtypes="float")
+        m = nn.Sequential().add(nn.ReLU()).add(inner)
+        m.reset(jax.random.PRNGKey(0))
+        rep = check_model(m, jnp.zeros((2, 3, 4)), mode="off")
+        ndim = [v for v in rep.violations if v.kind == "ndim"]
+        assert ndim, str(rep)
+        assert ndim[0].module == "Sequential[1].Sequential[0].Linear"
+        assert "Sequential[1].Sequential[0].Linear" in str(rep)
+
+    def test_convnet_violation_path_is_indexed(self):
+        rep = check_model(_convnet(), jnp.zeros((2, 3, 8, 8), jnp.int32),
+                          mode="off")
+        assert any(v.kind == "dtype" and
+                   v.module == "Sequential[0].SpatialConvolution"
+                   for v in rep.violations), str(rep)
+
+    def test_moe_block_checks_clean(self):
+        """check_model over a gated MoE block under eval_shape: the
+        routed dispatch (top-k gating, capacity slots, stacked expert
+        params) traces abstractly with zero violations."""
+        expert = (nn.Sequential()
+                  .add(nn.Linear(8, 16)).add(nn.ReLU())
+                  .add(nn.Linear(16, 8)))
+        m = (nn.Sequential()
+             .add(nn.Linear(8, 8))
+             .add(nn.MixtureOfExperts(8, expert, n_experts=4,
+                                      capacity_factor=4.0, top_k=2))
+             .add(nn.Linear(8, 3)))
+        m.reset(jax.random.PRNGKey(0))
+        rep = check_model(m, jax.ShapeDtypeStruct((16, 8), jnp.float32),
+                          mode="off")
+        assert rep.ok, str(rep)
+        assert rep.modules_checked >= 3
+
+    def test_folded_serving_model_checks_clean_and_sabotage_trips(self):
+        """fold_conv_bn's serving rewrite (conv<-BN folded, Identity left
+        behind) plus channels-last conversion passes the checker clean;
+        re-pointing the folded conv back to NCHW inside the NHWC region
+        still trips layout — and the report names the indexed path."""
+        from bigdl_tpu.nn.layout import to_channels_last
+        m = (nn.Sequential()
+             .add(nn.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1))
+             .add(nn.SpatialBatchNormalization(8))
+             .add(nn.ReLU())
+             .add(nn.View([8 * 8 * 8]))
+             .add(nn.Linear(8 * 8 * 8, 10)))
+        m.reset(jax.random.PRNGKey(0))
+        m.evaluate()
+        folded = to_channels_last(nn.fold_conv_bn(m))
+        rep = check_model(folded,
+                          jax.ShapeDtypeStruct((2, 3, 8, 8), jnp.float32),
+                          mode="off")
+        assert rep.ok, str(rep)
+        conv = folded.find_modules(nn.SpatialConvolution)[0]
+        conv.format = "NCHW"
+        rep2 = check_model(folded,
+                           jax.ShapeDtypeStruct((2, 3, 8, 8), jnp.float32),
+                           mode="off")
+        layout = [v for v in rep2.violations if v.kind == "layout"]
+        assert layout, str(rep2)
+        assert "SpatialConvolution" in layout[0].module
+        assert "[" in layout[0].module      # indexed container path
+
     def test_restores_apply_after_walk(self):
         m = _convnet()
         check_model(m, jnp.zeros((2, 3, 8, 8)), mode="off")

@@ -9,8 +9,11 @@ import os
 import subprocess
 import sys
 
-from bigdl_tpu.analysis.lint import (DEFAULT_ALLOWLIST, lint_paths,
-                                     load_allowlist)
+import pytest
+
+from bigdl_tpu.analysis.lint import (DEFAULT_ALLOWLIST, KNOWN_RULES,
+                                     lint_paths, load_allowlist,
+                                     main as lint_main)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PKG = os.path.join(REPO, "bigdl_tpu")
@@ -38,12 +41,44 @@ def test_cli_entry_point_exits_zero():
     assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
+def test_unknown_rule_is_an_error_listing_known_rules(capsys):
+    """``--rule`` with an unknown name must exit nonzero and list the
+    known rules — a typo'd rule name silently reporting an empty, green
+    result would be a CI hole."""
+    rc = lint_main(["bigdl_tpu", "--rule", "no-such-rule"])
+    assert rc != 0
+    err = capsys.readouterr().err
+    assert "unknown rule(s): no-such-rule" in err
+    for rule in KNOWN_RULES:
+        assert rule in err          # the listing names every known rule
+
+
+def test_known_rule_filter_exits_zero(capsys):
+    rc = lint_main(["bigdl_tpu/analysis/lint.py",
+                    "--rule", "undeclared-collective"])
+    assert rc == 0, capsys.readouterr()
+
+
 def test_bench_lint_only_preflight():
-    """bench.py --lint-only runs the linter + native.check_build as a
-    device-free preflight."""
+    """bench.py --lint-only runs the linter + native.check_build + the
+    offline HLO audit over a freshly-populated probe compile cache."""
     proc = subprocess.run(
         [sys.executable, "bench.py", "--lint-only"],
         cwd=REPO, capture_output=True, text=True, timeout=300,
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "preflight" in (proc.stdout + proc.stderr)
+    assert "HLO audit OK" in (proc.stdout + proc.stderr)
+
+
+@pytest.mark.slow
+def test_bench_audit_only_matches_baselines():
+    """The acceptance criterion: --audit-only's census matches the
+    committed audit_baselines.json within tolerance (nonzero exit on a
+    contract or baseline regression)."""
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--audit-only"],
+        cwd=REPO, capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "audit_collective_bytes" in proc.stdout
