@@ -13,8 +13,10 @@ directory (``python -m bigdl_tpu.analysis.hlo_audit <cacheDir>``):
    (``Audit/collective_bytes`` + per-kind op counters in the telemetry
    registry), and checked against the :class:`~bigdl_tpu.analysis.
    program_contracts.StepContract` the owning trainer declared.  An
-   undeclared kind, an op-count over ``max_ops``, or aggregate traffic
-   over ``max_bytes`` is a structured
+   undeclared kind, an op-count over ``max_ops`` or under ``min_ops``
+   (the bucketed ZeRO-1 schedule promises a collective PER BUCKET — a
+   missing one is a silently-unreduced parameter range), or aggregate
+   traffic over ``max_bytes`` is a structured
    :class:`~bigdl_tpu.analysis.program_contracts.
    ProgramContractViolation` naming the HLO op, its shapes, and the
    owning step.
@@ -287,12 +289,32 @@ def _check_collectives(census: ProgramCensus,
                 detail=f"{agg['ops']} {kind} op(s) exceed the declared "
                        f"max of {bound.max_ops} ({shapes}) — declared "
                        f"for: {bound.reason or 'unspecified'}"))
+        if bound.min_ops is not None and agg["ops"] < bound.min_ops:
+            out.append(ProgramContractViolation(
+                step=census.label, pass_name="collective", op=ops[0].op,
+                detail=f"only {agg['ops']} {kind} op(s) where the contract "
+                       f"requires at least {bound.min_ops} ({shapes}) — a "
+                       f"missing collective means a data range silently "
+                       f"skipped its exchange; declared for: "
+                       f"{bound.reason or 'unspecified'}"))
         if bound.max_bytes is not None and agg["bytes"] > bound.max_bytes:
             out.append(ProgramContractViolation(
                 step=census.label, pass_name="collective", op=ops[0].op,
                 detail=f"{kind} traffic {agg['bytes']} bytes exceeds the "
                        f"declared budget of {bound.max_bytes} bytes "
                        f"({shapes})"))
+    # a declared kind with an op-count floor that the census does not
+    # contain AT ALL never enters the loop above — flag it here (the
+    # fully-dropped-collective case)
+    for bound in contract.collectives:
+        if (getattr(bound, "min_ops", None) and
+                bound.min_ops > 0 and bound.kind not in by_kind):
+            out.append(ProgramContractViolation(
+                step=census.label, pass_name="collective",
+                op=f"stablehlo.{bound.kind.replace('-', '_')}",
+                detail=f"no {bound.kind} op in the program where the "
+                       f"contract requires at least {bound.min_ops} — "
+                       f"declared for: {bound.reason or 'unspecified'}"))
     return out
 
 

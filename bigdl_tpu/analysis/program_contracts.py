@@ -75,16 +75,22 @@ class CollectiveBound:
     """Budget for one collective kind inside one step's program.
 
     ``max_ops``: static op-count ceiling (None = any number — e.g. a
-    ppermute ring whose op count is a schedule detail); ``max_bytes``:
-    aggregate traffic ceiling over all ops of the kind, where one op's
-    traffic is max(operand bytes, result bytes) (None = unbounded);
-    ``reason``: why the step legitimately performs this collective —
-    printed with violations so the reader sees what WAS declared."""
+    ppermute ring whose op count is a schedule detail); ``min_ops``:
+    op-count FLOOR (None = no floor) — a program with fewer ops of the
+    kind than declared is as broken as one with more: the bucketed
+    ZeRO-1 schedule promises one reduce-scatter and one all-gather PER
+    BUCKET, and a silently dropped bucket collective means a parameter
+    range trains on unreduced gradients; ``max_bytes``: aggregate
+    traffic ceiling over all ops of the kind, where one op's traffic is
+    max(operand bytes, result bytes) (None = unbounded); ``reason``:
+    why the step legitimately performs this collective — printed with
+    violations so the reader sees what WAS declared."""
 
     kind: str
     max_ops: Optional[int] = None
     max_bytes: Optional[int] = None
     reason: str = ""
+    min_ops: Optional[int] = None
 
     def __post_init__(self):
         if self.kind not in COLLECTIVE_KINDS:
@@ -165,23 +171,35 @@ def feval_contract() -> StepContract:
 
 def shard_map_contract(precision: Optional[str], param_bytes: int,
                        state_bytes: int, *, seq_axis: bool = False,
-                       expert_axis: bool = False) -> StepContract:
-    """The ZeRO-1 data-parallel shard_map step: exactly one
-    reduce-scatter over the summed gradient vector, exactly one
-    all-gather reassembling the updated weights, and a small all-reduce
-    family (loss pmean, module-state pmean per float leaf, the
-    divergence-verdict pmin).  A ``seq``/``expert`` axis adds one full
+                       expert_axis: bool = False,
+                       n_buckets: int = 1) -> StepContract:
+    """The ZeRO-1 data-parallel shard_map step: exactly ``n_buckets``
+    reduce-scatters over the summed gradient vector, exactly
+    ``n_buckets`` all-gathers reassembling the updated weights (the
+    latency-hiding overlap schedule partitions the flat vector into
+    contiguous buckets; the monolithic baseline is ``n_buckets=1``), and
+    a small all-reduce family (loss pmean, module-state pmean per float
+    leaf, the divergence-verdict pmin).  The byte budgets do NOT scale
+    with ``n_buckets`` — the buckets partition the same vector, so
+    aggregate wire traffic is invariant under the bucket count.  The op
+    counts are exact both ways (``min_ops == max_ops``): a dropped
+    bucket collective means a parameter range silently trains on
+    unreduced gradients.  A ``seq``/``expert`` axis adds one full
     gradient psum per extra axis (all-reduce bytes) plus the ring /
     all-to-all exchange the wired layers perform inside the step."""
     extra_axes = int(seq_axis) + int(expert_axis)
     bounds: List[CollectiveBound] = [
         CollectiveBound(
-            "reduce-scatter", max_ops=1, max_bytes=param_bytes,
-            reason="gradient sum + shard-scatter "
-                   "(arp.reduce_scatter_gradients)"),
+            "reduce-scatter", max_ops=n_buckets, min_ops=n_buckets,
+            max_bytes=param_bytes,
+            reason="per-bucket gradient sum + shard-scatter "
+                   "(arp.reduce_scatter_gradients / "
+                   "arp.reduce_scatter_bucket)"),
         CollectiveBound(
-            "all-gather", max_ops=1, max_bytes=param_bytes,
-            reason="updated-weight reassembly (arp.all_gather_weights)"),
+            "all-gather", max_ops=n_buckets, min_ops=n_buckets,
+            max_bytes=param_bytes,
+            reason="per-bucket updated-weight reassembly "
+                   "(arp.all_gather_weights / arp.all_gather_bucket)"),
         CollectiveBound(
             "all-reduce", max_ops=None,
             # the mstate pmean repeats once per mesh axis the step

@@ -143,6 +143,29 @@ def transformer_block(d_model: int, n_head: int, ff_mult: int = 4,
             .add(_Residual(d_model, ffn)))
 
 
+def _default_remat(remat):
+    """Resolve a builder's ``remat`` argument against the
+    ``bigdl.remat.policy`` config preset: an explicit argument wins; with
+    the default (``False``) the preset applies — ``"nothing"`` (save
+    nothing per block), ``"dots"``, ``"save_attn"`` (:class:`nn.Remat`'s
+    vocabulary, where a typo fails at construction), ``None``/``"off"``
+    keeps remat off.  This is what lets the MFU bench A/B remat policies
+    against collective overlap without threading a new argument through
+    every model builder."""
+    if remat is not False:
+        return remat
+    from bigdl_tpu.utils import config
+    v = config.get_property("bigdl.remat.policy", None)
+    if v in (None, False, ""):
+        return False
+    v = str(v).lower()
+    if v in ("none", "off", "false"):
+        return False
+    if v in ("nothing", "true"):
+        return True
+    return v
+
+
 def transformer_lm(vocab_size: int, d_model: int = 128, n_head: int = 4,
                    n_layers: int = 2, max_len: int = 4096,
                    tp: bool = False, moe_experts: int = 0,
@@ -158,7 +181,10 @@ def transformer_lm(vocab_size: int, d_model: int = 128, n_head: int = 4,
     :class:`~bigdl_tpu.nn.Remat` activation checkpointing — ``True`` saves
     nothing per block, ``"dots"`` saves matmul outputs, ``"save_attn"``
     saves only the tagged attention context (driver ``--remat``);
-    identical numerics, O(layers) less activation memory."""
+    identical numerics, O(layers) less activation memory.  When the
+    argument is left at its default, the ``bigdl.remat.policy`` config
+    preset applies (see :func:`_default_remat`)."""
+    remat = _default_remat(remat)
     m = (nn.Sequential()
          .add(nn.LookupTable(vocab_size, d_model))
          .add(PositionalEncoding(d_model, max_len)))
@@ -191,6 +217,7 @@ def transformer_lm_pipeline(vocab_size: int, d_model: int = 128,
     ``tp=True`` Megatron-tags each block for the 3-D
     ``('data','stage','model')`` composition (driver
     ``--pipeline --tensor-parallel``)."""
+    remat = _default_remat(remat)
     embed = (nn.Sequential()
              .add(nn.LookupTable(vocab_size, d_model))
              .add(PositionalEncoding(d_model, max_len)))

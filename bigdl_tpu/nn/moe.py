@@ -170,6 +170,97 @@ class MixtureOfExperts(Module):
         aux = self.n_experts * jnp.sum(frac_tokens * mean_gate)
         return dispatch, combine, aux
 
+    # ---- the grouped execution path (bigdl.moe.impl=grouped) -------------
+    #
+    # Same routing decisions, different materialization: instead of the
+    # O(t*E*C*d) dispatch/combine einsums over mostly-zero (t, E, C)
+    # one-hot tensors, the kept (token, tier) assignments scatter
+    # directly into the (E, C, d) expert batch and gather back out —
+    # O(t*k*d) data movement.  The expert matmuls themselves are
+    # unchanged (the same grouped (E, C, d) batch), and the kept set,
+    # slot order, renormalized gates and aux diagnostic are computed by
+    # the identical bookkeeping, so capacity-drop semantics are exact.
+
+    @staticmethod
+    def _impl() -> str:
+        from bigdl_tpu.utils import config
+        impl = str(config.get_property("bigdl.moe.impl", "einsum")
+                   or "einsum").lower()
+        if impl not in ("einsum", "grouped"):
+            raise ValueError(f"bigdl.moe.impl={impl!r}: expected 'einsum' "
+                             "or 'grouped'")
+        return impl
+
+    def route_compact(self, params, flat):
+        """(tokens, d) -> (expert_id (t, k), slot (t, k), weight (t, k),
+        keep (t, k), aux) — :meth:`route`'s bookkeeping in token-major
+        compact form.  ``slot`` is the capacity position the assignment
+        would occupy (tier k queues after all earlier tiers of the same
+        expert, via the per-expert count offset — GShard's ordering);
+        ``keep`` is False past capacity; ``weight`` is the (renormalized)
+        gate with the keep mask already applied, so a dropped assignment
+        contributes exactly zero."""
+        t = flat.shape[0]
+        cap = self.capacity(t)
+        gates = jax.nn.softmax(flat @ params["gate"], axis=-1)   # (t, E)
+        top_gates, top_idx = jax.lax.top_k(gates, self.top_k)    # (t, k)
+        counts = jnp.zeros((self.n_experts,), jnp.int32)
+        slots_l, keeps_l = [], []
+        top1_oh = None
+        for k in range(self.top_k):
+            oh = jax.nn.one_hot(top_idx[:, k], self.n_experts,
+                                dtype=jnp.int32)
+            pos = (jnp.cumsum(oh, axis=0) * oh - 1) + counts[None, :] * oh
+            # the chosen column's value IS this assignment's queue
+            # position (>= 0 there by construction)
+            slot_k = jnp.take_along_axis(pos, top_idx[:, k:k + 1],
+                                         axis=1)[:, 0]
+            if top1_oh is None:
+                top1_oh = oh
+            slots_l.append(slot_k)
+            keeps_l.append(slot_k < cap)
+            counts = counts + jnp.sum(oh, axis=0)
+        slot = jnp.stack(slots_l, axis=1)                        # (t, k)
+        keep = jnp.stack(keeps_l, axis=1)                        # (t, k)
+        if self.top_k > 1:
+            denom = jnp.maximum(jnp.sum(top_gates, axis=1, keepdims=True),
+                                1e-9)
+        else:
+            denom = jnp.ones_like(top_gates)
+        wgt = (top_gates / denom) * keep.astype(flat.dtype)
+        frac_tokens = jnp.mean(top1_oh.astype(gates.dtype), axis=0)
+        mean_gate = jnp.mean(gates, axis=0)
+        aux = self.n_experts * jnp.sum(frac_tokens * mean_gate)
+        return top_idx, slot, wgt, keep, aux
+
+    def grouped_dispatch(self, flat, expert_id, slot, keep, cap: int):
+        """Scatter kept assignments into the (E, C, d) expert batch: row
+        ``expert_id * C + slot`` receives the token vector; dropped
+        assignments target a discarded overflow row.  Kept rows are
+        unique by construction (cumsum slot assignment), so the
+        scatter-add materializes exactly what the dispatch einsum
+        builds, with unfilled capacity slots staying zero."""
+        t, d = flat.shape
+        dump = self.n_experts * cap                 # overflow row, discarded
+        rows = jnp.where(keep, expert_id * cap + slot, dump)     # (t, k)
+        tok = jnp.repeat(jnp.arange(t), self.top_k)
+        buf = jnp.zeros((dump + 1, d), flat.dtype)
+        buf = buf.at[rows.reshape(-1)].add(flat[tok])
+        return buf[:dump].reshape(self.n_experts, cap, d)
+
+    def grouped_combine(self, expert_out, expert_id, slot, wgt, keep,
+                        cap: int):
+        """Gather each assignment's expert-output row and weighted-sum
+        over the k tiers — the combine einsum without the (t, E, C)
+        intermediate.  ``wgt`` carries the keep mask, so dropped
+        assignments add zero (they gather an arbitrary row, then
+        multiply by 0)."""
+        d = expert_out.shape[-1]
+        rows = jnp.where(keep, expert_id * cap + slot, 0)        # (t, k)
+        picked = expert_out.reshape(self.n_experts * cap, d)[
+            rows.reshape(-1)].reshape(rows.shape + (d,))         # (t, k, d)
+        return jnp.sum(picked * wgt[:, :, None], axis=1)
+
     def set_expert_parallel(self, axis_name, n_shards: int
                             ) -> "MixtureOfExperts":
         """Wire the trainer's mesh ``expert`` axis (duck-typed, like
@@ -208,6 +299,14 @@ class MixtureOfExperts(Module):
         if ep is not None and _axis_bound(ep):
             out, aux = self._apply_expert_parallel(params, flat, state,
                                                    training, rng)
+        elif self._impl() == "grouped":
+            eid, slot, wgt, keep, aux = self.route_compact(params, flat)
+            cap = self.capacity(flat.shape[0])
+            expert_in = self.grouped_dispatch(flat, eid, slot, keep, cap)
+            expert_out = self.expert_forward(params, expert_in, state,
+                                             training, rng)
+            out = self.grouped_combine(expert_out, eid, slot, wgt, keep,
+                                       cap)
         else:
             dispatch, combine, aux = self.route(params, flat)
             expert_in = jnp.einsum("tec,td->ecd", dispatch, flat)
@@ -229,8 +328,17 @@ class MixtureOfExperts(Module):
         trainer's loss term sees the global balance."""
         from jax import lax
         ep, n = self.expert_parallel, self._ep_shards
-        dispatch, combine, aux = self.route(params, flat)
-        expert_in = jnp.einsum("tec,td->ecd", dispatch, flat)
+        grouped = self._impl() == "grouped"
+        if grouped:
+            # grouped path: only the LOCAL dispatch/combine
+            # materialization changes — the all_to_all exchange geometry
+            # and per-shard capacity semantics are identical
+            eid, slot, wgt, keep, aux = self.route_compact(params, flat)
+            cap = self.capacity(flat.shape[0])
+            expert_in = self.grouped_dispatch(flat, eid, slot, keep, cap)
+        else:
+            dispatch, combine, aux = self.route(params, flat)
+            expert_in = jnp.einsum("tec,td->ecd", dispatch, flat)
         # (E, C, d) -> (E/n, n*C, d): every peer's tokens for my experts
         expert_in = lax.all_to_all(expert_in, ep, split_axis=0,
                                    concat_axis=1, tiled=True)
@@ -243,5 +351,8 @@ class MixtureOfExperts(Module):
                                   experts=mine)
         out = lax.all_to_all(out, ep, split_axis=1, concat_axis=0,
                              tiled=True)                     # (E, C, d)
-        y = jnp.einsum("tec,ecd->td", combine, out)
+        if grouped:
+            y = self.grouped_combine(out, eid, slot, wgt, keep, cap)
+        else:
+            y = jnp.einsum("tec,ecd->td", combine, out)
         return y, lax.pmean(aux, ep)

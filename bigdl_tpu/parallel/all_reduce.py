@@ -140,6 +140,45 @@ class AllReduceParameter:
         (reference ``getWeights`` / ``sendWeightPartition``)."""
         return lax.all_gather(shard, axis, tiled=True)
 
+    # ---- bucketed collectives (the latency-hiding overlap schedule) ------
+    #
+    # The padded flat vector is a logical (n_shards, shard_size) matrix:
+    # row i is device i's ZeRO-1 slice.  A bucket is a contiguous COLUMN
+    # range [a, b) of that matrix — so bucket k of every device's shard
+    # lines up, per-bucket reduce-scatter/all-gather over the column block
+    # is element-identical to the monolithic collective (same per-element
+    # reduction order, same placement), and summed over buckets the wire
+    # bytes are exactly the monolithic param_bytes.  N independent
+    # RS->update->AG chains is what lets XLA's latency-hiding scheduler
+    # overlap bucket k's collective with bucket k+1's compute.
+
+    def bucket_edges(self, n_buckets: int):
+        """~Equal contiguous [start, stop) column ranges over
+        ``shard_size``.  Clamped to at most one column per bucket; the
+        rounding spreads a non-divisible remainder one column at a time
+        (every column appears in exactly one bucket)."""
+        n = max(1, min(int(n_buckets), self.shard_size))
+        edges = [round(i * self.shard_size / n) for i in range(n + 1)]
+        return [(a, b) for a, b in zip(edges, edges[1:]) if b > a]
+
+    def reduce_scatter_bucket(self, columns: jnp.ndarray,
+                              axis: str) -> jnp.ndarray:
+        """Reduce-scatter one column block.  ``columns``: the
+        (n_shards, b-a) slice of the local gradient matrix view; returns
+        this device's summed (b-a,) piece of it."""
+        if self.compression == "bf16":
+            columns = columns.astype(jnp.bfloat16)
+        shard = lax.psum_scatter(columns, axis, scatter_dimension=0,
+                                 tiled=True)
+        return shard.reshape(-1).astype(self.dtype)
+
+    def all_gather_bucket(self, bucket_shard: jnp.ndarray,
+                          axis: str) -> jnp.ndarray:
+        """Gather one updated column block back from every device:
+        (b-a,) per device -> the (n_shards, b-a) column block of the new
+        flat matrix view."""
+        return lax.all_gather(bucket_shard, axis, tiled=False)
+
 
 # ---- declared-contract collective helpers -----------------------------------
 #

@@ -136,6 +136,62 @@ def map_over_slots(optim_method, fn, slots, per_param_tree):
 _pmean_float = pmean_floats
 
 
+def _leaf_bucket_groups(params, n_buckets: int):
+    """Partition the parameter leaves (flatten order) into at most
+    ``n_buckets`` contiguous, size-balanced index groups — the GSPMD
+    counterpart of :meth:`AllReduceParameter.bucket_edges`, operating on
+    whole leaves because the partitioner owns each leaf's sharding.  A
+    group closes once its leaves reach the next even-split boundary of
+    the total element count."""
+    leaves = jax.tree_util.tree_leaves(params)
+    sizes = [int(np.prod(np.shape(x))) for x in leaves]
+    total = sum(sizes)
+    n = max(1, min(int(n_buckets), len(leaves)))
+    groups, cur, acc = [], [], 0
+    for i, s in enumerate(sizes):
+        cur.append(i)
+        acc += s
+        if len(groups) < n - 1 and acc >= (len(groups) + 1) * total / n:
+            groups.append(cur)
+            cur = []
+    if cur:
+        groups.append(cur)
+    return groups
+
+
+def _bucketed_leaf_update(optim_method, groups, grads, params, slots, hyper):
+    """Run the optimizer update as one independent chain per leaf group.
+    Each group's gradient leaves pass through a ``lax.optimization_barrier``
+    so XLA treats the group as its own scheduling unit (its
+    partitioner-inserted gradient reductions can overlap other groups'
+    update compute); the update itself is the same elementwise
+    ``pure_update`` on the group's sub-pytree, so numerics are identical
+    to the whole-tree call."""
+    p_leaves, pdef = jax.tree_util.tree_flatten(params)
+    g_leaves = pdef.flatten_up_to(grads)
+    outer = jax.tree_util.tree_structure(
+        optim_method.init_slots(jnp.zeros(())))
+    fam_leaves = [pdef.flatten_up_to(f) for f in outer.flatten_up_to(slots)]
+    new_p = [None] * len(p_leaves)
+    new_f = [[None] * len(p_leaves) for _ in fam_leaves]
+    for idxs in groups:
+        gg = list(lax.optimization_barrier(
+            tuple(g_leaves[i] for i in idxs)))
+        sg = jax.tree_util.tree_unflatten(
+            outer, [[fl[i] for i in idxs] for fl in fam_leaves])
+        pp, ss = optim_method.pure_update(
+            gg, [p_leaves[i] for i in idxs], sg, hyper)
+        ss_f = outer.flatten_up_to(ss)
+        for j, i in enumerate(idxs):
+            new_p[i] = pp[j]
+            for fi in range(len(fam_leaves)):
+                new_f[fi][i] = ss_f[fi][j]
+    new_params = jax.tree_util.tree_unflatten(pdef, new_p)
+    new_slots = jax.tree_util.tree_unflatten(
+        outer, [jax.tree_util.tree_unflatten(pdef, nf) for nf in new_f])
+    return new_params, new_slots
+
+
 class DistriOptimizer(Optimizer):
     """Data-parallel trainer over a device mesh
     (reference ``optim/DistriOptimizer.scala:689``).
@@ -212,8 +268,31 @@ class DistriOptimizer(Optimizer):
         from bigdl_tpu.utils import config
         guard = config.get_bool("bigdl.divergence.guard", True)
         # audit fault injection: duplicate the weight all-gather so the
-        # step's program breaks its declared max_ops=1 all-gather bound
+        # step's program breaks its declared all-gather op ceiling
         extra_ag = config.get_bool("bigdl.chaos.extraAllGather", False)
+        # the latency-hiding overlap schedule: the ZeRO-1 exchange runs as
+        # N independent per-bucket reduce-scatter -> update -> all-gather
+        # chains (same wire bytes, element-identical numerics) so XLA's
+        # scheduler can overlap bucket k's collective with bucket k±1's
+        # compute; bigdl.parallel.overlap=false keeps the monolithic
+        # baseline program
+        overlap = config.get_bool("bigdl.parallel.overlap", True)
+        edges = (arp.bucket_edges(
+                     config.get_int("bigdl.parallel.overlapBuckets", 4))
+                 if overlap else [(0, arp.shard_size)])
+        # audit fault injection: bucket k's reduce-scatter silently
+        # replaced by the device's own unreduced rows — the
+        # missing-per-bucket-collective case the auditor's min_ops floor
+        # exists to catch
+        drop_bucket = config.get_property("bigdl.chaos.dropBucketCollective",
+                                          None)
+        drop_bucket = (int(drop_bucket) % len(edges)
+                       if drop_bucket not in (None, "", False) else None)
+        from bigdl_tpu import telemetry
+        telemetry.REGISTRY.gauge(
+            "Parallel/overlap_buckets", summary=True,
+            help="per-step collective buckets (1 = monolithic schedule)"
+        ).set(float(len(edges)))
 
         def shard_step(flat_params, slots, mstate, inputs, targets, hyper, rng):
             # distinct dropout masks per shard, like the reference's
@@ -244,39 +323,105 @@ class DistriOptimizer(Optimizer):
                 # expert shards saw disjoint tokens AND ran disjoint expert
                 # blocks: contributions sum over the axis
                 flat_grads = axis_sum(flat_grads, expert_axis)
-            # reduce-scatter: own gradient slice, summed over shards
-            grad_shard = arp.reduce_scatter_gradients(flat_grads, axis) / n
-            # ZeRO-1: update only this device's parameter slice + slots
-            param_shard = arp.local_shard(flat_params, axis)
-            new_shard, new_slots = optim.pure_update(grad_shard, param_shard,
-                                                     slots, hyper)
-            if guard:
-                # divergence guard: non-finite loss/grad → every shard
-                # keeps its pre-step slice.  The verdict must be GLOBAL
-                # (pmin over the data axis): each device only sees 1/N of
-                # the gradient vector, and replicas applying different
-                # verdicts would silently fork the model
-                ok = jnp.logical_and(all_finite(loss),
-                                     all_finite(grad_shard))
-                ok = axis_min(ok.astype(jnp.int32), axis)
-                for extra in (seq_axis, expert_axis):
-                    if extra:   # seq/expert replicas must agree too
-                        ok = axis_min(ok, extra)
-                ok = ok.astype(bool)
-                new_shard = select_tree(ok, new_shard, param_shard)
-                new_slots = select_tree(ok, new_slots, slots)
-                new_mstate = select_tree(ok, new_mstate, mstate)
-                # a skipped step must report non-finite to the driver's
-                # bad-step counter even when only the GRADS overflowed
-                loss = jnp.where(ok, loss, jnp.nan)
-            # all-gather the updated weights for the next forward
-            new_flat = arp.all_gather_weights(new_shard, axis)
-            if extra_ag:
-                # the redundant gather returns the identical vector, so
-                # (x + x) / 2 is bit-exact — but the program now carries
-                # a second all-gather for the auditor to catch
-                new_flat = (new_flat
-                            + arp.all_gather_weights(new_shard, axis)) / 2
+            if overlap:
+                # bucketed schedule: the padded flat vector viewed as an
+                # (n_shards, shard_size) matrix, each bucket a contiguous
+                # column range — per bucket, reduce-scatter its columns,
+                # update this device's piece, and all-gather it back.
+                # The chains share no data flow until the divergence
+                # verdict, so the scheduler is free to run bucket k's
+                # collective under bucket k±1's update compute; summed
+                # over buckets the wire bytes equal the monolithic
+                # schedule and every element sees the same reduction
+                # order (parity is exact, not approximate).
+                gmat = flat_grads.reshape(arp.n_shards, arp.shard_size)
+                param_row = arp.local_shard(flat_params, axis)
+                grad_b, new_p, new_s = [], [], []
+                for k, (a, b) in enumerate(edges):
+                    if drop_bucket == k:
+                        # chaos: this bucket's collective is GONE — the
+                        # device's own unreduced gradient rows stand in
+                        g_k = jnp.take(gmat[:, a:b], lax.axis_index(axis),
+                                       axis=0).astype(arp.dtype) / n
+                    else:
+                        g_k = arp.reduce_scatter_bucket(gmat[:, a:b],
+                                                        axis) / n
+                    s_k = jax.tree_util.tree_map(lambda v: v[a:b], slots)
+                    p_k, ns_k = optim.pure_update(g_k, param_row[a:b],
+                                                  s_k, hyper)
+                    grad_b.append(g_k)
+                    new_p.append(p_k)
+                    new_s.append(ns_k)
+                if guard:
+                    # the verdict stays GLOBAL over the whole vector: all
+                    # buckets' gradients feed one pmin (the one sync point
+                    # the baseline schedule has too)
+                    ok = all_finite(loss)
+                    for g_k in grad_b:
+                        ok = jnp.logical_and(ok, all_finite(g_k))
+                    ok = axis_min(ok.astype(jnp.int32), axis)
+                    for extra in (seq_axis, expert_axis):
+                        if extra:
+                            ok = axis_min(ok, extra)
+                    ok = ok.astype(bool)
+                    new_p = [select_tree(ok, p_k, param_row[a:b])
+                             for p_k, (a, b) in zip(new_p, edges)]
+                    new_s = [select_tree(
+                                 ok, s_k,
+                                 jax.tree_util.tree_map(
+                                     lambda v, a=a, b=b: v[a:b], slots))
+                             for s_k, (a, b) in zip(new_s, edges)]
+                    new_mstate = select_tree(ok, new_mstate, mstate)
+                    loss = jnp.where(ok, loss, jnp.nan)
+                # per-bucket gathers: each depends only on its own
+                # bucket's selected shard (plus the shared verdict)
+                blocks = [arp.all_gather_bucket(p_k, axis) for p_k in new_p]
+                if extra_ag:
+                    blocks[0] = (blocks[0] + arp.all_gather_bucket(
+                        new_p[0], axis)) / 2
+                new_flat = jnp.concatenate(blocks, axis=1).reshape(-1)
+                new_slots = (jax.tree_util.tree_map(
+                                 lambda *xs: jnp.concatenate(xs), *new_s)
+                             if jax.tree_util.tree_leaves(slots)
+                             else slots)
+            else:
+                # monolithic baseline: one reduce-scatter, one update,
+                # one all-gather
+                grad_shard = arp.reduce_scatter_gradients(flat_grads,
+                                                          axis) / n
+                # ZeRO-1: update only this device's parameter slice + slots
+                param_shard = arp.local_shard(flat_params, axis)
+                new_shard, new_slots = optim.pure_update(
+                    grad_shard, param_shard, slots, hyper)
+                if guard:
+                    # divergence guard: non-finite loss/grad → every shard
+                    # keeps its pre-step slice.  The verdict must be GLOBAL
+                    # (pmin over the data axis): each device only sees 1/N
+                    # of the gradient vector, and replicas applying
+                    # different verdicts would silently fork the model
+                    ok = jnp.logical_and(all_finite(loss),
+                                         all_finite(grad_shard))
+                    ok = axis_min(ok.astype(jnp.int32), axis)
+                    for extra in (seq_axis, expert_axis):
+                        if extra:   # seq/expert replicas must agree too
+                            ok = axis_min(ok, extra)
+                    ok = ok.astype(bool)
+                    new_shard = select_tree(ok, new_shard, param_shard)
+                    new_slots = select_tree(ok, new_slots, slots)
+                    new_mstate = select_tree(ok, new_mstate, mstate)
+                    # a skipped step must report non-finite to the
+                    # driver's bad-step counter even when only the GRADS
+                    # overflowed
+                    loss = jnp.where(ok, loss, jnp.nan)
+                # all-gather the updated weights for the next forward
+                new_flat = arp.all_gather_weights(new_shard, axis)
+                if extra_ag:
+                    # the redundant gather returns the identical vector,
+                    # so (x + x) / 2 is bit-exact — but the program now
+                    # carries a second all-gather for the auditor to catch
+                    new_flat = (new_flat
+                                + arp.all_gather_weights(new_shard,
+                                                         axis)) / 2
 
             loss = axis_mean(loss, axis)
             new_mstate = pmean_floats(new_mstate, axis)
@@ -317,7 +462,8 @@ class DistriOptimizer(Optimizer):
             if jnp.issubdtype(x.dtype, jnp.floating))
         contract = program_contracts.shard_map_contract(
             precision, param_bytes, state_bytes,
-            seq_axis=bool(seq_axis), expert_axis=bool(expert_axis))
+            seq_axis=bool(seq_axis), expert_axis=bool(expert_axis),
+            n_buckets=len(edges))
         return compile_cache.tracked_jit(sharded, label="shard_map",
                                          topology=self._topology_meta(),
                                          contract=contract,
@@ -631,6 +777,26 @@ class DistriOptimizer(Optimizer):
         aux_weight = self.moe_aux_weight
         from bigdl_tpu.utils import config
         guard = config.get_bool("bigdl.divergence.guard", True)
+        # GSPMD overlap: the collectives here are partitioner-inserted,
+        # so bucketing means partitioning the PARAMETER LEAVES into ~N
+        # contiguous size-balanced groups and running each group's
+        # optimizer update as its own scheduling unit (an
+        # optimization_barrier pins the group boundary) — the
+        # partitioner's collective combiner then emits per-group
+        # gradient reductions the scheduler can overlap with other
+        # groups' update compute.  Identical elementwise numerics; the
+        # traced program stays collective-free, so the gspmd contract is
+        # unchanged.
+        overlap = config.get_bool("bigdl.parallel.overlap", True)
+        groups = (_leaf_bucket_groups(
+                      model.params,
+                      config.get_int("bigdl.parallel.overlapBuckets", 4))
+                  if overlap else None)
+        from bigdl_tpu import telemetry
+        telemetry.REGISTRY.gauge(
+            "Parallel/overlap_buckets", summary=True,
+            help="per-step collective buckets (1 = monolithic schedule)"
+        ).set(float(len(groups) if groups else 1))
 
         def step(params, slots, mstate, inputs, targets, hyper, rng):
             def loss_fn(p):
@@ -643,8 +809,12 @@ class DistriOptimizer(Optimizer):
 
             (loss, new_mstate), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
-            new_params, new_slots = optim.pure_update(grads, params, slots,
-                                                      hyper)
+            if groups is not None and len(groups) > 1:
+                new_params, new_slots = _bucketed_leaf_update(
+                    optim, groups, grads, params, slots, hyper)
+            else:
+                new_params, new_slots = optim.pure_update(grads, params,
+                                                          slots, hyper)
             if guard:
                 # divergence guard (logically-global arrays: XLA's
                 # partitioner makes the finiteness verdict consistent

@@ -61,8 +61,17 @@ def expert_parallel_apply(moe: MixtureOfExperts, params, x: jnp.ndarray,
 
     def shard_fn(p, xs):
         flat = jnp.reshape(xs, (-1, moe.d_model))          # local tokens
-        dispatch, combine, aux = moe.route(p, flat)        # (t, E, C)
-        expert_in = jnp.einsum("tec,td->ecd", dispatch, flat)
+        grouped = moe._impl() == "grouped"
+        if grouped:
+            # grouped materialization (bigdl.moe.impl=grouped): scatter /
+            # gather instead of the (t, E, C) one-hot einsums — the
+            # exchange geometry and capacity semantics are identical
+            eid, slot, wgt, keep, aux = moe.route_compact(p, flat)
+            cap = moe.capacity(flat.shape[0])
+            expert_in = moe.grouped_dispatch(flat, eid, slot, keep, cap)
+        else:
+            dispatch, combine, aux = moe.route(p, flat)    # (t, E, C)
+            expert_in = jnp.einsum("tec,td->ecd", dispatch, flat)
         # exchange queues: split the expert dim across devices, gather the
         # capacity dim — each device ends up with (E/n, n*C, d): every
         # peer's tokens for the experts this device owns
@@ -72,7 +81,10 @@ def expert_parallel_apply(moe: MixtureOfExperts, params, x: jnp.ndarray,
         # route results back to the devices whose tokens they are
         out = lax.all_to_all(out, axis, split_axis=1, concat_axis=0,
                              tiled=True)                   # (E, C, d)
-        y = jnp.einsum("tec,ecd->td", combine, out)
+        if grouped:
+            y = moe.grouped_combine(out, eid, slot, wgt, keep, cap)
+        else:
+            y = jnp.einsum("tec,ecd->td", combine, out)
         y = jnp.reshape(y, xs.shape)
         if return_aux:
             return y, lax.pmean(aux, axis)

@@ -130,6 +130,23 @@ _DEFAULTS: Dict[str, Any] = {
     # to catch (step-BUILD time, unlike the runtime chaos hooks above)
     "bigdl.chaos.extraAllGather": False,  # redundant all-gather in shard_map
     "bigdl.chaos.f32Upcast": False,       # f32 matmul inside a bf16 program
+    "bigdl.chaos.dropBucketCollective": None,  # k: bucket k's reduce-scatter
+    # silently replaced by a local slice — MISSING-collective auditor prey
+    # latency-hiding collective overlap (parallel/distri_optimizer.py):
+    # the ZeRO-1 exchange runs as N independent per-bucket reduce-scatter
+    # -> update -> all-gather chains so XLA's latency-hiding scheduler can
+    # overlap ICI with compute; same wire bytes, same numerics
+    "bigdl.parallel.overlap": True,        # False = monolithic baseline step
+    "bigdl.parallel.overlapBuckets": 4,    # contiguous param buckets per step
+    # MoE execution path (nn/moe.py): "einsum" = capacity-slot dispatch/
+    # combine einsums (GShard reference form), "grouped" = expert-sorted
+    # scatter + grouped batched matmul + gather-combine (same capacity-drop
+    # and aux-loss semantics, O(t*k*d) instead of O(t*E*C*d) data movement)
+    "bigdl.moe.impl": "einsum",
+    # default activation-checkpoint policy for transformer_lm blocks when
+    # the builder's remat arg is unset: "nothing" / "dots" / "save_attn"
+    # (nn.Remat's preset vocabulary); None = no remat
+    "bigdl.remat.policy": None,
     # runtime telemetry (bigdl_tpu/telemetry): span tracer + step-time
     # decomposition + metrics registry
     "bigdl.telemetry.trace": False,        # arm the span tracer

@@ -278,3 +278,113 @@ def test_diagnostic_scoping_is_per_module():
     moe = _moe()
     assert not semantic_state_leaves(moe), \
         "MoE's declared diagnostic must be excluded"
+
+
+def _grouped(moe):
+    """Context-style helper: flip the layer to the grouped execution path
+    (``bigdl.moe.impl=grouped``) and drop its jit cache."""
+    from bigdl_tpu.utils import config
+    config.set_property("bigdl.moe.impl", "grouped")
+    moe._jit_apply = None
+
+
+def _einsum(moe):
+    from bigdl_tpu.utils import config
+    config.clear_property("bigdl.moe.impl")
+    moe._jit_apply = None
+
+
+class TestGroupedImpl:
+    """bigdl.moe.impl=grouped: one scatter-gathered grouped batched matmul
+    over all experts must reproduce the dispatch/combine einsum path
+    exactly — same capacity drops, same gate weighting, same aux loss."""
+
+    def _cmp(self, moe, x, tol=1e-6):
+        _einsum(moe)
+        want = np.asarray(moe.forward(x))
+        _grouped(moe)
+        try:
+            got = np.asarray(moe.forward(x))
+        finally:
+            _einsum(moe)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=tol)
+
+    def test_top1_matches_einsum(self):
+        x = jnp.asarray(np.random.RandomState(0)
+                        .normal(size=(16, D)).astype(np.float32))
+        self._cmp(_moe(capacity_factor=8.0), x)
+
+    def test_top1_capacity_drops_match(self):
+        # cf=0.26 -> capacity 2 for 16 tokens: most tokens drop, and the
+        # grouped path must drop EXACTLY the same ones (position-in-queue
+        # tie-breaking included)
+        x = jnp.asarray(np.random.RandomState(5)
+                        .normal(size=(16, D)).astype(np.float32))
+        self._cmp(_moe(capacity_factor=0.26), x)
+        self._cmp(_moe(capacity_factor=0.26),
+                  jnp.asarray(np.ones((16, D), np.float32)))
+
+    def test_top2_matches_einsum(self):
+        expert = (nn.Sequential().add(nn.Linear(D, 2 * D)).add(nn.ReLU())
+                  .add(nn.Linear(2 * D, D)))
+        for cf in (8.0, 0.26):
+            moe = MixtureOfExperts(D, expert, E, capacity_factor=cf,
+                                   top_k=2)
+            moe.reset(jax.random.PRNGKey(9))
+            x = jnp.asarray(np.random.RandomState(6)
+                            .normal(size=(16, D)).astype(np.float32))
+            self._cmp(moe, x)
+
+    def test_aux_loss_matches_einsum(self):
+        moe = _moe(capacity_factor=8.0)
+        x = jnp.asarray(np.random.RandomState(7)
+                        .normal(size=(16, D)).astype(np.float32))
+        _, _, aux_e = moe.route(moe.params, x)
+        _, _, _, _, aux_g = moe.route_compact(moe.params, x)
+        np.testing.assert_allclose(float(aux_g), float(aux_e), rtol=1e-6)
+
+    def test_expert_parallel_path_matches_einsum(self):
+        mesh = Engine.create_mesh((N_DEV,), ("expert",),
+                                  devices=jax.devices()[:N_DEV])
+        x = jnp.asarray(np.random.RandomState(8)
+                        .normal(size=(16, D)).astype(np.float32))
+        for cf, k in ((8.0, 1), (0.26, 1), (8.0, 2)):
+            expert = (nn.Sequential().add(nn.Linear(D, 2 * D))
+                      .add(nn.ReLU()).add(nn.Linear(2 * D, D)))
+            moe = MixtureOfExperts(D, expert, E, capacity_factor=cf,
+                                   top_k=k)
+            moe.reset(jax.random.PRNGKey(3))
+            params = ep_shard_params(moe.params, mesh)
+            _einsum(moe)
+            want = np.asarray(expert_parallel_apply(moe, params, x, mesh))
+            _grouped(moe)
+            try:
+                got = np.asarray(
+                    expert_parallel_apply(moe, params, x, mesh))
+            finally:
+                _einsum(moe)
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_gradients_flow_through_grouped_path(self):
+        moe = _moe()
+        x = jnp.asarray(np.random.RandomState(9)
+                        .normal(size=(8, D)).astype(np.float32))
+        _grouped(moe)
+        try:
+            g = jax.grad(
+                lambda p: jnp.mean(moe.apply(p, x, moe.state)[0] ** 2)
+            )(moe.params)
+        finally:
+            _einsum(moe)
+        for leaf in jax.tree_util.tree_leaves(g):
+            assert np.all(np.isfinite(np.asarray(leaf)))
+
+    def test_unknown_impl_rejected(self):
+        from bigdl_tpu.utils import config
+        moe = _moe()
+        config.set_property("bigdl.moe.impl", "banana")
+        try:
+            with pytest.raises(ValueError, match="bigdl.moe.impl"):
+                moe.forward(jnp.zeros((4, D)))
+        finally:
+            _einsum(moe)

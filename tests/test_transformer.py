@@ -11,7 +11,8 @@ from bigdl_tpu.dataset import SampleToMiniBatch
 from bigdl_tpu.dataset.dataset import LocalDataSet, ShardedDataSet
 from bigdl_tpu.engine import Engine
 from bigdl_tpu.models.transformer import (LayerNorm, PositionalEncoding,
-                                          transformer_lm)
+                                          transformer_lm,
+                                          transformer_lm_pipeline)
 from bigdl_tpu.models.transformer.train import VOCAB, _synthetic
 from bigdl_tpu.parallel import DistriOptimizer
 
@@ -77,6 +78,51 @@ class TestTransformerLM:
                         jax.tree_util.tree_leaves(grads_r)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-3, atol=1e-8)
+
+    def test_remat_config_preset_applies(self):
+        """bigdl.remat.policy wraps blocks when the builder argument is
+        left alone; an explicit argument wins over the preset; off/none
+        keep remat off; a preset typo fails at construction."""
+        from bigdl_tpu.utils import config
+        try:
+            config.set_property("bigdl.remat.policy", "dots")
+            m = transformer_lm(VOCAB, d_model=16, n_head=2, n_layers=2)
+            assert all(isinstance(c, nn.Remat) for c in m.children[2:4])
+            # explicit remat=None beats the preset
+            m2 = transformer_lm(VOCAB, d_model=16, n_head=2, n_layers=2,
+                                remat=None)
+            assert not any(isinstance(c, nn.Remat) for c in m2.children)
+            config.set_property("bigdl.remat.policy", "off")
+            m3 = transformer_lm(VOCAB, d_model=16, n_head=2, n_layers=2)
+            assert not any(isinstance(c, nn.Remat) for c in m3.children)
+            config.set_property("bigdl.remat.policy", "save_attn")
+            e, b, h = transformer_lm_pipeline(VOCAB, d_model=16, n_head=2,
+                                              n_layers=2)
+            assert all(isinstance(x, nn.Remat) for x in b)
+            config.set_property("bigdl.remat.policy", "everything")
+            with pytest.raises(ValueError, match="remat policy"):
+                transformer_lm(VOCAB, d_model=16, n_head=2,
+                               n_layers=1).forward(
+                    np.ones((1, 4), np.float32))
+        finally:
+            config.clear_property("bigdl.remat.policy")
+
+    def test_remat_preset_numerics_match(self):
+        """A preset-wrapped model's forward is the identical program."""
+        from bigdl_tpu.utils import config
+        base = transformer_lm(VOCAB, d_model=16, n_head=2, n_layers=2)
+        base.reset(jax.random.PRNGKey(5))
+        try:
+            config.set_property("bigdl.remat.policy", "nothing")
+            rem = transformer_lm(VOCAB, d_model=16, n_head=2, n_layers=2)
+        finally:
+            config.clear_property("bigdl.remat.policy")
+        rem.params = [[p] if isinstance(c, nn.Remat) else p
+                      for c, p in zip(rem.children, base.params)]
+        x = np.random.RandomState(4).randint(
+            1, VOCAB + 1, (2, 8)).astype(np.float32)
+        np.testing.assert_array_equal(np.asarray(rem.forward(x)),
+                                      np.asarray(base.forward(x)))
 
     def test_remat_rejects_unknown_policy(self):
         with pytest.raises(ValueError, match="remat policy"):
