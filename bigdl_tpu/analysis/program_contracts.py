@@ -172,7 +172,8 @@ def feval_contract() -> StepContract:
 def shard_map_contract(precision: Optional[str], param_bytes: int,
                        state_bytes: int, *, seq_axis: bool = False,
                        expert_axis: bool = False,
-                       n_buckets: int = 1) -> StepContract:
+                       n_buckets: int = 1,
+                       integrity: bool = False) -> StepContract:
     """The ZeRO-1 data-parallel shard_map step: exactly ``n_buckets``
     reduce-scatters over the summed gradient vector, exactly
     ``n_buckets`` all-gathers reassembling the updated weights (the
@@ -186,8 +187,18 @@ def shard_map_contract(precision: Optional[str], param_bytes: int,
     bucket collective means a parameter range silently trains on
     unreduced gradients.  A ``seq``/``expert`` axis adds one full
     gradient psum per extra axis (all-reduce bytes) plus the ring /
-    all-to-all exchange the wired layers perform inside the step."""
+    all-to-all exchange the wired layers perform inside the step.
+
+    ``integrity=True`` declares the training-state integrity traffic
+    (``bigdl.integrity.everyN`` > 0): exactly ONE extra all-gather — the
+    cross-replica fingerprint table exchange
+    (``all_reduce.gather_fingerprints``) — plus a few scalar all-reduces
+    (the sharded grad-norm psum, the widened verdict pmin) that ride
+    under the existing scalar slack.  Declared, not leaked: a
+    fingerprint collective the contract does not cover is exactly the
+    drift the auditor exists to catch."""
     extra_axes = int(seq_axis) + int(expert_axis)
+    fp_gathers = 1 if integrity else 0
     bounds: List[CollectiveBound] = [
         CollectiveBound(
             "reduce-scatter", max_ops=n_buckets, min_ops=n_buckets,
@@ -196,10 +207,15 @@ def shard_map_contract(precision: Optional[str], param_bytes: int,
                    "(arp.reduce_scatter_gradients / "
                    "arp.reduce_scatter_bucket)"),
         CollectiveBound(
-            "all-gather", max_ops=n_buckets, min_ops=n_buckets,
-            max_bytes=param_bytes,
+            "all-gather", max_ops=n_buckets + fp_gathers,
+            min_ops=n_buckets + fp_gathers,
+            max_bytes=param_bytes + (SCALAR_SLACK_BYTES if integrity
+                                     else 0),
             reason="per-bucket updated-weight reassembly "
-                   "(arp.all_gather_weights / arp.all_gather_bucket)"),
+                   "(arp.all_gather_weights / arp.all_gather_bucket)"
+                   + (" + integrity fingerprint table "
+                      "(all_reduce.gather_fingerprints)" if integrity
+                      else "")),
         CollectiveBound(
             "all-reduce", max_ops=None,
             # the mstate pmean repeats once per mesh axis the step

@@ -1,0 +1,62 @@
+"""Training-state integrity: fingerprints, agreement, self-healing.
+
+Detects finite-but-wrong training state — the silent corruption class
+every loud-failure guard (divergence, torn checkpoints, hangs) misses —
+and heals it through the existing restore/re-placement machinery.  See
+``docs/programming-guide/optimization.md`` ("Training-state integrity").
+"""
+
+from bigdl_tpu.integrity.errors import IntegrityError, ReplicaDesyncError
+from bigdl_tpu.integrity.fingerprint import (
+    DEFAULT_SEED,
+    GRAD_SEED_OFF,
+    NF_SENTINEL,
+    SLOT_SEED_OFF,
+    acc_dtype,
+    continuity_check,
+    fingerprint_flat,
+    fingerprint_key,
+    fingerprint_tree,
+    first_nonfinite,
+    host_fingerprint,
+    init_carry,
+    nonfinite_names,
+    pack_carry,
+    sq_norm,
+    sq_norm_diff,
+)
+from bigdl_tpu.integrity.health import WeightHealthMonitor
+from bigdl_tpu.integrity.monitor import (
+    DriverIntegrity,
+    bitflip_one_replica,
+    bitflip_tree,
+    majority_split,
+    replicated_shard_disagreement,
+)
+
+__all__ = [
+    "IntegrityError",
+    "ReplicaDesyncError",
+    "DEFAULT_SEED",
+    "NF_SENTINEL",
+    "acc_dtype",
+    "fingerprint_flat",
+    "fingerprint_key",
+    "fingerprint_tree",
+    "first_nonfinite",
+    "host_fingerprint",
+    "nonfinite_names",
+    "GRAD_SEED_OFF",
+    "SLOT_SEED_OFF",
+    "continuity_check",
+    "init_carry",
+    "pack_carry",
+    "sq_norm",
+    "sq_norm_diff",
+    "WeightHealthMonitor",
+    "DriverIntegrity",
+    "bitflip_one_replica",
+    "bitflip_tree",
+    "majority_split",
+    "replicated_shard_disagreement",
+]

@@ -1,0 +1,49 @@
+"""Structured errors for the training-state integrity subsystem.
+
+The taxonomy mirrors how each fault heals:
+
+- :class:`IntegrityError` — finite-but-wrong state detected on ONE
+  logical copy (a continuity break between consecutive fused steps, or a
+  checkpoint whose bytes verify but whose semantic fingerprint doesn't).
+  The retry loop classifies it like divergence: restore an older valid
+  snapshot, never retry in place, and never reset the retry budget on
+  the evalCounter ground the frozen run appears to have covered.
+- :class:`ReplicaDesyncError` — data-parallel replicas disagree on the
+  bitwise parameter fingerprint.  The agreeing majority still holds
+  canonical state, so the trainer heals WITHOUT a checkpoint restore:
+  re-broadcast the majority's parameters and re-place the ZeRO-1 slots
+  (``elastic.place_slots``), then replay from the first desynced
+  iteration.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+
+class IntegrityError(RuntimeError):
+    """Training state failed an integrity check while every value stayed
+    finite — silent data corruption, not divergence.  ``iteration`` is
+    the first iteration the corruption was observed at (the fused step
+    records it on-device, so a delayed driver pull still names the true
+    onset)."""
+
+    def __init__(self, message: str, iteration: Optional[int] = None):
+        super().__init__(message)
+        self.iteration = iteration
+
+
+class ReplicaDesyncError(IntegrityError):
+    """Data-parallel replicas disagree on the parameter fingerprint.
+
+    ``replicas`` names the minority (disagreeing) replica indices,
+    ``fingerprints`` carries the full gathered per-replica fingerprint
+    table the verdict was computed from, and ``iteration`` the first
+    iteration the disagreement was observed on-device."""
+
+    def __init__(self, message: str, replicas: Sequence[int] = (),
+                 iteration: Optional[int] = None,
+                 fingerprints: Any = None):
+        super().__init__(message, iteration)
+        self.replicas = tuple(int(r) for r in replicas)
+        self.fingerprints = fingerprints

@@ -1,0 +1,68 @@
+"""Weight-health monitor: EMA anomaly gates over integrity series.
+
+Grad-norm and update-ratio trends are the earliest observable symptoms
+of a run that is still finite but already exploding — they cross their
+healthy band many iterations before the first NaN reaches the
+divergence guard.  Each series feeds a
+:class:`~bigdl_tpu.telemetry.step_stats.SlowStepDetector` (the exact
+anomaly-gate discipline the slow-step and hung-dispatch watchdogs use:
+EMA seeded from the warmup MINIMUM so early optimizer transients cannot
+poison the baseline, one fire per anomaly window with a cooldown,
+``factor <= 0`` disables, anomalies never drag the EMA up).  A fire is
+a FLAG, not a fault: it logs, bumps ``Integrity/health_anomalies``, and
+leaves the run alone — the operator (or an outer controller) decides
+whether a hot trajectory warrants a rollback.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import Dict
+
+from bigdl_tpu import telemetry
+
+logger = logging.getLogger("bigdl_tpu")
+
+
+class WeightHealthMonitor:
+    """One anomaly gate per named series (``grad_norm``,
+    ``update_ratio``, per-bucket ratios, ...), created lazily so the
+    bucket count need not be known up front."""
+
+    def __init__(self, factor: float, warmup: int = 5, cooldown: int = 50):
+        self.factor = float(factor)
+        self.warmup = int(warmup)
+        self.cooldown = int(cooldown)
+        self._gates: Dict[str, telemetry.SlowStepDetector] = {}
+        self.anomalies = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.factor > 0
+
+    def observe(self, series: str, value: float, iteration: int) -> bool:
+        """Feed one observation; True iff it opened a new anomaly
+        window.  Non-finite values are ignored — the divergence guard
+        owns those, and a NaN must not poison the healthy-regime EMA."""
+        if not self.enabled or not math.isfinite(value):
+            return False
+        gate = self._gates.get(series)
+        if gate is None:
+            gate = telemetry.SlowStepDetector(
+                self.factor, warmup=self.warmup, cooldown=self.cooldown)
+            self._gates[series] = gate
+        fired = gate.observe(value)
+        if fired:
+            self.anomalies += 1
+            telemetry.counter(
+                "Integrity/health_anomalies",
+                help="weight-health EMA gates fired (finite but "
+                     "exploding state)").inc()
+            logger.warning(
+                "Weight-health anomaly at iteration %d: %s = %.3e "
+                "(> %.1fx the healthy EMA %.3e) — state is finite but "
+                "trending away from its baseline; a divergence guard "
+                "fire may follow", iteration, series, value, self.factor,
+                gate.ema if gate.ema else float("nan"))
+        return fired

@@ -1,0 +1,251 @@
+"""Driver-side integrity companion for the fused-step families.
+
+The fused steps compute fingerprints / agreement verdicts EVERY
+iteration on device (and AND them into the update-skip guard, so a
+corrupted replica can never contaminate healthy state — the run freezes
+instead); the driver pulls the small aux tree through the
+``analysis.host_pull`` choke point every ``bigdl.integrity.everyN``
+iterations and hands it here.  :meth:`DriverIntegrity.check` classifies
+the pulled verdicts — cross-replica disagreement raises
+:class:`~bigdl_tpu.integrity.errors.ReplicaDesyncError` naming the
+minority replicas, a continuity break raises
+:class:`~bigdl_tpu.integrity.errors.IntegrityError` — and feeds the
+weight-health EMA gates plus the ``Integrity/*`` registry metrics.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu import telemetry
+from bigdl_tpu.integrity.errors import IntegrityError, ReplicaDesyncError
+from bigdl_tpu.integrity.fingerprint import NF_SENTINEL
+from bigdl_tpu.integrity.health import WeightHealthMonitor
+
+logger = logging.getLogger("bigdl_tpu")
+
+
+def majority_split(keys: Sequence[bytes]):
+    """``(majority_key, minority_indices)`` of a list of bitwise
+    fingerprint keys.  Ties break toward the key holding the
+    lowest-indexed replica — with half the fleet corrupted there is no
+    canonical side, and a deterministic pick beats a coin flip."""
+    counts: Dict[bytes, int] = {}
+    for k in keys:
+        counts[k] = counts.get(k, 0) + 1
+    best = max(counts.items(), key=lambda kv: (kv[1], -keys.index(kv[0])))
+    major = best[0]
+    minority = [i for i, k in enumerate(keys) if k != major]
+    return major, minority
+
+
+def replicated_shard_disagreement(arr, what: str = "integrity replica "
+                                                   "shard"):
+    """Bitwise-compare the per-device copies of a REPLICATED array
+    (driver-side agreement for the GSPMD family, where the traced
+    program is collective-free and replication is the partitioner's
+    promise): returns ``(minority_replica_indices, per_copy_bytes)``.
+    Pulls go through the explicit host choke point."""
+    from bigdl_tpu.analysis.hostsync import host_pull
+    shards = sorted(arr.addressable_shards, key=lambda s: s.device.id)
+    keys = [np.asarray(host_pull(s.data, what=what)).tobytes()
+            for s in shards]
+    _, minority = majority_split(keys)
+    return minority, keys
+
+
+def _flip_low_bit(host: np.ndarray) -> np.ndarray:
+    """Flip one mid-mantissa bit of the first element — finite-preserving
+    corruption invisible to ``all_finite`` and far below loss-curve
+    resolution, but ABOVE the fingerprint's detection floor: the
+    fingerprint reduces in the accumulation dtype (f32), so a 1-ULP flip
+    can round away against the running sum; the chosen bit perturbs the
+    element by ~2^-11 of its magnitude, orders above that floor and
+    orders below anything training metrics can resolve."""
+    out = np.array(host, copy=True)
+    flat = out.reshape(-1)
+    bits, bit = {2: (np.uint16, 2), 4: (np.uint32, 12),
+                 8: (np.uint64, 40)}[out.dtype.itemsize]
+    flat.view(bits)[0] ^= bits(1) << bit
+    return out
+
+
+def bitflip_tree(tree, leaf_index: int = 0):
+    """Driver-side SDC injection for the local/GSPMD families: one
+    mid-mantissa bit of the ``leaf_index``-th float leaf flips.  Pulls and
+    re-places through the explicit host choke point, preserving the
+    leaf's sharding."""
+    import jax
+    import jax.numpy as jnp
+    from bigdl_tpu.analysis.hostsync import host_pull
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    float_pos = [i for i, l in enumerate(leaves)
+                 if jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)
+                 and jnp.asarray(l).size]
+    if not float_pos:
+        return tree
+    pos = float_pos[leaf_index % len(float_pos)]
+    leaf = leaves[pos]
+    host = _flip_low_bit(np.asarray(host_pull(leaf, what="chaos bitflip")))
+    sharding = getattr(leaf, "sharding", None)
+    leaves[pos] = (jax.device_put(host, sharding) if sharding is not None
+                   else jnp.asarray(host))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def bitflip_one_replica(arr, replica: int):
+    """Driver-side SDC injection for the shard_map dp family: flip one
+    bit in ONE replica's copy of a replicated array, leaving every other
+    copy untouched — the per-device buffers now disagree while the
+    logical array still looks healthy, which is exactly what real
+    in-HBM corruption does.  Rebuilt without any cross-device
+    consistency check (``make_array_from_single_device_arrays`` trusts
+    the caller), so agreement is the only detector."""
+    import jax
+    from bigdl_tpu.analysis.hostsync import host_pull
+    shards = sorted(arr.addressable_shards, key=lambda s: s.device.id)
+    copies = [np.array(host_pull(s.data, what="chaos bitflip"), copy=True)
+              for s in shards]
+    r = replica % len(copies)
+    copies[r] = _flip_low_bit(copies[r])
+    bufs = [jax.device_put(c, s.device) for c, s in zip(copies, shards)]
+    return jax.make_array_from_single_device_arrays(
+        arr.shape, arr.sharding, bufs)
+
+
+class DriverIntegrity:
+    """Per-run integrity state the trainers hand to the shared driver
+    loop: the non-finite leaf-name table (diagnosed divergence), the
+    pull cadence, the weight-health gates, and the verdict classifier."""
+
+    def __init__(self, family: str, nf_names: Sequence[str],
+                 every_n: int = 0, health: Optional[WeightHealthMonitor]
+                 = None):
+        self.family = family
+        self.nf_names = list(nf_names)
+        self.every_n = int(every_n)
+        self.health = health
+        self.checks = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.every_n > 0
+
+    def due(self, neval: int) -> bool:
+        return self.enabled and neval % self.every_n == 0
+
+    # -- diagnosed divergence -------------------------------------------
+
+    def describe_nonfinite(self, idx: int) -> str:
+        """Suffix for the bad-step log line / DivergenceError: which
+        tree and leaf path first went non-finite (empty when the index
+        is the all-finite sentinel — e.g. a chaos-injected NaN loss that
+        never existed on device)."""
+        if idx == NF_SENTINEL or idx < 0:
+            return ""
+        if idx < len(self.nf_names):
+            return f"; first non-finite: {self.nf_names[idx]}"
+        return f"; first non-finite: float leaf #{idx}"
+
+    # -- fingerprint verdicts -------------------------------------------
+
+    def _bad_iteration(self, vals: Dict[str, Any], neval: int) -> int:
+        it = int(float(vals.get("bad_iter", 0.0)))
+        return it if it > 0 else neval
+
+    def check(self, aux, neval: int) -> None:
+        """Classify one pulled aux tree.  Raises on corruption; feeds
+        health gates and gauges otherwise.  ``aux`` holds DEVICE values
+        — the (single, batched) pull happens here, through the choke
+        point."""
+        from bigdl_tpu.analysis.hostsync import host_pull
+        self.checks += 1
+        telemetry.counter(
+            "Integrity/checks",
+            help="driver-side fingerprint verdicts pulled").inc()
+        vals = host_pull(
+            {k: v for k, v in aux.items() if k != "fpc"},
+            what="integrity fingerprints")
+        fps_all = vals.get("fps_all")
+        if fps_all is not None:
+            fps_all = np.asarray(fps_all)
+            keys = [fps_all[i].tobytes() for i in range(fps_all.shape[0])]
+            _, minority = majority_split(keys)
+            if minority:
+                self._raise_desync(minority, fps_all, vals, neval)
+        if self.family == "gspmd" and "fp_p" in aux:
+            # replication is implicit in GSPMD: the traced program holds
+            # ONE logical fingerprint, so agreement is verified by
+            # bitwise-comparing the replicated output's per-device copies
+            minority, keys = replicated_shard_disagreement(aux["fp_p"])
+            if minority:
+                self._raise_desync(minority,
+                                   np.frombuffer(b"".join(keys),
+                                                 dtype=np.uint8),
+                                   vals, neval)
+        if float(vals.get("cont", 0.0)) > 0:
+            telemetry.counter(
+                "Integrity/continuity_failures",
+                help="fused-step fingerprint continuity breaks (silent "
+                     "in-memory corruption)").inc()
+            it = self._bad_iteration(vals, neval)
+            raise IntegrityError(
+                f"training-state fingerprint continuity broke at "
+                f"iteration {it} (observed at iteration {neval}; "
+                f"{self.family} step): parameters or optimizer slots "
+                "changed outside the fused step while every value "
+                "stayed finite — restoring the latest valid snapshot",
+                iteration=it)
+        self._observe_health(vals, neval)
+
+    def _raise_desync(self, minority: List[int], fps, vals, neval: int):
+        telemetry.counter(
+            "Integrity/desync_detected",
+            help="cross-replica fingerprint disagreements").inc()
+        it = self._bad_iteration(vals, neval)
+        raise ReplicaDesyncError(
+            f"data-parallel replica(s) {minority} disagree on the "
+            f"parameter fingerprint at iteration {it} (observed at "
+            f"iteration {neval}; {self.family} step) — healing by "
+            "re-broadcasting canonical state from the agreeing "
+            "majority", replicas=minority, iteration=it,
+            fingerprints=fps)
+
+    # -- weight health ---------------------------------------------------
+
+    def _observe_health(self, vals: Dict[str, Any], neval: int) -> None:
+        pn = float(vals.get("pn", float("nan")))
+        un = float(vals.get("un", float("nan")))
+        gn = float(vals.get("gn", float("nan")))
+        if not math.isfinite(pn):
+            return
+        param_norm = math.sqrt(max(pn, 0.0))
+        update_norm = math.sqrt(max(un, 0.0))
+        grad_norm = math.sqrt(max(gn, 0.0))
+        ratio = update_norm / max(param_norm, 1e-12)
+        telemetry.gauge("Integrity/param_norm", summary=True).set(
+            param_norm)
+        telemetry.gauge("Integrity/update_norm", summary=True).set(
+            update_norm)
+        telemetry.gauge("Integrity/grad_norm", summary=True).set(
+            grad_norm)
+        telemetry.gauge("Integrity/update_ratio", summary=True).set(ratio)
+        pb = np.asarray(vals.get("pb", ()), dtype=np.float64).ravel()
+        ub = np.asarray(vals.get("ub", ()), dtype=np.float64).ravel()
+        bucket_ratios = []
+        for i in range(min(pb.size, ub.size)):
+            r = math.sqrt(max(float(ub[i]), 0.0)) / max(
+                math.sqrt(max(float(pb[i]), 0.0)), 1e-12)
+            bucket_ratios.append(r)
+            telemetry.gauge(
+                "Integrity/bucket_update_ratio",
+                labels={"bucket": str(i)}).set(r)
+        if self.health is not None and self.health.enabled:
+            self.health.observe("grad_norm", grad_norm, neval)
+            self.health.observe("update_ratio", ratio, neval)
+            for i, r in enumerate(bucket_ratios):
+                self.health.observe(f"update_ratio_b{i}", r, neval)

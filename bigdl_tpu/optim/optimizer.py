@@ -141,13 +141,20 @@ def moe_aux_penalty(model: Module, new_mstate, weight: float):
 def all_finite(*trees) -> jnp.ndarray:
     """Scalar bool: every float leaf of every tree is finite.  The
     divergence guard's trace-time predicate — cheap relative to the step
-    (one reduction per leaf, fused by XLA)."""
-    ok = jnp.array(True)
+    (one reduction per leaf, fused by XLA).  Empty and integer-only
+    trees are vacuously finite and return a CONSTANT True without
+    building a single device op — callers branch on the guard at trace
+    time, and a float-free tree must not cost a device reduction (or a
+    tracer) to say nothing."""
+    ok = None
     for tree in trees:
         for leaf in jax.tree_util.tree_leaves(tree):
             leaf = jnp.asarray(leaf)
             if jnp.issubdtype(leaf.dtype, jnp.floating):
-                ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(leaf)))
+                fin = jnp.all(jnp.isfinite(leaf))
+                ok = fin if ok is None else jnp.logical_and(ok, fin)
+    if ok is None:
+        return np.bool_(True)
     return ok
 
 
@@ -432,9 +439,11 @@ class Optimizer:
                 self._commit_preemption_snapshot()
                 raise
             except Exception as e:
+                from bigdl_tpu.integrity import (IntegrityError,
+                                                 ReplicaDesyncError)
                 cur = self.optim_method.state.get("evalCounter", 0)
-                if (not isinstance(e, DivergenceError) and
-                        high_water is not None and cur > high_water):
+                if (not isinstance(e, (DivergenceError, IntegrityError))
+                        and high_water is not None and cur > high_water):
                     # NEW ground — training got further than any
                     # previous attempt, so this is a fresh fault, not
                     # the same one looping (reference retryNum reset
@@ -456,7 +465,27 @@ class Optimizer:
                 attempt += 1
                 if attempt >= retry_times:
                     raise
+                if (isinstance(e, ReplicaDesyncError)
+                        and getattr(e, "healed", False)):
+                    # the trainer already re-broadcast canonical state
+                    # from the agreeing majority and rewound the eval
+                    # counter — a checkpoint restore would throw away
+                    # the surviving replicas' newer, valid ground
+                    interval = _retry_backoff(attempt, base, cap)
+                    logger.warning(
+                        "Replica desync healed in place (attempt %d/%d); "
+                        "re-entering training in %.1fs: %s", attempt,
+                        retry_times, interval, e)
+                    _sleep(interval)
+                    continue
+                heal_t0 = time.monotonic()
                 restored = self._restore_latest_checkpoint()
+                if restored and isinstance(e, IntegrityError):
+                    telemetry.gauge(
+                        "Integrity/heal_ms",
+                        help="detection-to-heal latency of the last "
+                             "integrity fault (restore or re-broadcast)"
+                    ).set((time.monotonic() - heal_t0) * 1000.0)
                 if not restored and self._params_dead():
                     # the jitted step donates its carries: without a
                     # snapshot to reload, the in-memory params are gone
@@ -748,18 +777,25 @@ class Optimizer:
     # -- shared driver loop (used by Local and Distri trainers) -----------
 
     def _drive(self, fetch_batch, run_step, reset_epoch, publish,
-               epoch_size: int) -> Dict[str, Any]:
+               epoch_size: int, integrity=None) -> Dict[str, Any]:
         """The per-iteration driver loop both trainers share (reference
         ``optim/DistriOptimizer.scala:141-344`` / ``LocalOptimizer.scala:78``):
         fetch, step, bookkeeping/logging, epoch rollover, trigger-gated
         validation + checkpoint.
 
         ``fetch_batch() -> (inputs, targets, batch_size)`` and
-        ``run_step(inputs, targets, hyper, rng) -> loss`` close over the
-        trainer's device-resident carries; ``publish()`` syncs those carries
-        back into the model/optim shells — called only when a trigger fires
+        ``run_step(inputs, targets, hyper, rng) -> loss`` (or
+        ``-> (loss, aux)`` — a device-resident diagnostics pytree rides
+        the dispatch pipeline next to the loss) close over the trainer's
+        device-resident carries; ``publish()`` syncs those carries back
+        into the model/optim shells — called only when a trigger fires
         (the reference's getModel runs only at checkpoints, ``:818``) and
-        once at the end.
+        once at the end.  ``integrity`` is the trainer's
+        :class:`~bigdl_tpu.integrity.DriverIntegrity`: it names the
+        first non-finite leaf in the bad-step diagnostics, and at its
+        cadence classifies the step's fingerprint verdicts (raising
+        ``IntegrityError`` / ``ReplicaDesyncError`` into the retry
+        loop).
         """
         self._check_symmetric_config()
         state = _initial_driver_state()
@@ -824,7 +860,7 @@ class Optimizer:
         from bigdl_tpu.analysis.hostsync import host_pull
 
         def drain(item, nxt):
-            loss_dev, bsz, t0, epoch, recs, neval, parts = item
+            loss_dev, bsz, t0, epoch, recs, neval, parts, aux = item
             # the ONE intended device→host pull of the hot loop, through
             # the explicit choke point (permitted while the guard is armed)
             with telemetry.span("driver/host_wait"):
@@ -869,18 +905,37 @@ class Optimizer:
             # like a genuinely diverged trajectory
             if not math.isfinite(loss):
                 state["consecutiveBadSteps"] += 1
+                # diagnosed divergence: the step recorded the index of
+                # the first non-finite leaf on device; name it (the pull
+                # is explicit, through the choke point, and happens only
+                # on the already-slow bad-step path)
+                culprit = ""
+                if (integrity is not None and aux is not None
+                        and "nf" in aux):
+                    culprit = integrity.describe_nonfinite(
+                        int(host_pull(aux["nf"],
+                                      what="first non-finite leaf")))
                 logger.warning(
                     "Non-finite loss/grads (%s) at iteration %d — update "
                     "skipped (%d consecutive bad step(s); restore after "
-                    "%d)", loss, neval, state["consecutiveBadSteps"],
-                    max_bad_steps)
+                    "%d)%s", loss, neval, state["consecutiveBadSteps"],
+                    max_bad_steps, culprit)
                 if 0 < max_bad_steps <= state["consecutiveBadSteps"]:
                     raise DivergenceError(
                         f"{state['consecutiveBadSteps']} consecutive "
                         f"non-finite losses (last at iteration {neval}) — "
-                        "restoring the latest valid snapshot")
+                        "restoring the latest valid snapshot"
+                        f"{culprit}")
             else:
                 state["consecutiveBadSteps"] = 0
+            # training-state integrity: classify the fingerprint
+            # verdicts at the configured cadence — cross-replica
+            # disagreement / continuity breaks raise into the retry
+            # loop, healthy verdicts feed the weight-health gates
+            if (integrity is not None and aux is not None
+                    and "cont" in aux and integrity.due(neval)):
+                with telemetry.span("driver/integrity_check"):
+                    integrity.check(aux, neval)
             # step-time decomposition: data-wait / compute / host-pull /
             # bookkeeping measured, the signed residual is unaccounted —
             # the five always sum to the wall interval exactly.  The wall
@@ -1105,7 +1160,9 @@ class Optimizer:
                         self._probe_step_flops(inputs, targets, hyper, rng)
                     t0 = telemetry.clock_ns()
                     with telemetry.span("driver/device_step"):
-                        loss_dev = run_step(inputs, targets, hyper, rng)
+                        out = run_step(inputs, targets, hyper, rng)
+                        loss_dev, step_aux = (
+                            out if isinstance(out, tuple) else (out, None))
                         dispatch_ns = telemetry.clock_ns() - t0
                     if inject_nan:
                         loss_dev = float("nan")
@@ -1118,7 +1175,7 @@ class Optimizer:
                              telemetry.clock_ns() - t_book)
                     pipeline.push(loss_dev, bsz, t0, state["epoch"],
                                   state["recordsProcessedThisEpoch"] + bsz,
-                                  state["neval"], parts)
+                                  state["neval"], parts, step_aux)
 
                 state["recordsProcessedThisEpoch"] += bsz
 
@@ -1450,9 +1507,14 @@ class LocalOptimizer(Optimizer):
         precision = self.precision
         aux_weight = self.moe_aux_weight
         from bigdl_tpu.utils import config
+        from bigdl_tpu import integrity as _integrity
         guard = config.get_bool("bigdl.divergence.guard", True)
+        every_n = config.get_int("bigdl.integrity.everyN", 0)
+        fp_seed = config.get_int("bigdl.integrity.seed",
+                                 _integrity.DEFAULT_SEED)
 
-        def step(params, slots, mstate, inputs, targets, hyper, rng):
+        def _step_core(params, slots, mstate, inputs, targets, hyper, rng,
+                       fpc=None, tick=None):
             def loss_fn(p):
                 out, new_mstate = mixed_precision_forward(
                     model, p, inputs, mstate, precision, True, rng)
@@ -1465,19 +1527,64 @@ class LocalOptimizer(Optimizer):
                 loss_fn, has_aux=True)(params)
             new_params, new_slots = optim.pure_update(grads, params, slots,
                                                       hyper)
+            aux: Dict[str, Any] = {}
+            ok = None
             if guard:
                 # divergence guard: a non-finite loss/grad step keeps
                 # every carry at its pre-step value.  The returned loss is
                 # poisoned to NaN whenever the step was skipped — a
                 # non-finite GRADIENT under a finite loss must still reach
                 # the driver's bad-step counter, or a permanently
-                # overflowing backward would freeze training silently
-                ok = all_finite(loss, grads)
+                # overflowing backward would freeze training silently.
+                # ``nf`` names the first non-finite leaf for the driver's
+                # diagnosed log line / DivergenceError.
+                ok, nf = _integrity.first_nonfinite(loss, grads)
+                aux["nf"] = nf
+            if fpc is not None:
+                # integrity: input fingerprints vs the previous step's
+                # output carry — state that changed outside the fused
+                # step is silent corruption; the verdict joins the
+                # update-skip guard so a corrupt run FREEZES (restorable)
+                # instead of training on rotten weights
+                fp_p_in = _integrity.fingerprint_tree(params, fp_seed)
+                fp_s_in = _integrity.fingerprint_tree(
+                    slots, fp_seed + _integrity.SLOT_SEED_OFF)
+                cont_ok, latch, bad_iter = _integrity.continuity_check(
+                    fpc, fp_p_in, fp_s_in, tick)
+                intact = latch == 0
+                ok = intact if ok is None else jnp.logical_and(ok, intact)
+            if ok is not None and ok is not True:
                 new_params = select_tree(ok, new_params, params)
                 new_slots = select_tree(ok, new_slots, slots)
                 new_mstate = select_tree(ok, new_mstate, mstate)
-                loss = jnp.where(ok, loss, jnp.nan)
-            return new_params, new_slots, new_mstate, loss
+            if guard:
+                loss = jnp.where(aux["nf"] == _integrity.NF_SENTINEL,
+                                 loss, jnp.nan)
+            if fpc is not None:
+                fp_p_out = _integrity.fingerprint_tree(new_params, fp_seed)
+                fp_s_out = _integrity.fingerprint_tree(
+                    new_slots, fp_seed + _integrity.SLOT_SEED_OFF)
+                fp_g = _integrity.fingerprint_tree(
+                    grads, fp_seed + _integrity.GRAD_SEED_OFF)
+                aux.update(
+                    cont=latch, bad_iter=bad_iter, fp_p=fp_p_out,
+                    fp_s=fp_s_out, fp_g=fp_g,
+                    pn=_integrity.sq_norm(new_params),
+                    un=_integrity.sq_norm_diff(new_params, params),
+                    gn=_integrity.sq_norm(grads),
+                    fpc=_integrity.pack_carry(latch, bad_iter, fp_p_out,
+                                              fp_s_out))
+            return new_params, new_slots, new_mstate, loss, aux
+
+        if every_n > 0:
+            def step(params, slots, mstate, inputs, targets, hyper, rng,
+                     fpc, tick):
+                return _step_core(params, slots, mstate, inputs, targets,
+                                  hyper, rng, fpc, tick)
+        else:
+            def step(params, slots, mstate, inputs, targets, hyper, rng):
+                return _step_core(params, slots, mstate, inputs, targets,
+                                  hyper, rng)
 
         from bigdl_tpu.analysis import program_contracts
         from bigdl_tpu.utils import compile_cache
@@ -1529,6 +1636,28 @@ class LocalOptimizer(Optimizer):
         if self._step_fn is None:
             self._step_fn = self._arm_retrace(self._build_step(), "local")
 
+        from bigdl_tpu.utils import config as _config
+        from bigdl_tpu import integrity as _integrity
+        feval = getattr(self.optim_method, "requires_feval", False)
+        guard = _config.get_bool("bigdl.divergence.guard", True)
+        every_n = 0 if feval else _config.get_int(
+            "bigdl.integrity.everyN", 0)
+        integ = None
+        if not feval and (guard or every_n > 0):
+            integ = _integrity.DriverIntegrity(
+                "local",
+                _integrity.nonfinite_names(
+                    ("loss", 0.0), ("grad", carry["params"])),
+                every_n=every_n,
+                health=_integrity.WeightHealthMonitor(
+                    _config.get_float("bigdl.integrity.healthFactor", 0.0),
+                    warmup=_config.get_int(
+                        "bigdl.integrity.healthWarmup", 5),
+                    cooldown=_config.get_int(
+                        "bigdl.integrity.healthCooldown", 50)))
+        if every_n > 0:
+            carry["fpc"] = jnp.asarray(_integrity.init_carry())
+
         it = {"data": None}
 
         def reset_epoch():
@@ -1541,17 +1670,38 @@ class LocalOptimizer(Optimizer):
                     _to_device(batch.get_target()), batch.size())
 
         def run_step(inputs, targets, hyper, rng):
+            flip = _chaos.take_bitflip() if _chaos.active() else None
+            if flip is not None:
+                # injected SDC: one mantissa bit of a live parameter
+                # flips between steps — all_finite cannot see it; the
+                # continuity fingerprint must
+                carry["params"] = _integrity.bitflip_tree(
+                    carry["params"], flip)
+            args = [carry["params"], carry["slots"], carry["mstate"],
+                    inputs, targets, hyper, rng]
+            if every_n > 0:
+                tick = self.optim_method.state.get("evalCounter", 0) + 1
+                args += [carry["fpc"], np.int32(tick)]
+            out = self._step_fn(*args)
+            if len(out) == 5:
+                (carry["params"], carry["slots"], carry["mstate"],
+                 loss, aux) = out
+                if "fpc" in aux:
+                    carry["fpc"] = aux["fpc"]
+                return loss, aux
             (carry["params"], carry["slots"], carry["mstate"],
-             loss) = self._step_fn(carry["params"], carry["slots"],
-                                   carry["mstate"], inputs, targets,
-                                   hyper, rng)
+             loss) = out
             return loss
 
         # telemetry MFU probe: the fused step's full argument tuple, for
         # the one-shot cost_analysis lowering (bigdl.telemetry.mfu)
-        self._cost_args_fn = lambda inputs, targets, hyper, rng: (
-            carry["params"], carry["slots"], carry["mstate"], inputs,
-            targets, hyper, rng)
+        def _cost_args(inputs, targets, hyper, rng):
+            args = (carry["params"], carry["slots"], carry["mstate"],
+                    inputs, targets, hyper, rng)
+            if every_n > 0:
+                args += (carry["fpc"], np.int32(1))
+            return args
+        self._cost_args_fn = _cost_args
 
         def publish():
             self._publish(carry["params"], carry["slots"], carry["mstate"])
@@ -1559,7 +1709,8 @@ class LocalOptimizer(Optimizer):
         self._sync_dataset_epoch()
         reset_epoch()
         self._drive(fetch_batch, run_step, reset_epoch, publish,
-                    epoch_size=_epoch_records(self.dataset))
+                    epoch_size=_epoch_records(self.dataset),
+                    integrity=integ)
         return model
 
 

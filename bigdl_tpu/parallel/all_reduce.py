@@ -214,6 +214,14 @@ def ring_permute(x, axis: str, perm):
     return lax.ppermute(x, axis, perm)
 
 
+def gather_fingerprints(fp, axis: str):
+    """all_gather (tiled=False) over ``axis`` → every replica receives
+    the full (n_replicas, k) table of per-replica integrity fingerprints
+    — the cross-replica agreement verdict is then computable locally on
+    each replica with no further collective."""
+    return lax.all_gather(fp, axis, tiled=False)
+
+
 def pmean_floats(tree, axis: str):
     """Average float leaves across the axis (keeps BatchNorm running
     stats consistent between replicas); non-float leaves pass through

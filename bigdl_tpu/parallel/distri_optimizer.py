@@ -58,6 +58,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from bigdl_tpu import integrity as _integrity
 from bigdl_tpu.engine import Engine
 from bigdl_tpu.dataset.dataset import ShardedDataSet
 from bigdl_tpu.nn.module import Criterion, Module
@@ -66,7 +67,9 @@ from bigdl_tpu.optim.optimizer import (Optimizer, all_finite,
                                        moe_aux_penalty,
                                        regularization_penalty, select_tree)
 from bigdl_tpu.parallel.all_reduce import (AllReduceParameter, axis_mean,
-                                           axis_min, axis_sum, pmean_floats)
+                                           axis_min, axis_sum,
+                                           gather_fingerprints, pmean_floats)
+from bigdl_tpu.utils import chaos as _chaos
 
 logger = logging.getLogger("bigdl_tpu")
 
@@ -267,6 +270,14 @@ class DistriOptimizer(Optimizer):
         aux_weight = self.moe_aux_weight
         from bigdl_tpu.utils import config
         guard = config.get_bool("bigdl.divergence.guard", True)
+        every_n = config.get_int("bigdl.integrity.everyN", 0)
+        fp_seed = config.get_int("bigdl.integrity.seed",
+                                 _integrity.DEFAULT_SEED)
+        # chaos: in-step replica desync — at tick ``desync_at`` replica
+        # ``desync_rep``'s updated parameter copy drifts AFTER the update
+        # and BEFORE the output fingerprint (build-time constants; (0, 0)
+        # = disarmed, and tick 0 never occurs)
+        desync_at, desync_rep = _chaos.desync_replica()
         # audit fault injection: duplicate the weight all-gather so the
         # step's program breaks its declared all-gather op ceiling
         extra_ag = config.get_bool("bigdl.chaos.extraAllGather", False)
@@ -294,7 +305,8 @@ class DistriOptimizer(Optimizer):
             help="per-step collective buckets (1 = monolithic schedule)"
         ).set(float(len(edges)))
 
-        def shard_step(flat_params, slots, mstate, inputs, targets, hyper, rng):
+        def shard_step(flat_params, slots, mstate, inputs, targets, hyper,
+                       rng, fpc=None, tick=None):
             # distinct dropout masks per shard, like the reference's
             # independently-seeded model replicas
             rng = jax.random.fold_in(rng, lax.axis_index(axis))
@@ -323,6 +335,35 @@ class DistriOptimizer(Optimizer):
                 # expert shards saw disjoint tokens AND ran disjoint expert
                 # blocks: contributions sum over the axis
                 flat_grads = axis_sum(flat_grads, expert_axis)
+            aux = {}
+            intact = None
+            if fpc is not None:
+                # training-state integrity, per replica: fingerprint the
+                # INPUT copies — each device hashes its OWN HBM copy of
+                # the replicated parameter vector and its own ZeRO-1 slot
+                # shard — all-gather the parameter fingerprints into the
+                # agreement table, and check continuity against this
+                # replica's carry row from the previous step.  The
+                # combined verdict latches and freezes the update below,
+                # so a corrupted replica can never contaminate healthy
+                # state: the run freezes (restorable/healable) instead of
+                # training on rotten weights.
+                fpc_row = fpc[0]
+                fp_p_in = _integrity.fingerprint_flat(flat_params, fp_seed)
+                fp_s_in = _integrity.fingerprint_tree(
+                    slots, fp_seed + _integrity.SLOT_SEED_OFF)
+                fps_table = gather_fingerprints(fp_p_in, axis)
+                agree_ok = jnp.all(fps_table == fps_table[0])
+                cont_ok, latch, bad_iter = _integrity.continuity_check(
+                    fpc_row, fp_p_in, fp_s_in, tick, extra_ok=agree_ok)
+                # the freeze verdict must be GLOBAL (pmin over every mesh
+                # axis): one latched replica freezing alone would
+                # silently fork the model
+                intact = axis_min((latch == 0).astype(jnp.int32), axis)
+                for extra in (seq_axis, expert_axis):
+                    if extra:
+                        intact = axis_min(intact, extra)
+                intact = intact.astype(bool)
             if overlap:
                 # bucketed schedule: the padded flat vector viewed as an
                 # (n_shards, shard_size) matrix, each bucket a contiguous
@@ -352,18 +393,27 @@ class DistriOptimizer(Optimizer):
                     grad_b.append(g_k)
                     new_p.append(p_k)
                     new_s.append(ns_k)
+                okf = None
                 if guard:
                     # the verdict stays GLOBAL over the whole vector: all
                     # buckets' gradients feed one pmin (the one sync point
-                    # the baseline schedule has too)
-                    ok = all_finite(loss)
-                    for g_k in grad_b:
-                        ok = jnp.logical_and(ok, all_finite(g_k))
-                    ok = axis_min(ok.astype(jnp.int32), axis)
+                    # the baseline schedule has too).  The pmin is widened
+                    # to a stacked [ok, nf] pair so the first-non-finite
+                    # leaf index rides the same collective for the
+                    # driver's diagnosed divergence line.
+                    okf, nf = _integrity.first_nonfinite(loss, *grad_b)
+                    verdict = axis_min(
+                        jnp.stack([okf.astype(jnp.int32), nf]), axis)
                     for extra in (seq_axis, expert_axis):
                         if extra:
-                            ok = axis_min(ok, extra)
-                    ok = ok.astype(bool)
+                            verdict = axis_min(verdict, extra)
+                    okf, nf = verdict[0].astype(bool), verdict[1]
+                    aux["nf"] = nf
+                ok = okf
+                if intact is not None:
+                    ok = (intact if ok is None
+                          else jnp.logical_and(ok, intact))
+                if ok is not None:
                     new_p = [select_tree(ok, p_k, param_row[a:b])
                              for p_k, (a, b) in zip(new_p, edges)]
                     new_s = [select_tree(
@@ -372,7 +422,12 @@ class DistriOptimizer(Optimizer):
                                      lambda v, a=a, b=b: v[a:b], slots))
                              for s_k, (a, b) in zip(new_s, edges)]
                     new_mstate = select_tree(ok, new_mstate, mstate)
-                    loss = jnp.where(ok, loss, jnp.nan)
+                if guard:
+                    # only the FINITENESS verdict poisons the loss (an
+                    # integrity freeze reports through aux, not NaN)
+                    loss = jnp.where(okf, loss, jnp.nan)
+                if fpc is not None:
+                    g_sq = _integrity.sq_norm(grad_b)
                 # per-bucket gathers: each depends only on its own
                 # bucket's selected shard (plus the shared verdict)
                 blocks = [arp.all_gather_bucket(p_k, axis) for p_k in new_p]
@@ -393,26 +448,40 @@ class DistriOptimizer(Optimizer):
                 param_shard = arp.local_shard(flat_params, axis)
                 new_shard, new_slots = optim.pure_update(
                     grad_shard, param_shard, slots, hyper)
+                okf = None
                 if guard:
                     # divergence guard: non-finite loss/grad → every shard
                     # keeps its pre-step slice.  The verdict must be GLOBAL
                     # (pmin over the data axis): each device only sees 1/N
                     # of the gradient vector, and replicas applying
-                    # different verdicts would silently fork the model
-                    ok = jnp.logical_and(all_finite(loss),
-                                         all_finite(grad_shard))
-                    ok = axis_min(ok.astype(jnp.int32), axis)
+                    # different verdicts would silently fork the model.
+                    # The pmin is widened to a stacked [ok, nf] pair so
+                    # the first-non-finite leaf index rides the same
+                    # collective for the diagnosed divergence line.
+                    okf, nf = _integrity.first_nonfinite(loss, grad_shard)
+                    verdict = axis_min(
+                        jnp.stack([okf.astype(jnp.int32), nf]), axis)
                     for extra in (seq_axis, expert_axis):
                         if extra:   # seq/expert replicas must agree too
-                            ok = axis_min(ok, extra)
-                    ok = ok.astype(bool)
+                            verdict = axis_min(verdict, extra)
+                    okf, nf = verdict[0].astype(bool), verdict[1]
+                    aux["nf"] = nf
+                ok = okf
+                if intact is not None:
+                    ok = (intact if ok is None
+                          else jnp.logical_and(ok, intact))
+                if ok is not None:
                     new_shard = select_tree(ok, new_shard, param_shard)
                     new_slots = select_tree(ok, new_slots, slots)
                     new_mstate = select_tree(ok, new_mstate, mstate)
+                if guard:
                     # a skipped step must report non-finite to the
                     # driver's bad-step counter even when only the GRADS
-                    # overflowed
-                    loss = jnp.where(ok, loss, jnp.nan)
+                    # overflowed; an integrity freeze does NOT poison the
+                    # loss — its verdict reaches the driver through aux
+                    loss = jnp.where(okf, loss, jnp.nan)
+                if fpc is not None:
+                    g_sq = _integrity.sq_norm(grad_shard)
                 # all-gather the updated weights for the next forward
                 new_flat = arp.all_gather_weights(new_shard, axis)
                 if extra_ag:
@@ -423,12 +492,62 @@ class DistriOptimizer(Optimizer):
                                 + arp.all_gather_weights(new_shard,
                                                          axis)) / 2
 
+            if fpc is not None:
+                # a frozen step must keep each replica's INPUT copy
+                # bit-for-bit: the all-gather above rebuilds every copy
+                # from per-shard contributions, which would wash a
+                # diverged copy back into agreement (or spread its
+                # corrupted rows to every replica) and destroy the
+                # evidence the heal's majority vote needs
+                if ok is not None:
+                    new_flat = select_tree(ok, new_flat, flat_params)
+                if desync_at:
+                    # chaos: the injected replica stays SELF-consistent
+                    # (its output fingerprint hashes the drifted copy),
+                    # so only the next step's agreement table can see it
+                    inj = jnp.logical_and(
+                        jnp.asarray(tick) == desync_at,
+                        lax.axis_index(axis) == desync_rep)
+                    new_flat = new_flat.at[0].add(
+                        jnp.where(inj, jnp.asarray(1.0, new_flat.dtype),
+                                  jnp.asarray(0.0, new_flat.dtype)))
+                fp_p_out = _integrity.fingerprint_flat(new_flat, fp_seed)
+                fp_s_out = _integrity.fingerprint_tree(
+                    new_slots, fp_seed + _integrity.SLOT_SEED_OFF)
+                accd = _integrity.acc_dtype()
+                new_row = arp.local_shard(new_flat, axis)
+                old_row = arp.local_shard(flat_params, axis)
+                pb = jnp.stack([
+                    jnp.sum(jnp.square(new_row[a:b].astype(accd)))
+                    for a, b in edges])
+                ub = jnp.stack([
+                    jnp.sum(jnp.square(new_row[a:b].astype(accd)
+                                       - old_row[a:b].astype(accd)))
+                    for a, b in edges])
+                # ONE psum carries every diagnostic scalar — per-bucket
+                # param/update norms plus the gradient norm (the shards
+                # partition the vector, so the axis sum IS the global
+                # square norm)
+                nb = len(edges)
+                stats = axis_sum(
+                    jnp.concatenate([pb, ub, g_sq[None]]), axis)
+                aux.update(
+                    cont=jnp.logical_not(intact).astype(jnp.int32),
+                    bad_iter=-axis_min(-bad_iter, axis),
+                    fps_all=fps_table,
+                    pn=jnp.sum(stats[:nb]), un=jnp.sum(stats[nb:2 * nb]),
+                    gn=stats[2 * nb], pb=stats[:nb],
+                    ub=stats[nb:2 * nb],
+                    fpc=_integrity.pack_carry(latch, bad_iter, fp_p_out,
+                                              fp_s_out)[None, :])
             loss = axis_mean(loss, axis)
             new_mstate = pmean_floats(new_mstate, axis)
             for extra in (seq_axis, expert_axis):
                 if extra:
                     loss = axis_mean(loss, extra)
                     new_mstate = pmean_floats(new_mstate, extra)
+            if guard or every_n > 0:
+                return new_flat, new_slots, new_mstate, loss, aux
             return new_flat, new_slots, new_mstate, loss
 
         pspec_rep = P()
@@ -439,15 +558,35 @@ class DistriOptimizer(Optimizer):
         # slots are sharded over the data axis only (ZeRO-1); replicated
         # across seq/expert shards
         pspec_slots = P(axis)
+        in_specs = (pspec_rep,                          # flat params
+                    pspec_slots,                        # slot shards
+                    pspec_rep,                          # module state
+                    pspec_batch, pspec_batch,           # inputs, targets
+                    pspec_rep, pspec_rep)               # hyper, rng
+        # the diagnostics aux rides replicated (the verdicts and the
+        # gathered fingerprint table are identical on every device after
+        # their reductions); the integrity carry keeps one row per data
+        # replica
+        aux_specs = {}
+        if guard:
+            aux_specs["nf"] = pspec_rep
+        if every_n > 0:
+            in_specs += (pspec_slots, pspec_rep)        # fpc rows, tick
+            aux_specs.update(
+                cont=pspec_rep, bad_iter=pspec_rep, fps_all=pspec_rep,
+                pn=pspec_rep, un=pspec_rep, gn=pspec_rep, pb=pspec_rep,
+                ub=pspec_rep, fpc=pspec_slots)
+        out_specs = (pspec_rep, pspec_slots, pspec_rep, pspec_rep)
+        if aux_specs:
+            out_specs += (aux_specs,)
         sharded = shard_map(
             shard_step, mesh=mesh,
-            in_specs=(pspec_rep,                          # flat params
-                      pspec_slots,                        # slot shards
-                      pspec_rep,                          # module state
-                      pspec_batch, pspec_batch,           # inputs, targets
-                      pspec_rep, pspec_rep),              # hyper, rng
-            out_specs=(pspec_rep, pspec_slots, pspec_rep, pspec_rep),
+            in_specs=in_specs, out_specs=out_specs,
             check_rep=False)
+        # verdict index space of the widened guard pmin, for the
+        # driver's diagnosed divergence suffix
+        self._nf_names = (["loss"]
+                          + [f"grad:flat[{a}:{b})" for a, b in edges])
         from bigdl_tpu.analysis import program_contracts
         from bigdl_tpu.utils import compile_cache
         # byte budgets from the live model: the padded flat parameter
@@ -463,7 +602,7 @@ class DistriOptimizer(Optimizer):
         contract = program_contracts.shard_map_contract(
             precision, param_bytes, state_bytes,
             seq_axis=bool(seq_axis), expert_axis=bool(expert_axis),
-            n_buckets=len(edges))
+            n_buckets=len(edges), integrity=every_n > 0)
         return compile_cache.tracked_jit(sharded, label="shard_map",
                                          topology=self._topology_meta(),
                                          contract=contract,
@@ -535,6 +674,28 @@ class DistriOptimizer(Optimizer):
             self._step_fn = self._arm_retrace(self._build_step(arp),
                                               "shard_map")
 
+        from bigdl_tpu.utils import config as _config
+        guard = _config.get_bool("bigdl.divergence.guard", True)
+        every_n = _config.get_int("bigdl.integrity.everyN", 0)
+        integ = None
+        if guard or every_n > 0:
+            integ = _integrity.DriverIntegrity(
+                "shard_map",
+                getattr(self, "_nf_names", ["loss", "grad:flat"]),
+                every_n=every_n,
+                health=_integrity.WeightHealthMonitor(
+                    _config.get_float("bigdl.integrity.healthFactor", 0.0),
+                    warmup=_config.get_int(
+                        "bigdl.integrity.healthWarmup", 5),
+                    cooldown=_config.get_int(
+                        "bigdl.integrity.healthCooldown", 50)))
+        if every_n > 0:
+            # one carry row per data replica (seen/latch/bad_iter + the
+            # previous step's params/slots output fingerprints)
+            carry["fpc"] = jax.device_put(
+                np.stack([_integrity.init_carry()] * axis_size),
+                NamedSharding(mesh, P("data")))
+
         # batch dim co-shards over expert when present (tokens follow the
         # all_to_all dispatch axis); time (dim 1) over seq
         dim0 = ("data", "expert") if self.expert_axis else "data"
@@ -593,17 +754,38 @@ class DistriOptimizer(Optimizer):
                                  expert_chunks=expert_chunks, check=_check)
 
         def run_step(inputs, targets, hyper, rng):
-            (carry["flat"], carry["slots"], carry["mstate"],
-             loss) = self._step_fn(carry["flat"], carry["slots"],
-                                   carry["mstate"], inputs, targets,
-                                   hyper, rng)
+            flip = _chaos.take_bitflip() if _chaos.active() else None
+            if flip is not None:
+                # injected SDC: one replica's HBM copy of the replicated
+                # parameter vector flips a mid-mantissa bit between steps
+                # — the logical array still looks healthy and every value
+                # stays finite; only fingerprint agreement can see it
+                carry["flat"] = _integrity.bitflip_one_replica(
+                    carry["flat"], flip)
+            args = [carry["flat"], carry["slots"], carry["mstate"],
+                    inputs, targets, hyper, rng]
+            if every_n > 0:
+                tick = self.optim_method.state.get("evalCounter", 0) + 1
+                args += [carry["fpc"], np.int32(tick)]
+            out = self._step_fn(*args)
+            if len(out) == 5:
+                (carry["flat"], carry["slots"], carry["mstate"],
+                 loss, aux) = out
+                if "fpc" in aux:
+                    carry["fpc"] = aux["fpc"]
+                return loss, aux
+            (carry["flat"], carry["slots"], carry["mstate"], loss) = out
             return loss
 
         # telemetry MFU probe (bigdl.telemetry.mfu): the fused sharded
         # step's argument tuple for the one-shot cost_analysis lowering
-        self._cost_args_fn = lambda inputs, targets, hyper, rng: (
-            carry["flat"], carry["slots"], carry["mstate"], inputs,
-            targets, hyper, rng)
+        def _cost_args(inputs, targets, hyper, rng):
+            args = (carry["flat"], carry["slots"], carry["mstate"],
+                    inputs, targets, hyper, rng)
+            if every_n > 0:
+                args += (carry["fpc"], np.int32(1))
+            return args
+        self._cost_args_fn = _cost_args
 
         def publish():
             # slots leave the device in the same per-parameter pytree format
@@ -633,9 +815,62 @@ class DistriOptimizer(Optimizer):
 
         self._sync_dataset_epoch()
         reset_epoch()
-        self._drive(fetch_batch, run_step, reset_epoch, publish,
-                    epoch_size=self.dataset.size())
+        try:
+            self._drive(fetch_batch, run_step, reset_epoch, publish,
+                        epoch_size=self.dataset.size(), integrity=integ)
+        except _integrity.ReplicaDesyncError as e:
+            # heal in place from the agreeing majority, then re-raise:
+            # the retry loop sees ``healed`` and re-enters training
+            # without a checkpoint restore
+            self._heal_desync(e, carry, mesh)
+            raise
         return model
+
+    def _heal_desync(self, err, carry, mesh) -> None:
+        """Self-heal a data-parallel replica desync: re-broadcast the
+        agreeing majority's parameter copy as the canonical state,
+        rewind the eval counter to just before the first frozen tick
+        (the corrupted replica applied no updates — the in-step verdict
+        froze every replica the moment the copies diverged, so the
+        majority copy IS the last healthy state), publish, and mark the
+        error healed.  The ZeRO-1 slot shards never diverged (each
+        device owns disjoint rows, verified per-shard by continuity) and
+        are re-placed for the mesh via ``elastic.place_slots`` on
+        re-entry."""
+        import time
+        from bigdl_tpu import telemetry
+        from bigdl_tpu.analysis.hostsync import host_pull
+        t0 = time.monotonic()
+        minority, _ = _integrity.replicated_shard_disagreement(
+            carry["flat"], what="desync heal majority vote")
+        shards = sorted(carry["flat"].addressable_shards,
+                        key=lambda s: s.device.id)
+        major = next(i for i in range(len(shards)) if i not in minority)
+        canonical = np.asarray(host_pull(
+            shards[major].data, what="desync heal canonical copy"))
+        carry["flat"] = jax.device_put(canonical,
+                                       NamedSharding(mesh, P()))
+        self.optim_method.state["evalCounter"] = max(err.iteration - 1, 0)
+        # publish the healed canonical state: re-entry rebuilds the
+        # device carries (and a fresh integrity carry) from the shells
+        self._publish(self._arp.unflatten(carry["flat"]),
+                      jax.tree_util.tree_map(self._arp.unflatten,
+                                             carry["slots"]),
+                      carry["mstate"])
+        # re-entry re-partitions the canonical slots for the mesh — time
+        # it as the elastic reshard it is
+        self._elastic_resumed = True
+        telemetry.gauge(
+            "Integrity/heal_ms",
+            help="detection-to-heal latency of the last integrity fault "
+                 "(restore or re-broadcast)").set(
+            (time.monotonic() - t0) * 1000.0)
+        logger.warning(
+            "Healed replica desync at iteration %d: re-broadcast the "
+            "majority copy over minority replica(s) %s and rewound to "
+            "iteration %d", err.iteration, err.replicas,
+            max(err.iteration - 1, 0))
+        err.healed = True
 
     def _wire_expert_parallel(self, module) -> None:
         """Point every MixtureOfExperts at the mesh's ``expert`` axis
@@ -700,6 +935,26 @@ class DistriOptimizer(Optimizer):
         self.optim_method.set_slots(carry["slots"])
         self.optim_method.state.setdefault("epoch", 1)
 
+        from bigdl_tpu.utils import config as _config
+        guard = _config.get_bool("bigdl.divergence.guard", True)
+        every_n = _config.get_int("bigdl.integrity.everyN", 0)
+        integ = None
+        if guard or every_n > 0:
+            integ = _integrity.DriverIntegrity(
+                "gspmd",
+                _integrity.nonfinite_names(
+                    ("loss", 0.0), ("grad", carry["params"])),
+                every_n=every_n,
+                health=_integrity.WeightHealthMonitor(
+                    _config.get_float("bigdl.integrity.healthFactor", 0.0),
+                    warmup=_config.get_int(
+                        "bigdl.integrity.healthWarmup", 5),
+                    cooldown=_config.get_int(
+                        "bigdl.integrity.healthCooldown", 50)))
+        if every_n > 0:
+            carry["fpc"] = jax.device_put(
+                jnp.asarray(_integrity.init_carry()), rep)
+
         if self._step_fn is None:
             # pin the step's output shardings: params come back in their tp
             # placement (replicated over 'data' — XLA schedules the ZeRO
@@ -710,9 +965,13 @@ class DistriOptimizer(Optimizer):
             slot_sh = self._map_over_slots(
                 lambda x, s: NamedSharding(mesh, s), carry["slots"],
                 slot_specs)
+            out_sh = (param_sh, slot_sh, rep, rep)
+            if guard or every_n > 0:
+                # the 5th output is the small aux diagnostics dict —
+                # replicated (a prefix sharding covers every entry)
+                out_sh += (rep,)
             self._step_fn = self._arm_retrace(
-                self._build_gspmd_step(
-                    out_shardings=(param_sh, slot_sh, rep, rep)),
+                self._build_gspmd_step(out_shardings=out_sh),
                 "gspmd")
 
         batch_sharding = NamedSharding(mesh, P("data"))
@@ -729,17 +988,38 @@ class DistriOptimizer(Optimizer):
                                  self.dataset.partition_num)
 
         def run_step(inputs, targets, hyper, rng):
+            flip = _chaos.take_bitflip() if _chaos.active() else None
+            if flip is not None:
+                # injected SDC: one mantissa bit of a live (sharded)
+                # parameter leaf flips between steps — every value stays
+                # finite; only the continuity fingerprint can see it
+                carry["params"] = _integrity.bitflip_tree(
+                    carry["params"], flip)
+            args = [carry["params"], carry["slots"], carry["mstate"],
+                    inputs, targets, hyper, rng]
+            if every_n > 0:
+                tick = self.optim_method.state.get("evalCounter", 0) + 1
+                args += [carry["fpc"], np.int32(tick)]
+            out = self._step_fn(*args)
+            if len(out) == 5:
+                (carry["params"], carry["slots"], carry["mstate"],
+                 loss, aux) = out
+                if "fpc" in aux:
+                    carry["fpc"] = aux["fpc"]
+                return loss, aux
             (carry["params"], carry["slots"], carry["mstate"],
-             loss) = self._step_fn(carry["params"], carry["slots"],
-                                   carry["mstate"], inputs, targets,
-                                   hyper, rng)
+             loss) = out
             return loss
 
         # telemetry MFU probe (bigdl.telemetry.mfu): the GSPMD step's
         # argument tuple for the one-shot cost_analysis lowering
-        self._cost_args_fn = lambda inputs, targets, hyper, rng: (
-            carry["params"], carry["slots"], carry["mstate"], inputs,
-            targets, hyper, rng)
+        def _cost_args(inputs, targets, hyper, rng):
+            args = (carry["params"], carry["slots"], carry["mstate"],
+                    inputs, targets, hyper, rng)
+            if every_n > 0:
+                args += (carry["fpc"], np.int32(1))
+            return args
+        self._cost_args_fn = _cost_args
 
         from bigdl_tpu.parallel.all_reduce import (gather_to_host,
                                                    replicate_tree)
@@ -764,7 +1044,7 @@ class DistriOptimizer(Optimizer):
         self._sync_dataset_epoch()
         reset_epoch()
         self._drive(fetch_batch, run_step, reset_epoch, publish,
-                    epoch_size=self.dataset.size())
+                    epoch_size=self.dataset.size(), integrity=integ)
         return model
 
     def _map_over_slots(self, fn, slots, per_param_tree):
@@ -777,6 +1057,9 @@ class DistriOptimizer(Optimizer):
         aux_weight = self.moe_aux_weight
         from bigdl_tpu.utils import config
         guard = config.get_bool("bigdl.divergence.guard", True)
+        every_n = config.get_int("bigdl.integrity.everyN", 0)
+        fp_seed = config.get_int("bigdl.integrity.seed",
+                                 _integrity.DEFAULT_SEED)
         # GSPMD overlap: the collectives here are partitioner-inserted,
         # so bucketing means partitioning the PARAMETER LEAVES into ~N
         # contiguous size-balanced groups and running each group's
@@ -798,7 +1081,8 @@ class DistriOptimizer(Optimizer):
             help="per-step collective buckets (1 = monolithic schedule)"
         ).set(float(len(groups) if groups else 1))
 
-        def step(params, slots, mstate, inputs, targets, hyper, rng):
+        def step(params, slots, mstate, inputs, targets, hyper, rng,
+                 fpc=None, tick=None):
             def loss_fn(p):
                 out, new_mstate = mixed_precision_forward(
                     model, p, inputs, mstate, precision, True, rng)
@@ -815,17 +1099,53 @@ class DistriOptimizer(Optimizer):
             else:
                 new_params, new_slots = optim.pure_update(grads, params,
                                                           slots, hyper)
+            aux = {}
+            ok = None
             if guard:
                 # divergence guard (logically-global arrays: XLA's
                 # partitioner makes the finiteness verdict consistent
-                # across every shard without explicit collectives)
-                ok = all_finite(loss, grads)
+                # across every shard without explicit collectives); ``nf``
+                # names the first non-finite leaf for the driver's
+                # diagnosed divergence line
+                ok, nf = _integrity.first_nonfinite(loss, grads)
+                aux["nf"] = nf
+            if fpc is not None:
+                # training-state integrity: the fingerprints are LOGICAL
+                # values — the partitioner reduces across shards without
+                # explicit collectives, so the traced program stays
+                # collective-free (the gspmd contract is unchanged) and
+                # cross-copy agreement is verified driver-side by
+                # bitwise-comparing the replicated output's device copies
+                fp_p_in = _integrity.fingerprint_tree(params, fp_seed)
+                fp_s_in = _integrity.fingerprint_tree(
+                    slots, fp_seed + _integrity.SLOT_SEED_OFF)
+                cont_ok, latch, bad_iter = _integrity.continuity_check(
+                    fpc, fp_p_in, fp_s_in, tick)
+                intact = latch == 0
+                ok = intact if ok is None else jnp.logical_and(ok, intact)
+            if ok is not None and ok is not True:
                 new_params = select_tree(ok, new_params, params)
                 new_slots = select_tree(ok, new_slots, slots)
                 new_mstate = select_tree(ok, new_mstate, mstate)
+            if guard:
                 # a skipped step must report non-finite to the driver's
-                # bad-step counter even when only the GRADS overflowed
-                loss = jnp.where(ok, loss, jnp.nan)
+                # bad-step counter even when only the GRADS overflowed;
+                # an integrity freeze does NOT poison the loss
+                loss = jnp.where(aux["nf"] == _integrity.NF_SENTINEL,
+                                 loss, jnp.nan)
+            if fpc is not None:
+                fp_p_out = _integrity.fingerprint_tree(new_params, fp_seed)
+                fp_s_out = _integrity.fingerprint_tree(
+                    new_slots, fp_seed + _integrity.SLOT_SEED_OFF)
+                aux.update(
+                    cont=latch, bad_iter=bad_iter, fp_p=fp_p_out,
+                    pn=_integrity.sq_norm(new_params),
+                    un=_integrity.sq_norm_diff(new_params, params),
+                    gn=_integrity.sq_norm(grads),
+                    fpc=_integrity.pack_carry(latch, bad_iter, fp_p_out,
+                                              fp_s_out))
+            if guard or every_n > 0:
+                return new_params, new_slots, new_mstate, loss, aux
             return new_params, new_slots, new_mstate, loss
 
         from bigdl_tpu.analysis import program_contracts

@@ -108,6 +108,32 @@ identically under pytest, a soak script, or a real cluster rehearsal:
                                 thundering herd the admission control must
                                 reject fast instead of collapsing tail
                                 latency (once per position).
+``bigdl.chaos.bitflipParamAt``  "k" or "k:leaf": at iteration k ONE
+                                mid-mantissa bit of the first element of
+                                float parameter leaf ``leaf`` (default 0)
+                                flips —
+                                finite-preserving silent data corruption
+                                that ``all_finite`` cannot see; only the
+                                integrity fingerprints (continuity or
+                                cross-replica agreement) catch it.  Once
+                                per plan, so the healed replay runs clean.
+``bigdl.chaos.desyncReplicaAt`` "k" or "k:replica": inside the fused step
+                                at iteration k, data-parallel replica
+                                ``replica`` (default 1) perturbs its own
+                                copy of the updated parameters — the
+                                replica stays SELF-consistent (its own
+                                continuity fingerprints match), so only
+                                cross-replica agreement detects the drift.
+                                Traced into the step, gated on the
+                                iteration tick: fires exactly once since a
+                                healed run resumes past iteration k.
+``bigdl.chaos.corruptStateBeforeSaveAt``  k: the k-th checkpoint capture is
+                                corrupted in host RAM AFTER the semantic
+                                fingerprint was computed but BEFORE
+                                serialization — the payload checksums are
+                                taken over the already-corrupt bytes and
+                                verify clean; only the recomputed
+                                fingerprint at restore can refuse it.
 ==============================  =============================================
 
 Counters are process-local and monotonically increasing from
@@ -168,6 +194,12 @@ class _ChaosState:
             config.get_property("bigdl.chaos.hangDispatchAt"))
         self.burst_arrivals_at, self.burst_arrivals_n = _parse_burst(
             config.get_property("bigdl.chaos.burstArrivals"))
+        self.bitflip_at, self.bitflip_leaf = _parse_indexed(
+            config.get_property("bigdl.chaos.bitflipParamAt"), 0)
+        self.desync_at, self.desync_replica = _parse_indexed(
+            config.get_property("bigdl.chaos.desyncReplicaAt"), 1)
+        self.corrupt_save_at = config.get_int(
+            "bigdl.chaos.corruptStateBeforeSaveAt", 0)
         self.writes = 0
         self.steps_failed = 0
         self.steps_seen = 0
@@ -188,6 +220,10 @@ class _ChaosState:
         self.dispatches = 0
         self.dispatch_hangs = 0
         self.bursts_fired: set = set()
+        self.bitflip_due: Optional[int] = None  # leaf index, consume-once
+        self.bitflips = 0
+        self.state_corruptions = 0
+        self.captures = 0
         self._lock = threading.Lock()
 
     # ---- storage-layer hooks -------------------------------------------
@@ -254,8 +290,53 @@ class _ChaosState:
                 # exactly how a recovered-but-overdue step should die
                 import time
                 time.sleep(self.stall_seconds)
+        if self.bitflip_at and neval == self.bitflip_at:
+            with self._lock:
+                if self.bitflips == 0:       # one flip per plan — a healed
+                    self.bitflips = 1        # replay must run clean
+                    self.bitflip_due = self.bitflip_leaf
         lo, hi = self.nan_loss_at
         return bool(lo) and lo <= seen <= hi
+
+    # ---- integrity hooks -----------------------------------------------
+
+    def take_bitflip(self) -> Optional[int]:
+        """Consume the pending bit-flip marked by :meth:`on_step`:
+        returns the float-leaf index to corrupt, or None.  The trainer's
+        run_step applies the flip to live device state through the
+        ``host_pull`` choke point — simulated in-memory SDC."""
+        with self._lock:
+            due, self.bitflip_due = self.bitflip_due, None
+        return due
+
+    def corrupt_state_before_save(self, obj):
+        """Called by the checkpoint manager with each captured state
+        AFTER its semantic fingerprint was computed; the
+        ``corruptStateBeforeSaveAt``-th capture gets one float nudged in
+        a deep copy (the original live state stays clean) — so every
+        payload checksum is taken over already-corrupt bytes and
+        verifies, and only the fingerprint recomputation at restore can
+        refuse the snapshot.  Once per plan."""
+        if not self.corrupt_save_at:
+            return obj
+        with self._lock:
+            self.captures += 1
+            fire = (self.captures == self.corrupt_save_at and
+                    self.state_corruptions == 0)
+            if fire:
+                self.state_corruptions = 1
+        if not fire:
+            return obj
+        # the copy is a pickle round trip, not a deepcopy: the live
+        # graph's leaves may be immutable device arrays, while the
+        # serialized form holds host numpy buffers — the same form the
+        # snapshot stores and the restore-time fingerprint walks
+        import pickle
+        corrupt = pickle.loads(pickle.dumps(obj))
+        flipped = _corrupt_first_float(corrupt)
+        if not flipped:   # nothing float-like found: leave pristine
+            return obj
+        return corrupt
 
     # ---- compile-subsystem hooks ---------------------------------------
 
@@ -493,6 +574,80 @@ def _parse_burst(value) -> Tuple[int, int]:
     return (int(s), 8)
 
 
+def _parse_indexed(value, default_index: int) -> Tuple[int, int]:
+    """``"k"`` -> (k, default); ``"k:i"`` -> (k, i); falsy -> (0, 0)."""
+    if not value:
+        return (0, 0)
+    s = str(value)
+    if ":" in s:
+        k, i = s.split(":", 1)
+        return (int(k), int(i))
+    return (int(s), default_index)
+
+
+def _corrupt_first_float(obj, _seen=None) -> bool:
+    """Nudge the first reachable float array in an object graph (+1.0 —
+    big enough that no fingerprint rounding hides it): mutable numpy
+    buffers are nudged in place; other float arrays (immutable device
+    arrays) are REPLACED inside their parent container with a nudged
+    numpy copy.  True when something was changed."""
+    import numpy as np
+
+    def is_float_arr(x):
+        dt = getattr(x, "dtype", None)
+        if dt is None:
+            return False
+        kind_f = getattr(dt, "kind", "") == "f" or str(dt) in (
+            "bfloat16", "float16")
+        return kind_f and getattr(x, "size", 0)
+
+    def nudge(x):
+        if isinstance(x, np.ndarray):
+            x.reshape(-1)[0] += 1.0
+            return x
+        arr = np.array(x, copy=True)
+        arr.reshape(-1)[0] += 1.0
+        return arr
+
+    if _seen is None:
+        _seen = set()
+        if isinstance(obj, np.ndarray) and is_float_arr(obj):
+            nudge(obj)
+            return True
+    if obj is None or isinstance(obj, (str, bytes, bool, int, float)):
+        return False          # bare floats are immutable — skip, recurse on
+    if id(obj) in _seen:      # containers until a float array turns up
+        return False
+    _seen.add(id(obj))
+    if isinstance(obj, tuple):
+        # immutable container: only in-place numpy members are reachable
+        for v in obj:
+            if isinstance(v, np.ndarray) and is_float_arr(v):
+                nudge(v)
+                return True
+            if _corrupt_first_float(v, _seen):
+                return True
+        return False
+    if isinstance(obj, dict):
+        items, setter = list(obj.items()), obj.__setitem__
+    elif isinstance(obj, list):
+        items, setter = list(enumerate(obj)), obj.__setitem__
+    elif isinstance(getattr(obj, "__dict__", None), dict):
+        d = obj.__dict__
+        items, setter = list(d.items()), d.__setitem__
+    else:
+        return False
+    for k, v in items:
+        if is_float_arr(v):
+            new = nudge(v)
+            if new is not v:
+                setter(k, new)
+            return True
+        if _corrupt_first_float(v, _seen):
+            return True
+    return False
+
+
 def _parse_kill(value) -> Tuple[Optional[str], int]:
     """``"stage"`` -> (stage, 1); ``"stage:k"`` -> (stage, k); falsy ->
     (None, 0)."""
@@ -604,6 +759,35 @@ def kill_stage_thread(stage: str, items: int) -> bool:
     if _state is None:
         return False
     return _state.kill_stage_thread(stage, items)
+
+
+def take_bitflip() -> Optional[int]:
+    """Integrity hook (None when disarmed): the float-leaf index whose
+    first element should get one mantissa bit flipped NOW — marked by
+    ``on_step`` at the ``bitflipParamAt`` iteration, consumed once."""
+    if _state is None:
+        return None
+    return _state.take_bitflip()
+
+
+def desync_replica() -> Tuple[int, int]:
+    """Integrity hook, read at step-BUILD time: ``(iteration, replica)``
+    for the traced in-step desync injection, or ``(0, 0)`` when
+    disarmed.  The step perturbs that replica's updated parameters when
+    its iteration tick matches — once per run, since a healed replay
+    resumes past the iteration."""
+    if _state is None:
+        return (0, 0)
+    return (_state.desync_at, _state.desync_replica)
+
+
+def corrupt_state_before_save(obj):
+    """Checkpoint-capture hook (identity when disarmed): returns the
+    state to serialize — the ``corruptStateBeforeSaveAt``-th capture
+    comes back as a corrupted deep copy whose checksums will verify."""
+    if _state is None:
+        return obj
+    return _state.corrupt_state_before_save(obj)
 
 
 def write_count() -> int:

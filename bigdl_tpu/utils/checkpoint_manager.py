@@ -53,11 +53,16 @@ from bigdl_tpu.visualization.crc32c import crc32c
 logger = logging.getLogger("bigdl_tpu")
 
 #: manifest schema: 2 added the saved-topology record (``topology`` key,
-#: ``utils/elastic.py``); version-1 manifests (and pre-manifest legacy
-#: pairs) stay restorable — same-topology by assumption.  A manifest
-#: from a NEWER release than this reader fails restore with a structured
-#: :class:`SnapshotSchemaError`, never an unpickle crash.
-MANIFEST_VERSION = 2
+#: ``utils/elastic.py``); 3 added per-payload SEMANTIC fingerprints
+#: (``fingerprint`` key per file — ``integrity.host_fingerprint`` over
+#: the live state BEFORE serialization, recomputed at restore so
+#: corruption between compute and pickle — which the payload CRC can NOT
+#: see, being taken over the already-corrupt bytes — refuses the
+#: snapshot).  Version-1/2 manifests (and pre-manifest legacy pairs)
+#: stay restorable — their files simply carry no fingerprint to check.
+#: A manifest from a NEWER release than this reader fails restore with a
+#: structured :class:`SnapshotSchemaError`, never an unpickle crash.
+MANIFEST_VERSION = 3
 
 
 def _native_crc32c():
@@ -126,9 +131,10 @@ class SnapshotSchemaError(RuntimeError):
             "restore it with the release that wrote it")
 
 
-def _capture(model, optim, neval: int) -> Dict[str, bytes]:
+def _capture(model, optim, neval: int
+             ) -> Tuple[Dict[str, bytes], Dict[str, str]]:
     """Serialize the live model/optim into detached byte payloads, on the
-    caller's thread.
+    caller's thread; returns ``(blobs, fingerprints)``.
 
     Two hazards force the capture to be synchronous: (1) the jitted step
     DONATES its carries, so a device array read after the next dispatch
@@ -138,14 +144,35 @@ def _capture(model, optim, neval: int) -> Dict[str, bytes]:
     param trees, ``step_done`` bumps ``state`` counters), so a background
     pickle of the live objects could observe a torn snapshot.  Bytes are
     unambiguously detached; what moves to the writer thread is the part
-    with unbounded latency — checksumming and (possibly remote) IO."""
+    with unbounded latency — checksumming and (possibly remote) IO.
+
+    The semantic fingerprint is taken from the clean serialization of
+    the TRUE state — recomputing it on an unpickled copy, because the
+    restore-time walk sees the pickle-NORMALIZED object graph (shared
+    parameter subtrees come back as per-module copies, ``__setstate__``
+    may rebuild dicts in a different order) and the two fingerprints
+    must be comparable bit-for-bit.  The ``corrupt_state_before_save``
+    chaos hook sits AFTER the fingerprint and re-serializes, modelling
+    in-RAM rot between state capture and write — which the payload CRC
+    is blind to (it checksums the already-corrupt bytes); only the
+    fingerprint recomputation at restore refuses such a snapshot."""
+    from bigdl_tpu.integrity import fingerprint_key, host_fingerprint
+    from bigdl_tpu.utils import chaos
     with telemetry.span("checkpoint/capture", neval=neval):
-        return {
-            f"model.{neval}": pickle.dumps(
-                model, protocol=pickle.HIGHEST_PROTOCOL),
-            f"optimMethod.{neval}": pickle.dumps(
-                optim, protocol=pickle.HIGHEST_PROTOCOL),
-        }
+        blobs: Dict[str, bytes] = {}
+        fps: Dict[str, str] = {}
+        for name, obj in ((f"model.{neval}", model),
+                          (f"optimMethod.{neval}", optim)):
+            data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+            fps[name] = fingerprint_key(
+                host_fingerprint(pickle.loads(data)))
+            if chaos.active():
+                corrupted = chaos.corrupt_state_before_save(obj)
+                if corrupted is not obj:
+                    data = pickle.dumps(
+                        corrupted, protocol=pickle.HIGHEST_PROTOCOL)
+            blobs[name] = data
+        return blobs, fps
 
 
 class _AsyncWriter:
@@ -273,20 +300,22 @@ class CheckpointManager:
         mesh in the manifest so a restore onto a different device count
         can reshard the ZeRO-1 slots — or refuse with the mismatch
         named — instead of discovering the change as a shape error."""
-        blobs = _capture(model, optim, neval)
+        blobs, fps = _capture(model, optim, neval)
         if self._writer is not None:
             self._writer.submit(
-                lambda: self._write_snapshot(blobs, neval, topology))
+                lambda: self._write_snapshot(blobs, neval, topology, fps))
         else:
-            self._write_snapshot(blobs, neval, topology)
+            self._write_snapshot(blobs, neval, topology, fps)
 
     def _write_snapshot(self, blobs: Dict[str, bytes], neval: int,
-                        topology: Optional[Dict[str, Any]] = None) -> None:
+                        topology: Optional[Dict[str, Any]] = None,
+                        fps: Optional[Dict[str, str]] = None) -> None:
         with telemetry.span("checkpoint/write", neval=neval):
-            self._write_snapshot_inner(blobs, neval, topology)
+            self._write_snapshot_inner(blobs, neval, topology, fps)
 
     def _write_snapshot_inner(self, blobs: Dict[str, bytes], neval: int,
-                              topology: Optional[Dict[str, Any]] = None
+                              topology: Optional[Dict[str, Any]] = None,
+                              fps: Optional[Dict[str, str]] = None
                               ) -> None:
         from bigdl_tpu.utils import file_io
         file_io.makedirs(self.path)
@@ -296,6 +325,8 @@ class CheckpointManager:
         for name, data in blobs.items():
             algo, value = payload_checksum(data)
             files[name] = {"checksum": value, "bytes": len(data)}
+            if fps and name in fps:
+                files[name]["fingerprint"] = fps[name]
         manifest = {
             "version": MANIFEST_VERSION,
             "neval": int(neval),
@@ -401,6 +432,28 @@ class CheckpointManager:
                     f"({len(data)} bytes)")
         return data
 
+    def _check_fingerprint(self, name: str, obj: Any,
+                           manifest: Optional[Dict[str, Any]]) -> None:
+        """Semantic verification: recompute the state fingerprint on the
+        UNPICKLED object and compare with the save-time record.  The
+        payload bytes already passed their checksum — a mismatch here
+        means the state rotted BEFORE serialization (the CRC faithfully
+        protects corrupt bytes), so the snapshot is refused and restore
+        walks to the next-older one."""
+        if manifest is None:
+            return
+        expected = manifest["files"].get(name, {}).get("fingerprint")
+        if expected is None:
+            return    # pre-v3 manifest: nothing semantic to check
+        from bigdl_tpu.integrity import fingerprint_key, host_fingerprint
+        got = fingerprint_key(host_fingerprint(obj))
+        if got != expected:
+            raise SnapshotCorruptError(
+                f"{name}: semantic state fingerprint mismatch — payload "
+                f"checksums verify but the save-time fingerprint "
+                f"{expected} does not match the recomputed {got}; the "
+                "state was corrupted in memory before serialization")
+
     def verify(self, n: int, has_manifest: bool,
                deep: bool = False) -> bool:
         """True when snapshot ``n``'s payloads match their manifest.
@@ -427,7 +480,10 @@ class CheckpointManager:
             manifest = self._read_manifest(n)
             for name in (f"model.{n}", f"optimMethod.{n}"):
                 if deep:
-                    self._read_verified(name, manifest)
+                    data = self._read_verified(name, manifest)
+                    if manifest["files"].get(name, {}).get("fingerprint"):
+                        self._check_fingerprint(name, pickle.loads(data),
+                                                manifest)
                 else:
                     sz = file_io.size(file_io.join(self.path, name))
                     if sz != manifest["files"][name]["bytes"]:
@@ -488,8 +544,11 @@ class CheckpointManager:
                         manifest.get("topology"), expected_topology)
                 model = pickle.loads(
                     self._read_verified(f"model.{n}", manifest))
+                self._check_fingerprint(f"model.{n}", model, manifest)
                 optim = pickle.loads(
                     self._read_verified(f"optimMethod.{n}", manifest))
+                self._check_fingerprint(f"optimMethod.{n}", optim,
+                                        manifest)
                 self.last_loaded_manifest = manifest
                 self.last_restore_mode = mode
                 if mode == "reshard":

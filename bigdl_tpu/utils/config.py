@@ -126,6 +126,20 @@ _DEFAULTS: Dict[str, Any] = {
     "bigdl.audit.collectives": "warn",  # collective contract checker
     "bigdl.audit.precision": "warn",    # f64 / f32-in-bf16 drift pass
     "bigdl.audit.memory": "warn",       # peak-buffer + transpose budget pass
+    # training-state integrity (bigdl_tpu/integrity): on-device
+    # fingerprints + cross-replica agreement + weight-health gates.
+    # everyN is the DRIVER pull/verify cadence — when > 0 the fused
+    # steps compute fingerprints every iteration and the driver
+    # classifies them every N iterations; 0 disables the whole path
+    "bigdl.integrity.everyN": 0,
+    "bigdl.integrity.seed": 0x51D0,        # projection-sign seed
+    "bigdl.integrity.healthFactor": 0,     # weight-health gate: > k x EMA; 0 off
+    "bigdl.integrity.healthWarmup": 5,     # EMA warmup observations
+    "bigdl.integrity.healthCooldown": 50,  # observations between fires
+    # integrity fault injection (silent-data-corruption simulators)
+    "bigdl.chaos.bitflipParamAt": None,    # "k" / "k:leaf": flip one param bit
+    "bigdl.chaos.desyncReplicaAt": None,   # "k" / "k:replica": one dp replica drifts
+    "bigdl.chaos.corruptStateBeforeSaveAt": 0,  # k: k-th snapshot capture corrupted
     # audit fault injection: provoke the violations the auditor exists
     # to catch (step-BUILD time, unlike the runtime chaos hooks above)
     "bigdl.chaos.extraAllGather": False,  # redundant all-gather in shard_map

@@ -230,9 +230,10 @@ class TestDivergenceGuard:
         before = jax.tree_util.tree_map(np.asarray, params)
         x = np.full((8, 4), np.nan, np.float32)
         y = np.ones((8,), np.float32)
-        new_params, new_slots, new_mstate, loss = step(
+        new_params, new_slots, new_mstate, loss, aux = step(
             params, slots, mstate, x, y, method.hyper(),
             jax.random.PRNGKey(0))
+        assert int(aux["nf"]) != 0x7FFFFFFF  # guard named the bad leaf
         assert not np.isfinite(float(loss))
         for a, b in zip(jax.tree_util.tree_leaves(before),
                         jax.tree_util.tree_leaves(new_params)):
@@ -256,9 +257,9 @@ class TestDivergenceGuard:
         params, mstate = model.params, model.state
         x = np.full((8, 4), np.nan, np.float32)
         y = np.ones((8,), np.float32)
-        new_params, _, _, loss = step(params, method.slots(params), mstate,
-                                      x, y, method.hyper(),
-                                      jax.random.PRNGKey(0))
+        new_params, _, _, loss, _aux = step(
+            params, method.slots(params), mstate, x, y, method.hyper(),
+            jax.random.PRNGKey(0))
         leaves = [np.asarray(l)
                   for l in jax.tree_util.tree_leaves(new_params)]
         assert any(not np.isfinite(l).all() for l in leaves)

@@ -261,7 +261,7 @@ class TestCapture:
         model, method = _mlp(), _sgd()
         method.state["evalCounter"] = 7
         before = jax.tree_util.tree_map(np.asarray, model.params)
-        blobs = _capture(model, method, 7)
+        blobs, _fps = _capture(model, method, 7)
         # simulate the next publish: wholesale tree replacement + counter
         model.params = jax.tree_util.tree_map(np.zeros_like, model.params)
         method.state["evalCounter"] = 99

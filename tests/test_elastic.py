@@ -258,7 +258,9 @@ class TestManifestSchema:
         import json
         with open(tmp_path / "manifest.2") as f:
             manifest = json.load(f)
-        assert manifest["version"] == 2
+        assert manifest["version"] == 3
+        assert all("fingerprint" in meta
+                   for meta in manifest["files"].values())
         assert manifest["topology"] == {
             "device_count": 4, "axes": {"data": 4},
             "step": "shard_map", "slot_axis": "data"}
