@@ -101,6 +101,18 @@ Rules
     taxonomy + resync), or carry an explicit
     ``# lint: allow(unguarded-io-in-stage-thread)``.
 
+``unaccounted-buffer-in-stage``
+    In the stage/serving files (``dataset/ingest.py``, ``engine.py``,
+    ``bigdl_tpu/serving/``): a batch-scale host allocation —
+    ``np.empty``/``np.zeros``/``bytearray`` sized by a
+    ring/batch/depth-scale expression — in a scope with no
+    resource-governor accounting.  Every bounded buffer these paths own
+    must charge a ``bigdl_tpu.resources.GOVERNOR`` account (via
+    ``account().add``/``item_nbytes``/``check_item``), or the
+    ``Resources/host_bytes`` roll-up and the host-memory budget it
+    enforces under-report by exactly that buffer.  The allowlist stays
+    empty.
+
 ``undeclared-collective``
     In the trainer step-constructor files (``optim/optimizer.py`` /
     ``optim/evaluator.py`` / ``optim/predictor.py`` /
@@ -183,6 +195,20 @@ TRAINER_STEP_FILES = (os.path.join("optim", "optimizer.py"),
 COLLECTIVE_CALLS = {"psum", "psum_scatter", "pmean", "pmin", "pmax",
                     "ppermute", "all_gather", "all_to_all", "pbroadcast"}
 
+#: stage/serving files whose host buffers must be governor-accounted —
+#: a batch-scale allocation invisible to Resources/host_bytes makes the
+#: host-memory budget a lie
+ACCOUNTED_BUFFER_FILES = (os.path.join("dataset", "ingest.py"),
+                          "engine.py")
+#: size expressions built from these name fragments are pipeline-scale
+#: (depth x batch), not scalar temps
+_BUFFER_SCALE = re.compile(
+    r"(batch|ring|depth|maxsize|ahead|queue|window|slot)", re.IGNORECASE)
+BUFFER_CTORS_NP = {"empty", "zeros"}
+#: calls that mark the enclosing scope as governor-accounted
+ACCOUNTING_CALLS = {"account", "item_nbytes", "check_item", "_charge",
+                    "_slot_nbytes"}
+
 _ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([a-z0-9_,\- ]+)\)")
 
 #: every rule the linter can emit — the CLI validates --rule against it
@@ -190,7 +216,8 @@ KNOWN_RULES = frozenset({
     "host-sync-in-hot-path", "raw-clock-in-hot-path",
     "signal-handler-in-hot-path", "jnp-dtype-drop", "untracked-jit",
     "undeclared-collective", "unguarded-io-in-stage-thread",
-    "unbounded-queue-in-serving", "bare-except", "swallowed-exception",
+    "unbounded-queue-in-serving", "unaccounted-buffer-in-stage",
+    "bare-except", "swallowed-exception",
     "blocking-under-lock", "lock-order", "syntax",
 })
 
@@ -559,6 +586,65 @@ def _rule_unbounded_queue(path: str, rel: str, tree: ast.AST) -> List[Finding]:
     return out
 
 
+def _rule_unaccounted_buffer(path: str, rel: str,
+                             tree: ast.AST) -> List[Finding]:
+    """Batch-scale host allocations in stage/serving files whose scope
+    never touches the resource governor: the host-memory budget can only
+    hold if every buffer these paths pin is charged to an account."""
+    if not (any(rel.endswith(t) for t in ACCOUNTED_BUFFER_FILES) or
+            SERVING_SCOPE in rel):
+        return []
+    out: List[Finding] = []
+
+    def _scale_sized(call: ast.Call) -> bool:
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            for n in ast.walk(arg):
+                if isinstance(n, ast.Name) and _BUFFER_SCALE.search(n.id):
+                    return True
+                if (isinstance(n, ast.Attribute) and
+                        _BUFFER_SCALE.search(n.attr)):
+                    return True
+        return False
+
+    def _accounted(scope: ast.AST) -> bool:
+        return any(isinstance(n, ast.Call) and
+                   _call_name(n) in ACCOUNTING_CALLS
+                   for n in ast.walk(scope))
+
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.scopes: List[ast.AST] = [tree]
+
+        def visit_FunctionDef(self, node):
+            self.scopes.append(node)
+            self.generic_visit(node)
+            self.scopes.pop()
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Call(self, node):
+            name = _call_name(node)
+            qual = _qualifier(node)
+            is_buf = ((qual in ("np", "numpy") and
+                       name in BUFFER_CTORS_NP) or
+                      (isinstance(node.func, ast.Name) and
+                       name == "bytearray" and node.args))
+            if (is_buf and _scale_sized(node) and
+                    not _accounted(self.scopes[-1])):
+                out.append(Finding(
+                    rel, node.lineno, "unaccounted-buffer-in-stage",
+                    f"batch-scale {qual + '.' if qual else ''}{name}(...) "
+                    "in a stage/serving file with no resource-governor "
+                    "accounting in scope — charge it to a "
+                    "bigdl_tpu.resources.GOVERNOR account (account().add "
+                    "/ item_nbytes / check_item) so Resources/host_bytes "
+                    "and the host-memory budget see it"))
+            self.generic_visit(node)
+
+    V().visit(tree)
+    return out
+
+
 def _handler_swallows(handler: ast.ExceptHandler) -> bool:
     body = [n for n in handler.body
             if not (isinstance(n, ast.Expr) and
@@ -776,6 +862,7 @@ def lint_paths(targets: Sequence[str],
                          _rule_undeclared_collective(path, rel, tree) +
                          _rule_unguarded_io(path, rel, tree) +
                          _rule_unbounded_queue(path, rel, tree) +
+                         _rule_unaccounted_buffer(path, rel, tree) +
                          _rule_exceptions(path, rel, tree))
         if any(rel.endswith(t) for t in THREADED_FILES):
             lv = _LockVisitor(rel)

@@ -89,6 +89,8 @@ import numpy as np
 
 from bigdl_tpu import telemetry
 from bigdl_tpu.dataset.transformer import Transformer
+from bigdl_tpu.resources import GOVERNOR as _governor
+from bigdl_tpu.resources import item_nbytes as _item_nbytes
 from bigdl_tpu.utils import config
 
 #: live engines, for the summary layer (weak: an abandoned engine must not
@@ -186,9 +188,13 @@ class RecordQuarantine:
             self.count += 1
             self.by_stage[stage] = self.by_stage.get(stage, 0) + 1
             if len(self.samples) < self.SAMPLE_MAX:
-                self.samples.append({
-                    "stage": stage, "index": index, "name": name,
-                    "error": repr(error)})
+                sample = {"stage": stage, "index": index, "name": name,
+                          "error": repr(error)}
+                self.samples.append(sample)
+                # even this bounded diagnostic sink is accounted: the
+                # host-memory governor's roll-up must see every buffer
+                _governor.account("ingest_quarantine").add(
+                    _item_nbytes(sample))
             over = self.count > self.budget
         telemetry.counter(
             "Ingest/quarantined", summary=True,
@@ -425,32 +431,72 @@ class _Ring:
     ``put`` charges blocked time to the producing stage's ``backpressure_s``
     (a full ring means the downstream stage is the bottleneck); ``get``
     charges the consuming stage's ``starve_s``.  Both poll a stop event so
-    teardown can never deadlock a stage thread."""
+    teardown can never deadlock a stage thread.
+
+    ``limit`` is the DYNAMIC depth: it starts at the configured depth and
+    the host-memory governor's shrinkers may halve it mid-run
+    (:meth:`shrink`) — a ring at or above its limit behaves exactly like a
+    full one, so the shrink flows through the existing backpressure
+    accounting rather than a new mechanism.  ``account``/``sizer`` keep a
+    governor byte ledger current as items enter and leave."""
 
     def __init__(self, depth: int, producer: Optional[StageStats] = None,
-                 consumer: Optional[StageStats] = None):
-        self.q: "queue.Queue" = queue.Queue(maxsize=max(1, int(depth)))
+                 consumer: Optional[StageStats] = None,
+                 account=None, sizer=None):
+        depth = max(1, int(depth))
+        self.q: "queue.Queue" = queue.Queue(maxsize=depth)
+        #: dynamic depth cap, <= the queue's hard maxsize; shrinks persist
+        self.limit = depth
         self._producer = producer
         self._consumer = consumer
+        self._account = account
+        self._sizer = sizer
         #: progress heartbeat: monotonic time of the last successful
         #: put/get — the stage supervisor's wedged-handoff signal and
         #: the watchdog's stall diagnostic (ring age)
         self.last_progress = time.monotonic()
 
+    def shrink(self) -> int:
+        """Halve the dynamic depth (floor 1); returns the new limit."""
+        self.limit = max(1, self.limit // 2)
+        return self.limit
+
+    def _charge(self, item, sign: int) -> None:
+        if self._account is None:
+            return
+        try:
+            n = self._sizer(item) if self._sizer is not None else 0
+        except Exception:       # accounting must never break the stage
+            n = 0
+        if n:
+            (self._account.add if sign > 0 else self._account.sub)(n)
+
     def put(self, item, stop: Optional[threading.Event]) -> bool:
         t0 = None
         while stop is None or not stop.is_set():
+            if self.q.qsize() >= self.limit:
+                # at (or shrunk below) the dynamic depth: identical to a
+                # full queue — wait, charging backpressure
+                if t0 is None:
+                    t0 = time.monotonic()
+                if stop is None:
+                    time.sleep(0.05)
+                else:
+                    stop.wait(0.05)
+                continue
             try:
                 self.q.put(item, timeout=0.05)
-                self.last_progress = time.monotonic()
-                if t0 is not None and self._producer is not None:
-                    self._producer.add(backpressure_s=time.monotonic() - t0)
-                if self._producer is not None:
-                    self._producer.sample_occupancy(self.q.qsize())
-                return True
             except queue.Full:
                 if t0 is None:
                     t0 = time.monotonic()
+                continue
+            self.last_progress = time.monotonic()
+            self._charge(item, +1)
+            if t0 is not None and self._producer is not None:
+                self._producer.add(backpressure_s=time.monotonic() - t0)
+            if self._producer is not None:
+                self._producer.sample_occupancy(self.q.qsize())
+            return True
         if t0 is not None and self._producer is not None:
             self._producer.add(backpressure_s=time.monotonic() - t0)
         return False
@@ -461,6 +507,7 @@ class _Ring:
             try:
                 item = self.q.get(timeout=0.05)
                 self.last_progress = time.monotonic()
+                self._charge(item, -1)
                 if t0 is not None and self._consumer is not None:
                     self._consumer.add(starve_s=time.monotonic() - t0)
                 return item
@@ -473,14 +520,17 @@ class _Ring:
 
     def try_get(self):
         try:
-            return self.q.get_nowait()
+            item = self.q.get_nowait()
         except queue.Empty:
             return _NO_ITEM
+        self._charge(item, -1)
+        return item
 
     def drain(self) -> None:
         try:
             while True:
-                self.q.get_nowait()
+                item = self.q.get_nowait()
+                self._charge(item, -1)
         except queue.Empty:
             pass
 
@@ -887,12 +937,39 @@ class StreamingIngest(Transformer):
             drawer.set_seed((mix ^ (0x9E3779B1 * fork_rank)) % (2 ** 31))
 
         stop = threading.Event()
+
+        # host-memory governor accounting: every bounded buffer this run
+        # owns (record ring, decode in-flight window, batch ring) keeps a
+        # byte ledger current, rolled up into Resources/host_bytes
+        rec_acct = _governor.account("ingest_record_ring")
+        bat_acct = _governor.account("ingest_batch_ring")
+        dec_acct = _governor.account("ingest_decode_window")
+        dec_outstanding = [0]    # this run's share, settled at teardown
+
+        def _rec_nbytes(item):
+            if isinstance(item, tuple) and len(item) == 2:
+                return _item_nbytes(getattr(item[1], "bytes", None))
+            return 0
+
+        def _bat_nbytes(item):
+            if isinstance(item, tuple) and len(item) == 2:
+                return _item_nbytes(item[0])
+            return 0
+
+        def _dec_charge(rec, sign: int) -> None:
+            n = _item_nbytes(getattr(rec, "bytes", None))
+            if n:
+                dec_outstanding[0] += sign * n
+                (dec_acct.add if sign > 0 else dec_acct.sub)(n)
+
         record_ring = _Ring(self.record_ring_depth,
                             producer=stats["read"],
-                            consumer=stats["assemble"])
+                            consumer=stats["assemble"],
+                            account=rec_acct, sizer=_rec_nbytes)
         batch_ring = _Ring(self.batch_ring_depth,
                            producer=stats["assemble"],
-                           consumer=stats["consume"])
+                           consumer=stats["consume"],
+                           account=bat_acct, sizer=_bat_nbytes)
         pool = ThreadPoolExecutor(self.decode_workers,
                                   thread_name_prefix="ingest-decode")
         ch, cw = self.crop
@@ -976,7 +1053,11 @@ class StreamingIngest(Transformer):
             the window is empty keeps the assembler from stalling on a
             slow upstream while it still has decoded work to pack."""
             pending = asm["pending"]
-            while not asm["done"] and len(pending) < self.decoded_ring_depth:
+            # under host-memory pressure the read-ahead pauses: the
+            # window collapses to depth 1 (progress, never deadlock)
+            window = (1 if _governor.under_pressure()
+                      else self.decoded_ring_depth)
+            while not asm["done"] and len(pending) < window:
                 item = (record_ring.get(stop) if block and not pending
                         else record_ring.try_get())
                 if item is _NO_ITEM:
@@ -994,6 +1075,7 @@ class StreamingIngest(Transformer):
                     pending.append((None, None, item))
                     return
                 idx, rec = item
+                _dec_charge(rec, +1)
                 pending.append((idx, rec,
                                 pool.submit(timed_decode, idx, rec.bytes)))
 
@@ -1056,6 +1138,9 @@ class StreamingIngest(Transformer):
 
         def emit() -> bool:
             batch, n, pack_s = pack_batch()
+            # depth-1 escalation: one batch larger than the whole host
+            # budget cannot be backpressured away — structured error
+            _governor.check_item("ingest_batch_ring", _item_nbytes(batch))
             ok = batch_ring.put((batch, drawer.np.get_state()), stop)
             if ok:
                 stats["assemble"].add(items=n, busy_s=pack_s)
@@ -1082,6 +1167,7 @@ class StreamingIngest(Transformer):
                     idx, rec, fut = pending.popleft()
                     if rec is None:      # upstream error, in order
                         raise fut
+                    _dec_charge(rec, -1)
                     try:
                         if fut.done():
                             img = fut.result()
@@ -1107,6 +1193,7 @@ class StreamingIngest(Transformer):
                             "ingest decode worker died on record %d — "
                             "resubmitting (%d/%d)", idx,
                             asm["decode_restarts"], self.max_stage_restarts)
+                        _dec_charge(rec, +1)
                         pending.appendleft(
                             (idx, rec, pool.submit(timed_decode, idx,
                                                    rec.bytes)))
@@ -1160,6 +1247,24 @@ class StreamingIngest(Transformer):
         fault_pair = (quarantine, sup)
         self._active_faults.append(fault_pair)
 
+        # run-scoped shrinker: when the governor detects host-memory
+        # pressure it halves this run's ring depths and decode window —
+        # the existing backpressure machinery does the rest.  Shrinks
+        # persist for the engine's lifetime (self.decoded_ring_depth).
+        shrink_key = f"ingest:{self.name}:{id(stop)}"
+
+        def _shrink() -> None:
+            rl = record_ring.shrink()
+            bl = batch_ring.shrink()
+            self.decoded_ring_depth = max(
+                1, int(self.decoded_ring_depth) // 2)
+            logger.warning(
+                "host-memory pressure: ingest '%s' ring depths shrink to "
+                "record=%d batch=%d decode-window=%d", self.name, rl, bl,
+                self.decoded_ring_depth)
+
+        _governor.register_shrinker(shrink_key, _shrink)
+
         def _sync_record_source() -> Iterator:
             """Leftover + remaining records for the fallback drain, in
             exact stream order: the assembler's in-flight window, then
@@ -1172,6 +1277,7 @@ class StreamingIngest(Transformer):
                     upstream_err = _fut
                     upstream_done = True
                     break
+                _dec_charge(rec, -1)
                 yield idx, rec
             asm["pending"].clear()
             while upstream_err is None:
@@ -1285,6 +1391,9 @@ class StreamingIngest(Transformer):
 
         try:
             while True:
+                # governor tick from the consumer side too: serving-only
+                # processes have no optimizer loop to poll for them
+                _governor.poll()
                 # blocked time inside get() is charged to consume.starve_s
                 # by the ring itself; the failure event doubles as the
                 # stop so a supervisor escalation wakes this wait at once
@@ -1319,6 +1428,7 @@ class StreamingIngest(Transformer):
                 stats["consume"].add(items=1)
                 yield batch
         finally:
+            _governor.unregister_shrinker(shrink_key)
             active_forks.discard(fork_token)
             for i, run in enumerate(self._active_stats):
                 if run is stats:
@@ -1350,6 +1460,14 @@ class StreamingIngest(Transformer):
             # drain again so no full batch stays pinned in the ring
             for ring in (record_ring, batch_ring):
                 ring.drain()
+            # settle this run's decode-window share: the account is
+            # process-global (shared by concurrent engines), so only the
+            # bytes THIS run still holds get released
+            if dec_outstanding[0] > 0:
+                dec_acct.sub(dec_outstanding[0])
+            elif dec_outstanding[0] < 0:
+                dec_acct.add(-dec_outstanding[0])
+            dec_outstanding[0] = 0
 
 
 def summary_scalars():

@@ -129,6 +129,13 @@ class MiniBatch:
     def size(self) -> int:
         return self.inputs[0].shape[0] if self.inputs else 0
 
+    @property
+    def nbytes(self) -> int:
+        """Host bytes this batch pins — what the resource governor's
+        ring/queue accounts charge per buffered batch."""
+        return int(sum(int(getattr(a, "nbytes", 0))
+                       for a in self.inputs + self.targets))
+
     def slice(self, offset: int, length: int) -> "MiniBatch":
         """Sub-batch [offset, offset+length) — 0-based, unlike the 1-based
         reference (reference ``MiniBatch.slice``)."""

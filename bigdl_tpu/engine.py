@@ -24,6 +24,7 @@ import jax
 import numpy as np
 
 from bigdl_tpu import telemetry
+from bigdl_tpu.resources import GOVERNOR as _resource_governor
 
 
 class DispatchPipeline:
@@ -145,6 +146,11 @@ class BatchPrefetcher:
         self.fetch_ns = 0
         self.block_ns = 0
         self.batches = 0
+        # transfer-ahead slot accounting: every batch sitting in the
+        # prefetch rings (fetched but not yet consumed) charges its host
+        # bytes to the governor — the read-ahead depth is exactly the
+        # buffer the host-memory budget needs to see
+        self._slot_acct = _resource_governor.account("prefetch_slots")
         # the producer owns epoch rollovers (reshuffles): it must continue
         # the CONSTRUCTING thread's RNG stream, so a user's set_seed on the
         # main thread keeps governing epoch 2+ shuffles whether or not
@@ -228,12 +234,23 @@ class BatchPrefetcher:
                 continue
         return False
 
+    @staticmethod
+    def _slot_nbytes(batch) -> int:
+        return int(sum(int(getattr(leaf, "nbytes", 0) or 0)
+                       for leaf in jax.tree_util.tree_leaves(batch)))
+
     def _run(self):
         from bigdl_tpu.utils.random_generator import RandomGenerator
         RandomGenerator.adopt(self._rng)
         staged = self._transfer_thread is not None
         out_q = self._issued_q if staged else self._q
         while not self._stop.is_set():
+            if _resource_governor.under_pressure():
+                # host-memory pressure: pause read-ahead — batches
+                # already queued keep flowing to the consumer while the
+                # accounted prefetch bytes drain down
+                self._stop.wait(0.05)
+                continue
             try:
                 # staged: hand the batch on with its upload still in
                 # flight — the transfer thread blocks it ready while this
@@ -241,8 +258,10 @@ class BatchPrefetcher:
                 item = (None, self._fetch_once(block=not staged))
             except BaseException as e:  # noqa: BLE001 — re-raised at call
                 item = (e, None)
+            if item[0] is None:
+                self._slot_acct.add(self._slot_nbytes(item[1]))
             if not self._put(out_q, item):
-                self._stash_error(item)
+                self._discard(item)
                 return
             if item[0] is not None:
                 return
@@ -259,9 +278,10 @@ class BatchPrefetcher:
                 try:
                     self._block_ready(batch)
                 except BaseException as e:  # noqa: BLE001 — re-raised
+                    self._slot_acct.sub(self._slot_nbytes(batch))
                     item = (e, None)
             if not self._put(self._q, item):
-                self._stash_error(item)
+                self._discard(item)
                 return
             if item[0] is not None:
                 return
@@ -275,12 +295,20 @@ class BatchPrefetcher:
         if item[0] is not None and self.error is None:
             self.error = item[0]
 
+    def _discard(self, item) -> None:
+        """An item dropped without ever reaching the consumer: release
+        its accounted slot bytes, then preserve any error it carried."""
+        if item[1] is not None:
+            self._slot_acct.sub(self._slot_nbytes(item[1]))
+        self._stash_error(item)
+
     def __call__(self):
         if self.depth <= 0:
             return self._fetch_once()
         err, batch = self._q.get()
         if err is not None:
             raise err
+        self._slot_acct.sub(self._slot_nbytes(batch))
         return batch
 
     def stop(self):
@@ -303,9 +331,11 @@ class BatchPrefetcher:
                 continue
             while True:
                 try:
-                    err, _ = q.get(block=False)
+                    err, batch = q.get(block=False)
                 except _queue.Empty:
                     break
+                if batch is not None:
+                    self._slot_acct.sub(self._slot_nbytes(batch))
                 if err is not None and self.error is None:
                     self.error = err
 

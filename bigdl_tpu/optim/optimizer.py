@@ -38,6 +38,9 @@ from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.optim.optim_method import OptimMethod, SGD
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation_method import ValidationMethod, ValidationResult
+from bigdl_tpu.resources import (GOVERNOR as _governor, DeviceMemoryError,
+                                 HostMemoryError)
+from bigdl_tpu.resources import storage as _resource_storage
 
 logger = logging.getLogger("bigdl_tpu")
 
@@ -278,6 +281,13 @@ class Optimizer:
         self._want_step_flops = False
         #: per-run step-time decomposition (bigdl_tpu.telemetry)
         self._step_account = None
+        #: microbatch re-plan state (resources.microbatch): the fused
+        #: step runs as k gradient-accumulation chunks after a device
+        #: OOM; 1 = full-batch (the normal plan)
+        self._microbatch_k: int = 1
+        #: global batch size observed by the last fetch — the re-plan
+        #: needs it to pick a k that divides the batch
+        self._plan_batch_size: int = 0
 
     # -- fluent setters (reference Optimizer.scala fluent API) ------------
 
@@ -432,6 +442,33 @@ class Optimizer:
             except (ValueError, TypeError, KeyboardInterrupt):
                 # reference: IllegalArgumentException aborts immediately
                 raise
+            except HostMemoryError:
+                # host memory exhausted even at depth 1 — no ring can
+                # shrink below one item, so a retry replays the same
+                # allocation; surface the structured error immediately
+                raise
+            except DeviceMemoryError as e:
+                # RESOURCE fault, not divergence: the same program would
+                # OOM forever, so retrying costs no budget and waits no
+                # backoff — the answer is a microbatch re-plan (split the
+                # global batch into k accumulation chunks).  next_k's
+                # doubling schedule bounds the loop: once per-sample has
+                # been tried the re-plan returns False and the fault is
+                # fatal.
+                heal_t0 = time.monotonic()
+                if not self._replan_microbatch(e):
+                    raise
+                restored = self._restore_latest_checkpoint()
+                if not restored and self._params_dead():
+                    # the OOMed dispatch donated-and-deleted the carries
+                    # and there is no snapshot to reload them from
+                    raise
+                telemetry.gauge(
+                    "Resources/oom_replan_ms",
+                    help="device-OOM detection to re-planned-step "
+                         "readiness (re-plan + restore)").set(
+                    (time.monotonic() - heal_t0) * 1000.0)
+                continue
             except elastic.Preempted:
                 # the driver drained and published before raising; commit
                 # the grace-period snapshot and leave — preemption is an
@@ -506,6 +543,40 @@ class Optimizer:
             if self.checkpoint is not None:
                 self.checkpoint.join()
             return result
+
+    def _replan_microbatch(self, e: DeviceMemoryError) -> bool:
+        """Answer a :class:`DeviceMemoryError` with the next microbatch
+        plan: the global batch of B samples re-runs as k equal
+        accumulation chunks (``resources.microbatch`` — Kahan-compensated
+        mean gradient, ONE optimizer update, numerics allclose to the
+        full-batch step).  Invalidates the built step and the retrace
+        sentinel so the re-planned program compiles as a NEW signature
+        with its own warmup — the re-plan must never trip the strict
+        retrace gate.  Returns False when no further split exists
+        (already per-sample, or no batch observed yet)."""
+        from bigdl_tpu.resources import microbatch as _microbatch
+        bsz = int(self._plan_batch_size or 0)
+        if bsz <= 0:
+            return False
+        k = _microbatch.next_k(bsz, self._microbatch_k)
+        if k is None:
+            return False
+        prev = self._microbatch_k
+        self._microbatch_k = k
+        self._step_fn = None           # rebuild with the k-chunk plan
+        self._retrace_sentinel = None  # fresh warmup for the new program
+        telemetry.counter(
+            "Resources/microbatch_replans",
+            help="device-OOM-driven microbatch re-plans this process").inc()
+        telemetry.gauge(
+            "Resources/microbatch_k",
+            help="gradient-accumulation chunks per step after OOM "
+                 "re-planning (1 = full batch)").set(k)
+        logger.warning(
+            "Device memory exhausted (%s) — re-planning the fused step: "
+            "global batch %d now runs as %d accumulation chunk(s) of %d "
+            "samples (was k=%d)", e, bsz, k, bsz // k, prev)
+        return True
 
     def _commit_preemption_snapshot(self) -> None:
         """The grace-period exit: the driver already flushed its dispatch
@@ -963,10 +1034,14 @@ class Optimizer:
                     # single-writer artifact
                     slow_req["due"] = True
                     if is_writer_process() and telemetry.tracing_enabled():
-                        os.makedirs(str(slow_profile_dir), exist_ok=True)
-                        telemetry.export_chrome_trace(os.path.join(
-                            str(slow_profile_dir),
-                            f"slowstep_{neval}_timeline.json"))
+                        # bounded (bigdl.telemetry.maxTimelineDumps,
+                        # oldest-first eviction) and disk-full-guarded: a
+                        # flapping detector must not fill the disk with
+                        # dump files, nor crash on one already full
+                        _resource_storage.bounded_timeline_export(
+                            os.path.join(
+                                str(slow_profile_dir),
+                                f"slowstep_{neval}_timeline.json"))
             with telemetry.span("driver/summary"):
                 self._summarize_train(loss, throughput, neval)
 
@@ -1104,6 +1179,11 @@ class Optimizer:
                     profile_end = state["neval"] + 1
                 if watchdog is not None:
                     watchdog.heartbeat()
+                # host-memory governor: one poll per iteration rolls up
+                # every registered buffer account against the soft budget
+                # (bigdl.resources.hostMemBudgetMB) and fires the
+                # registered shrinkers edge-triggered under pressure
+                _governor.poll()
                 if _chaos.active():
                     # chaos harness step-level hooks: a simulated step
                     # failure raises here (the retry loop absorbs it), a
@@ -1283,10 +1363,15 @@ class Optimizer:
         from bigdl_tpu.utils import config as _config
         if not is_writer_process():
             return
+        # both exports run disk-full-guarded: a full disk disables the
+        # artifact for the rest of the run with ONE structured warning
+        # (Resources/storage_degraded) — it never fails the training run
         trace_path = _config.get_property("bigdl.telemetry.tracePath")
         if trace_path and telemetry.tracing_enabled():
-            telemetry.export_chrome_trace(str(trace_path))
-            logger.info("Telemetry timeline written to %s", trace_path)
+            if _resource_storage.guarded_export(
+                    "telemetry",
+                    lambda: telemetry.export_chrome_trace(str(trace_path))):
+                logger.info("Telemetry timeline written to %s", trace_path)
         snap_path = _config.get_property("bigdl.telemetry.snapshotPath")
         if snap_path:
             import json
@@ -1295,9 +1380,13 @@ class Optimizer:
                 snap_path = os.path.join(snap_path, "telemetry.json")
             snap = telemetry.REGISTRY.snapshot()
             snap["step_summary"] = step_account.summary()
-            with open(snap_path, "w") as f:
-                json.dump(snap, f, indent=1, sort_keys=True)
-            logger.info("Telemetry snapshot written to %s", snap_path)
+
+            def _write_snap():
+                with open(snap_path, "w") as f:
+                    json.dump(snap, f, indent=1, sort_keys=True)
+
+            if _resource_storage.guarded_export("telemetry", _write_snap):
+                logger.info("Telemetry snapshot written to %s", snap_path)
 
     def _check_symmetric_config(self) -> None:
         """Multi-host guard: the publish/validation sync points contain
@@ -1508,10 +1597,14 @@ class LocalOptimizer(Optimizer):
         aux_weight = self.moe_aux_weight
         from bigdl_tpu.utils import config
         from bigdl_tpu import integrity as _integrity
+        from bigdl_tpu.resources import microbatch as _microbatch
         guard = config.get_bool("bigdl.divergence.guard", True)
         every_n = config.get_int("bigdl.integrity.everyN", 0)
         fp_seed = config.get_int("bigdl.integrity.seed",
                                  _integrity.DEFAULT_SEED)
+        #: OOM re-plan: > 1 splits the batch into mb_k accumulation
+        #: chunks inside ONE fused program (resources.microbatch)
+        mb_k = max(1, int(self._microbatch_k))
 
         def _step_core(params, slots, mstate, inputs, targets, hyper, rng,
                        fpc=None, tick=None):
@@ -1523,8 +1616,34 @@ class LocalOptimizer(Optimizer):
                 loss = loss + moe_aux_penalty(model, new_mstate, aux_weight)
                 return loss, new_mstate
 
-            (loss, new_mstate), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
+            if mb_k > 1:
+                # microbatch re-plan: k forward/backward passes over B/k
+                # samples each, Kahan-compensated mean of (loss, grads,
+                # state) — mean of equal-chunk means IS the full-batch
+                # mean, so the numerics stay allclose to the full-batch
+                # step while peak activation memory drops ~k-fold.  One
+                # lax.scan keeps it a single fused program.
+                def chunk_grads(xs):
+                    cin, ctg = xs
+
+                    def chunk_loss(p):
+                        out, nm = mixed_precision_forward(
+                            model, p, cin, mstate, precision, True, rng)
+                        closs = criterion.apply(out, ctg)
+                        closs = closs + regularization_penalty(model, p)
+                        closs = closs + moe_aux_penalty(model, nm,
+                                                        aux_weight)
+                        return closs, nm
+
+                    (closs, nm), cg = jax.value_and_grad(
+                        chunk_loss, has_aux=True)(params)
+                    return closs, cg, nm
+
+                loss, grads, new_mstate = _microbatch.scan_mean(
+                    chunk_grads, (inputs, targets), mb_k)
+            else:
+                (loss, new_mstate), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
             new_params, new_slots = optim.pure_update(grads, params, slots,
                                                       hyper)
             aux: Dict[str, Any] = {}
@@ -1588,8 +1707,12 @@ class LocalOptimizer(Optimizer):
 
         from bigdl_tpu.analysis import program_contracts
         from bigdl_tpu.utils import compile_cache
+        # the re-planned program gets its own label: same argument
+        # signature, DIFFERENT traced body — it must never collide with
+        # the full-batch executable in the compile cache
+        label = "local" if mb_k == 1 else f"local_mb{mb_k}"
         return compile_cache.tracked_jit(
-            step, label="local", topology=self._topology_meta(),
+            step, label=label, topology=self._topology_meta(),
             contract=program_contracts.local_contract(precision),
             donate_argnums=(0, 1, 2))
 
@@ -1666,6 +1789,9 @@ class LocalOptimizer(Optimizer):
 
         def fetch_batch():
             batch = next(it["data"])
+            # the OOM re-plan picks its chunk count k against the
+            # observed global batch (k must divide it)
+            self._plan_batch_size = batch.size()
             return (_to_device(batch.get_input()),
                     _to_device(batch.get_target()), batch.size())
 

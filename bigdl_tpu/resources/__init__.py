@@ -1,0 +1,38 @@
+"""Resource-exhaustion resilience (ISSUE 14).
+
+Three exhaustion classes, one structured taxonomy, all chaos-proven:
+
+* **device memory** — HBM preflight on every compiled fused step +
+  dispatch-time RESOURCE_EXHAUSTED classification; the driver answers a
+  :class:`DeviceMemoryError` with an automatic microbatch re-plan
+  (:mod:`bigdl_tpu.resources.microbatch`), never a same-plan retry.
+* **host memory** — every bounded ingest/prefetch/serving buffer
+  registers byte accounting with the :data:`GOVERNOR`; a soft budget
+  shrinks ring depths and pauses read-ahead through the existing
+  backpressure machinery; :class:`HostMemoryError` escalates only when
+  even depth 1 cannot fit.
+* **storage** — ENOSPC/EDQUOT classified at the ``file_io.write_bytes``
+  choke point into :class:`StorageExhaustedError`; checkpointing,
+  compile-cache stores, and telemetry exports degrade to diskless
+  operation (:mod:`bigdl_tpu.resources.storage`) — training and serving
+  never crash on a full disk.
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu.resources.errors import (DeviceMemoryError, HostMemoryError,
+                                        ResourceError,
+                                        StorageExhaustedError,
+                                        is_oom_error, is_storage_exhausted)
+from bigdl_tpu.resources.governor import (GOVERNOR, Account,
+                                          HostMemoryGovernor, item_nbytes)
+from bigdl_tpu.resources import storage
+from bigdl_tpu.resources.storage import (bounded_timeline_export,
+                                         guarded_export, note_degraded)
+
+__all__ = [
+    "Account", "DeviceMemoryError", "GOVERNOR", "HostMemoryError",
+    "HostMemoryGovernor", "ResourceError", "StorageExhaustedError",
+    "bounded_timeline_export", "guarded_export", "is_oom_error",
+    "is_storage_exhausted", "item_nbytes", "note_degraded", "storage",
+]

@@ -1,0 +1,78 @@
+"""Device-memory preflight and dispatch-time OOM classification.
+
+The PR 11 HLO census already extracts a peak-buffer estimate from every
+compiled fused step (``analysis.hlo_audit.peak_buffer_bytes``).  This
+module turns that census into a *gate*: with
+``bigdl.resources.deviceMemBudgetMB`` set, ``CachedStep`` calls
+:func:`preflight` after compilation and BEFORE the first dispatch — a
+step that cannot fit raises :class:`DeviceMemoryError` while the
+training state is still untouched, so the driver's microbatch re-plan
+starts from exactly the state the oversized step would have consumed.
+
+Dispatch-time failures (a real XLA RESOURCE_EXHAUSTED, or the chaos
+injector ``bigdl.chaos.oomStepAt`` replicating its message) are folded
+into the same structured error by :func:`classify_dispatch_error`, so
+the driver has ONE resource-fault class to re-plan against.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from bigdl_tpu.resources.errors import DeviceMemoryError, is_oom_error
+
+logger = logging.getLogger("bigdl_tpu")
+
+
+def budget_bytes() -> int:
+    """Configured device-memory budget in bytes (0 = preflight off)."""
+    from bigdl_tpu.utils import config
+    mb = config.get_float("bigdl.resources.deviceMemBudgetMB", 0.0)
+    return int(mb * (1 << 20)) if mb > 0 else 0
+
+
+def preflight(compiled, label: str) -> Optional[int]:
+    """Evaluate a compiled executable's peak-bytes estimate against the
+    budget before it ever dispatches.  Returns the peak estimate (None
+    when the backend cannot report one — never a false positive), or
+    raises :class:`DeviceMemoryError` on a breach."""
+    budget = budget_bytes()
+    if budget <= 0 or compiled is None:
+        return None
+    from bigdl_tpu.analysis.hlo_audit import peak_buffer_bytes
+    peak = peak_buffer_bytes(compiled)
+    if peak is None:
+        return None
+    from bigdl_tpu import telemetry
+    telemetry.gauge("Resources/device_peak_bytes",
+                    labels={"step": label},
+                    help="preflight peak-buffer estimate per fused step"
+                    ).set(peak)
+    if peak > budget:
+        telemetry.counter(
+            "Resources/device_oom",
+            help="device-memory faults (preflight breaches + dispatch "
+                 "RESOURCE_EXHAUSTED)").inc()
+        raise DeviceMemoryError(label, peak, budget, phase="preflight")
+    return peak
+
+
+def classify_dispatch_error(e: BaseException,
+                            label: str) -> Optional[DeviceMemoryError]:
+    """Fold a dispatch-time allocation failure into the structured
+    RESOURCE taxonomy.  Returns the classified error (counted), or None
+    when ``e`` is not an OOM (caller re-raises the original)."""
+    if isinstance(e, DeviceMemoryError):
+        return e
+    if not is_oom_error(e):
+        return None
+    from bigdl_tpu import telemetry
+    telemetry.counter(
+        "Resources/device_oom",
+        help="device-memory faults (preflight breaches + dispatch "
+             "RESOURCE_EXHAUSTED)").inc()
+    err = DeviceMemoryError(label, None, budget_bytes() or None,
+                            phase="dispatch")
+    err.__cause__ = e
+    return err

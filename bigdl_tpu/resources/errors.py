@@ -1,0 +1,121 @@
+"""Structured resource-exhaustion errors and their classifiers.
+
+The retry taxonomy in the optimizer driver distinguishes three resource
+fault classes, none of which is a divergence and none of which should
+burn the retry-from-snapshot budget:
+
+* :class:`DeviceMemoryError` — the fused step does not fit HBM, found
+  either by the pre-dispatch preflight (``compiled.memory_analysis()``
+  peak vs ``bigdl.resources.deviceMemBudgetMB``) or by a real/injected
+  RESOURCE_EXHAUSTED at dispatch.  The driver answers with a microbatch
+  re-plan, not a retry: re-running the same program re-OOMs forever.
+* :class:`HostMemoryError` — even a depth-1 buffer exceeds
+  ``bigdl.resources.hostMemBudgetMB``.  Shrinking cannot help; the run
+  escalates immediately with the offending account named.
+* :class:`StorageExhaustedError` — ENOSPC/EDQUOT classified at the
+  ``file_io.write_bytes`` choke point.  ``fatal = True`` so the
+  transient-IO retry refuses to absorb it (re-writing to a full disk
+  yields a full disk); callers degrade gracefully instead.
+
+This module stays import-light (stdlib only) so ``utils.file_io`` can
+import it without dragging in telemetry or jax.
+"""
+
+from __future__ import annotations
+
+import errno
+from typing import Optional
+
+
+class ResourceError(RuntimeError):
+    """Base class for the RESOURCE fault taxonomy: exhaustion of device
+    memory, host memory, or storage — never a numerics problem, never
+    retried against an unchanged plan."""
+
+
+class DeviceMemoryError(ResourceError):
+    """The fused step cannot fit device memory.
+
+    ``phase`` is ``"preflight"`` (caught from ``memory_analysis()``
+    before the first dispatch) or ``"dispatch"`` (a real or injected
+    RESOURCE_EXHAUSTED surfaced at execution).  The driver's answer is
+    a microbatch re-plan — splitting the global batch into k
+    gradient-accumulation steps — never a same-plan retry."""
+
+    def __init__(self, label: str, peak_bytes: Optional[int],
+                 budget_bytes: Optional[int], phase: str = "dispatch"):
+        self.label = label
+        self.peak_bytes = peak_bytes
+        self.budget_bytes = budget_bytes
+        self.phase = phase
+        peak = "?" if peak_bytes is None else f"{peak_bytes}"
+        budget = "?" if budget_bytes is None else f"{budget_bytes}"
+        super().__init__(
+            f"device memory exhausted ({phase}) on step {label!r}: "
+            f"peak {peak} B vs budget {budget} B — microbatch re-plan "
+            "required")
+
+
+class HostMemoryError(ResourceError):
+    """A single buffered item exceeds the host-memory budget: the
+    governor's depth shrinking has no move left (depth 1 is already too
+    big), so the run escalates with the owning account named."""
+
+    def __init__(self, account: str, nbytes: int, budget_bytes: int):
+        self.account = account
+        self.nbytes = nbytes
+        self.budget_bytes = budget_bytes
+        super().__init__(
+            f"host memory budget exhausted: one item of {nbytes} B in "
+            f"buffer {account!r} exceeds "
+            f"bigdl.resources.hostMemBudgetMB ({budget_bytes} B) — "
+            "even depth 1 cannot fit; lower the batch/record size or "
+            "raise the budget")
+
+
+class StorageExhaustedError(OSError):
+    """ENOSPC/EDQUOT classified at the payload-write choke point.
+
+    ``fatal`` makes ``file_io._is_transient`` refuse to retry it — a
+    full disk does not recover on a backoff schedule.  Consumers
+    (checkpoint manager, compile cache, telemetry exporters) degrade
+    instead of crashing."""
+
+    #: never absorbed by the transient-IO retry
+    fatal = True
+
+    def __init__(self, path: str, original: Optional[BaseException] = None):
+        self.path = path
+        self.original = original
+        code = getattr(original, "errno", None) or errno.ENOSPC
+        super().__init__(code,
+                         f"storage exhausted writing {path} "
+                         f"({errno.errorcode.get(code, code)})")
+
+
+#: substrings that mark an XLA allocation failure — the real runtime
+#: raises RuntimeError/XlaRuntimeError whose message leads with the
+#: RESOURCE_EXHAUSTED status code; the chaos injector mimics it exactly
+#: so one classifier covers both.
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory",
+                "out of memory", "OOM when allocating")
+
+_STORAGE_ERRNOS = (errno.ENOSPC, errno.EDQUOT)
+
+
+def is_oom_error(e: BaseException) -> bool:
+    """True when ``e`` is a device allocation failure (real XLA
+    RESOURCE_EXHAUSTED or the chaos injector's replica of it)."""
+    if isinstance(e, DeviceMemoryError):
+        return True
+    msg = str(e)
+    return any(m in msg for m in _OOM_MARKERS)
+
+
+def is_storage_exhausted(e: BaseException) -> bool:
+    """True when ``e`` is a disk-full class error (already classified,
+    or a raw OSError carrying ENOSPC/EDQUOT)."""
+    if isinstance(e, StorageExhaustedError):
+        return True
+    return (isinstance(e, OSError) and
+            getattr(e, "errno", None) in _STORAGE_ERRNOS)

@@ -1,0 +1,226 @@
+"""Host-memory governor: byte accounting + soft-budget backpressure.
+
+Every bounded buffer the ingest / prefetch / serving paths own (record
+ring, decode in-flight window, batch ring, transfer-ahead slots,
+quarantine samples, admission queue) registers an :class:`Account` here
+and keeps it current as items enter and leave.  The roll-up is exported
+as ``Resources/host_bytes`` through the telemetry registry provider
+mechanism (PR 5), one gauge per account plus the total.
+
+A soft budget ``bigdl.resources.hostMemBudgetMB`` (0 = accounting only,
+no enforcement) turns the governor active: when the accounted total
+reaches the budget — or the chaos injector
+``bigdl.chaos.hostMemPressureAt`` clamps the reported free bytes at the
+k-th poll — the registered *shrinkers* fire (ring depth halving, pause
+of read-ahead) through the same backpressure machinery the pipelines
+already have, instead of letting the process OOM.  Shrinks persist for
+the rest of the run; pressure detection is edge-triggered so a sustained
+breach fires the shrinkers once per excursion, not once per poll.
+
+When even a single item exceeds the whole budget there is no depth left
+to shrink: :meth:`HostMemoryGovernor.check_item` raises the structured
+:class:`~bigdl_tpu.resources.errors.HostMemoryError` escalation.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, Iterable, Tuple
+
+from bigdl_tpu.resources.errors import HostMemoryError
+
+logger = logging.getLogger("bigdl_tpu")
+
+
+class Account:
+    """One named byte ledger (a ring, a window, a queue).  Thread-safe;
+    clamped at zero so a stray double-subtract cannot go negative and
+    poison the roll-up."""
+
+    def __init__(self, name: str, lock: threading.Lock):
+        self.name = name
+        self._lock = lock
+        self._bytes = 0
+
+    @property
+    def nbytes(self) -> int:
+        return self._bytes
+
+    def add(self, n: int) -> None:
+        with self._lock:
+            self._bytes += max(0, int(n))
+
+    def sub(self, n: int) -> None:
+        with self._lock:
+            self._bytes = max(0, self._bytes - max(0, int(n)))
+
+    def set(self, n: int) -> None:
+        with self._lock:
+            self._bytes = max(0, int(n))
+
+
+class HostMemoryGovernor:
+    """Process-wide ledger of accounted host buffers + the soft-budget
+    reaction (shrinkers) and the hard escalation (HostMemoryError)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._accounts: Dict[str, Account] = {}
+        self._shrinkers: Dict[str, Callable[[], None]] = {}
+        self._polls = 0
+        self._pressure_events = 0
+        self._under_pressure = False
+
+    # ---- accounts ------------------------------------------------------
+
+    def account(self, name: str) -> Account:
+        """Get-or-create the named ledger (idempotent: stages re-created
+        across epochs reuse their account)."""
+        with self._lock:
+            acct = self._accounts.get(name)
+            if acct is None:
+                acct = self._accounts[name] = Account(
+                    name, threading.Lock())
+        return acct
+
+    def total_bytes(self) -> int:
+        with self._lock:
+            accounts = list(self._accounts.values())
+        return sum(a.nbytes for a in accounts)
+
+    def budget_bytes(self) -> int:
+        """Current soft budget in bytes (0 = accounting only)."""
+        from bigdl_tpu.utils import config
+        mb = config.get_float("bigdl.resources.hostMemBudgetMB", 0.0)
+        return int(mb * (1 << 20)) if mb > 0 else 0
+
+    def free_bytes(self) -> int:
+        """Budget headroom (a large sentinel when no budget is set) —
+        the value the chaos injector clamps."""
+        budget = self.budget_bytes()
+        if budget <= 0:
+            return 1 << 62
+        return budget - self.total_bytes()
+
+    # ---- budget reaction -----------------------------------------------
+
+    def register_shrinker(self, name: str,
+                          fn: Callable[[], None]) -> None:
+        """Register a depth-reduction callback (halve a ring, pause
+        read-ahead).  Run-scoped: unregister on teardown."""
+        with self._lock:
+            self._shrinkers[name] = fn
+
+    def unregister_shrinker(self, name: str) -> None:
+        with self._lock:
+            self._shrinkers.pop(name, None)
+
+    def poll(self) -> bool:
+        """One governor tick (called from the driver loop and the ingest
+        consumer).  Returns True when a pressure excursion fired the
+        shrinkers this tick."""
+        from bigdl_tpu.utils import chaos
+        with self._lock:
+            self._polls += 1
+            polls = self._polls
+        free = self.free_bytes()
+        if chaos.host_mem_pressure(polls):
+            free = 0    # injected pressure: reported headroom vanishes
+        under = free <= 0
+        fired = False
+        with self._lock:
+            if under and not self._under_pressure:
+                fired = True
+                self._pressure_events += 1
+            self._under_pressure = under
+            shrinkers = list(self._shrinkers.items()) if fired else []
+        if fired:
+            from bigdl_tpu import telemetry
+            telemetry.counter(
+                "Resources/host_pressure",
+                help="host-memory pressure excursions (budget or "
+                     "injected) that fired the shrinkers").inc()
+            logger.warning(
+                "host-memory pressure: %d B accounted vs %d B budget — "
+                "shrinking %d registered buffer(s)", self.total_bytes(),
+                self.budget_bytes(), len(shrinkers))
+            for name, fn in shrinkers:
+                try:
+                    fn()
+                except Exception as e:   # a broken shrinker must not
+                    logger.warning(      # take the driver loop down
+                        "resource shrinker %r failed: %r", name, e)
+        return fired
+
+    def under_pressure(self) -> bool:
+        with self._lock:
+            return self._under_pressure
+
+    def check_item(self, name: str, nbytes: int) -> None:
+        """Escalate when ONE item is larger than the whole budget: depth
+        shrinking bottoms out at 1, so no backpressure can save this."""
+        budget = self.budget_bytes()
+        if budget > 0 and int(nbytes) > budget:
+            from bigdl_tpu import telemetry
+            telemetry.counter(
+                "Resources/host_budget_exceeded",
+                help="single-item host-memory budget escalations").inc()
+            raise HostMemoryError(name, int(nbytes), budget)
+
+    # ---- telemetry / lifecycle -----------------------------------------
+
+    def summary_scalars(self) -> Iterable[Tuple[str, float]]:
+        yield ("Resources/host_bytes", float(self.total_bytes()))
+        with self._lock:
+            accounts = list(self._accounts.values())
+            events = self._pressure_events
+        for a in accounts:
+            yield (f"Resources/host_bytes_{a.name}", float(a.nbytes))
+        yield ("Resources/host_pressure_events", float(events))
+
+    def reset(self) -> None:
+        """Drop all accounts/shrinkers/counters (test isolation)."""
+        with self._lock:
+            self._accounts.clear()
+            self._shrinkers.clear()
+            self._polls = 0
+            self._pressure_events = 0
+            self._under_pressure = False
+
+
+#: the process-wide governor every accounted buffer reports to
+GOVERNOR = HostMemoryGovernor()
+
+
+def item_nbytes(obj, _depth: int = 0) -> int:
+    """Best-effort host-byte estimate of one buffered item: numpy/jax
+    arrays report ``nbytes``, bytes-likes their length, containers the
+    sum of their members (depth-capped — accounting must stay O(item),
+    never a deep graph walk)."""
+    if obj is None or _depth > 3:
+        return 0
+    n = getattr(obj, "nbytes", None)
+    if n is not None:
+        try:
+            return int(n)
+        except (TypeError, ValueError):
+            return 0
+    if isinstance(obj, (bytes, bytearray, memoryview)):
+        return len(obj)
+    if isinstance(obj, str):
+        return len(obj)
+    if isinstance(obj, dict):
+        return sum(item_nbytes(v, _depth + 1) for v in obj.values())
+    if isinstance(obj, (tuple, list)):
+        return sum(item_nbytes(v, _depth + 1) for v in obj)
+    return 0
+
+
+def _register_provider() -> None:
+    from bigdl_tpu import telemetry
+    telemetry.REGISTRY.register_provider(
+        "resources", GOVERNOR.summary_scalars)
+
+
+_register_provider()

@@ -1,0 +1,95 @@
+"""Microbatch re-planning: the driver's answer to device OOM.
+
+When a fused step raises :class:`DeviceMemoryError`, re-running the
+same program is pointless — it re-OOMs forever.  The re-plan splits the
+global batch of B samples into k equal accumulation chunks: the step
+runs k forward/backward passes over B/k samples each and applies ONE
+optimizer update with the mean gradient.  Peak activation memory drops
+roughly k-fold while the numerics stay allclose to the full-batch step:
+the mean of k equal-chunk gradient means IS the full-batch gradient
+mean, and the in-scan accumulation uses Kahan compensated summation so
+the k-term reduction does not lose low-order bits the single-pass
+reduction would have kept.
+
+The helpers here are pure and trace-safe (used INSIDE the jitted step);
+the driver-side policy (when to re-plan, how to grow k) is
+:func:`next_k` / :func:`snap_k`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+
+def snap_k(batch_size: int, k: int) -> int:
+    """Smallest divisor of ``batch_size`` that is >= ``k`` — equal-size
+    chunks are what makes mean-of-chunk-means equal the full-batch mean
+    (and what keeps one compiled chunk signature, not a ragged tail)."""
+    b = max(1, int(batch_size))
+    k = max(1, min(int(k), b))
+    while b % k:
+        k += 1
+    return k
+
+
+def next_k(batch_size: int, current_k: int) -> Optional[int]:
+    """The re-plan schedule: 1 → 2 → 4 → … (snapped to divisors of the
+    batch), until per-sample (k == B) has been tried; then None — the
+    model does not fit at microbatch 1 and the fault is fatal."""
+    b = max(1, int(batch_size))
+    cur = max(1, int(current_k))
+    if cur >= b:
+        return None
+    return snap_k(b, cur * 2)
+
+
+def chunk_leading(tree, k: int):
+    """Reshape every leaf's leading dim B into (k, B // k) — the scan
+    axis of the accumulation loop.  Trace-safe."""
+    import jax
+
+    def _split(a):
+        return a.reshape((k, a.shape[0] // k) + tuple(a.shape[1:]))
+
+    return jax.tree_util.tree_map(_split, tree)
+
+
+def scan_mean(fn: Callable, xs, k: int):
+    """Compensated mean of ``fn`` over ``k`` leading-dim chunks of the
+    pytree ``xs`` (every leaf's leading dim divisible by ``k``).
+
+    ``fn(chunk_tree)`` returns a pytree of float arrays; the result is
+    the same pytree holding the Kahan-compensated mean over the k
+    chunks.  Runs as one ``lax.scan`` so the re-planned step stays a
+    single fused program (one signature for the retrace sentinel)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    chunked = chunk_leading(xs, k)
+    first = fn(jax.tree_util.tree_map(lambda a: a[0], chunked))
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, first)
+
+    def body(carry, chunk):
+        acc, comp = carry
+        val = fn(chunk)
+        # Kahan step per leaf: y = v - comp; t = acc + y;
+        # comp = (t - acc) - y; acc = t
+        y = jax.tree_util.tree_map(lambda v, c: v - c, val, comp)
+        t = jax.tree_util.tree_map(lambda a, yy: a + yy, acc, y)
+        comp = jax.tree_util.tree_map(
+            lambda tt, a, yy: (tt - a) - yy, t, acc, y)
+        return (t, comp), None
+
+    (acc, _), _ = lax.scan(body, (zeros, zeros), chunked)
+
+    def _mean(a):
+        # integer leaves (module-state counters) must keep their dtype:
+        # equal-per-chunk values floor-divide back exactly, and a float
+        # promotion here would drift the carry signature between the
+        # full-batch and re-planned programs
+        if jnp.issubdtype(a.dtype, jnp.inexact):
+            return a / k
+        return (a // k).astype(a.dtype)
+
+    return jax.tree_util.tree_map(_mean, acc)

@@ -48,6 +48,8 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from bigdl_tpu import telemetry
+from bigdl_tpu.resources import GOVERNOR as _resource_governor
+from bigdl_tpu.resources import item_nbytes as _item_nbytes
 from bigdl_tpu.utils import elastic
 
 logger = logging.getLogger("bigdl_tpu")
@@ -144,13 +146,14 @@ class RequestHandle:
     racing dispatch)."""
 
     __slots__ = ("raw", "index", "submit_ns", "deadline_ns", "finish_ns",
-                 "outcome", "_result", "_error", "_done")
+                 "outcome", "_result", "_error", "_done", "payload_nbytes")
 
     def __init__(self, raw, index: int, submit_ns: int, deadline_ns: int):
         self.raw = raw
         self.index = index            # admission position (chaos plans key on it)
         self.submit_ns = submit_ns
         self.deadline_ns = deadline_ns
+        self.payload_nbytes = 0       # host bytes charged to the governor
         self.finish_ns: Optional[int] = None
         self.outcome: Optional[str] = None
         self._result = None
@@ -263,6 +266,8 @@ class ServingEngine:
         self._q: "queue.Queue[RequestHandle]" = queue.Queue(
             maxsize=self.max_queue_depth)
         self._lock = threading.Lock()
+        # queued + in-flight payload bytes, rolled into Resources/host_bytes
+        self._payload_acct = _resource_governor.account("serving_admission")
         self._counts: Dict[str, int] = dict.fromkeys(OUTCOMES, 0)
         self._counts["submitted"] = 0
         self._next_index = 0
@@ -360,6 +365,11 @@ class ServingEngine:
         now = telemetry.clock_ns()
         deadline = float(deadline_ms if deadline_ms is not None
                          else self.deadline_ms)
+        # one payload larger than the whole host-memory budget can never
+        # be admitted — escalate BEFORE it counts as submitted, so the
+        # outcome accounting identity stays intact
+        payload_nbytes = _item_nbytes(inputs)
+        _resource_governor.check_item("serving_admission", payload_nbytes)
         telemetry.counter("Serving/submitted").inc()
         with self._lock:
             self._counts["submitted"] += 1
@@ -386,6 +396,10 @@ class ServingEngine:
             self._next_index += 1
         try:
             self._q.put_nowait(req)
+            # admission-queue bytes: charged while the payload is queued
+            # or in flight, released by _account at the terminal state
+            req.payload_nbytes = payload_nbytes
+            self._payload_acct.add(payload_nbytes)
         except queue.Full:
             # a racing submit filled the last slot between the depth
             # check and here — same answer, same speed (the request's
@@ -447,6 +461,9 @@ class ServingEngine:
                  result=None, reason: Optional[str] = None) -> bool:
         if not req._finish(outcome, result=result, error=error):
             return False
+        if req.payload_nbytes:
+            self._payload_acct.sub(req.payload_nbytes)
+            req.payload_nbytes = 0
         with self._lock:
             self._counts[outcome] += 1
         telemetry.counter(f"Serving/{outcome}").inc()

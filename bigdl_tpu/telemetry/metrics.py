@@ -30,11 +30,20 @@ never contend on the registry itself.
 from __future__ import annotations
 
 import json
+import logging
 import math
 import re
 import threading
 from collections import deque
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+logger = logging.getLogger("bigdl_tpu")
+
+#: unbounded label cardinality is a slow host-memory leak (and a
+#: Prometheus scrape bomb): past this many labeled variants of one base
+#: name, further variants fold into a single ``{overflow="true"}``
+#: series instead of minting new ones
+MAX_LABEL_VARIANTS = 64
 
 
 def _label_key(name: str, labels: Optional[dict]) -> str:
@@ -168,6 +177,8 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._metrics: "Dict[str, _Metric]" = {}
         self._providers: "Dict[str, Callable[[], Iterable[Tuple[str, float]]]]" = {}
+        self._label_variants: Dict[str, int] = {}
+        self._overflow_logged: set = set()
 
     # ---- creation (get-or-create, keyed on name + labels) ---------------
 
@@ -176,10 +187,27 @@ class MetricsRegistry:
         key = _label_key(name, labels)
         with self._lock:
             m = self._metrics.get(key)
+            if m is None and labels and (
+                    self._label_variants.get(name, 0) >=
+                    MAX_LABEL_VARIANTS):
+                # cardinality cap: fold this NEW variant into the
+                # overflow series (existing variants keep updating)
+                labels = {"overflow": "true"}
+                key = _label_key(name, labels)
+                m = self._metrics.get(key)
+                if name not in self._overflow_logged:
+                    self._overflow_logged.add(name)
+                    logger.warning(
+                        "metric %r reached %d label variants — further "
+                        "variants fold into its {overflow=\"true\"} "
+                        "series", name, MAX_LABEL_VARIANTS)
             if m is None:
                 m = cls(name, labels=labels, summary=summary, help=help,
                         **kw)
                 self._metrics[key] = m
+                if labels:
+                    self._label_variants[name] = (
+                        self._label_variants.get(name, 0) + 1)
             elif not isinstance(m, cls):
                 raise TypeError(
                     f"metric {key!r} already registered as {m.kind}, "
@@ -312,11 +340,17 @@ class MetricsRegistry:
             for key in [k for k, m in self._metrics.items()
                         if m.name.startswith(prefix)]:
                 del self._metrics[key]
+            for name in [n for n in self._label_variants
+                         if n.startswith(prefix)]:
+                del self._label_variants[name]
+                self._overflow_logged.discard(name)
 
     def reset(self) -> None:
         with self._lock:
             self._metrics.clear()
             self._providers.clear()
+            self._label_variants.clear()
+            self._overflow_logged.clear()
 
 
 REGISTRY = MetricsRegistry()

@@ -134,6 +134,29 @@ identically under pytest, a soak script, or a real cluster rehearsal:
                                 taken over the already-corrupt bytes and
                                 verify clean; only the recomputed
                                 fingerprint at restore can refuse it.
+``bigdl.chaos.oomStepAt``       k: the k-th tracked-step dispatch raises a
+                                realistic RESOURCE_EXHAUSTED allocation
+                                failure BEFORE executing (device state
+                                untouched, exactly like a real XLA OOM
+                                surfaced at dispatch) — the driver must
+                                classify it as a RESOURCE fault and
+                                answer with a microbatch re-plan, never
+                                a same-plan retry.  Once per plan.
+``bigdl.chaos.diskFullAt``      "k" or "k:substr", comma-separable
+                                ("2:checkpoints,1:compile_cache"): the
+                                k-th ``file_io.write_bytes`` whose path
+                                contains ``substr`` (every write when
+                                omitted) raises ENOSPC — disk-full
+                                degradation prey for the checkpoint
+                                manager, compile cache, and telemetry
+                                exporters.  Once per entry per plan.
+``bigdl.chaos.hostMemPressureAt``  k: the host-memory governor's k-th
+                                poll reports zero free bytes regardless
+                                of the accounted total — the registered
+                                shrinkers (ring depth halving, paused
+                                read-ahead) must fire and the batch
+                                stream must stay bit-identical.  Once
+                                per plan.
 ==============================  =============================================
 
 Counters are process-local and monotonically increasing from
@@ -200,6 +223,11 @@ class _ChaosState:
             config.get_property("bigdl.chaos.desyncReplicaAt"), 1)
         self.corrupt_save_at = config.get_int(
             "bigdl.chaos.corruptStateBeforeSaveAt", 0)
+        self.oom_step_at = config.get_int("bigdl.chaos.oomStepAt", 0)
+        self.disk_full_plan = _parse_disk_full(
+            config.get_property("bigdl.chaos.diskFullAt"))
+        self.host_pressure_at = config.get_int(
+            "bigdl.chaos.hostMemPressureAt", 0)
         self.writes = 0
         self.steps_failed = 0
         self.steps_seen = 0
@@ -224,6 +252,10 @@ class _ChaosState:
         self.bitflips = 0
         self.state_corruptions = 0
         self.captures = 0
+        self.step_dispatches = 0
+        self.oom_fired = 0
+        self.disk_full_fired = 0
+        self.pressure_fired = 0
         self._lock = threading.Lock()
 
     # ---- storage-layer hooks -------------------------------------------
@@ -497,6 +529,72 @@ class _ChaosState:
             self.stage_kills = 1
         return True
 
+    # ---- resource-exhaustion hooks -------------------------------------
+
+    def take_oom_dispatch(self, label: str) -> None:
+        """Called by ``CachedStep`` immediately before each executable
+        dispatch: the ``oomStepAt``-th dispatch raises a realistic
+        RESOURCE_EXHAUSTED allocation failure — the message replicates
+        what jaxlib's XlaRuntimeError carries, so the production
+        classifier cannot tell it from a real HBM OOM.  Raised BEFORE
+        execution: device state is untouched, exactly the real failure
+        mode.  Once per plan (the re-planned step runs clean)."""
+        if not self.oom_step_at:
+            return
+        with self._lock:
+            self.step_dispatches += 1
+            fire = (self.step_dispatches == self.oom_step_at and
+                    self.oom_fired == 0)
+            if fire:
+                self.oom_fired = 1
+        if fire:
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: Out of memory while trying to "
+                f"allocate 17179869184 bytes (chaos: injected device "
+                f"OOM on step {label!r} dispatch "
+                f"{self.step_dispatches})")
+
+    def take_disk_full(self, path: str) -> None:
+        """Called by ``file_io.write_bytes`` with each destination path
+        about to be written: each armed ``diskFullAt`` entry counts the
+        writes whose path contains its substring and raises a plain
+        ``OSError(ENOSPC)`` at its k-th match — the SAME raw error a
+        full disk produces, so the classification into
+        ``StorageExhaustedError`` is exercised, not bypassed.  Once per
+        entry per plan."""
+        if not self.disk_full_plan:
+            return
+        import errno
+        fire = False
+        with self._lock:
+            for entry in self.disk_full_plan:
+                if entry["fired"] or (entry["substr"] and
+                                      entry["substr"] not in path):
+                    continue
+                entry["count"] += 1
+                if entry["count"] >= entry["k"]:
+                    entry["fired"] = True
+                    self.disk_full_fired += 1
+                    fire = True
+                    break
+        if fire:
+            raise OSError(errno.ENOSPC,
+                          f"No space left on device (chaos: injected "
+                          f"disk-full writing {path})")
+
+    def host_mem_pressure(self, poll_index: int) -> bool:
+        """True when the governor's ``poll_index``-th poll should report
+        zero free bytes (injected host-memory pressure).  Once per
+        plan."""
+        if not self.host_pressure_at:
+            return False
+        with self._lock:
+            fire = (poll_index >= self.host_pressure_at and
+                    self.pressure_fired == 0)
+            if fire:
+                self.pressure_fired = 1
+        return fire
+
 
 class CorruptRecord(ChaosError):
     """An injected corrupt ingest record — a DATA fault: the taxonomy
@@ -648,6 +746,27 @@ def _corrupt_first_float(obj, _seen=None) -> bool:
     return False
 
 
+def _parse_disk_full(value):
+    """``"k"`` / ``"k:substr"``, comma-separable — one armed entry per
+    element, each with its own match counter and once-per-plan latch.
+    Falsy -> []."""
+    if not value:
+        return []
+    entries = []
+    for part in str(value).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            k, substr = part.split(":", 1)
+            entries.append({"k": int(k), "substr": substr.strip(),
+                            "count": 0, "fired": False})
+        else:
+            entries.append({"k": int(part), "substr": "",
+                            "count": 0, "fired": False})
+    return entries
+
+
 def _parse_kill(value) -> Tuple[Optional[str], int]:
     """``"stage"`` -> (stage, 1); ``"stage:k"`` -> (stage, k); falsy ->
     (None, 0)."""
@@ -788,6 +907,30 @@ def corrupt_state_before_save(obj):
     if _state is None:
         return obj
     return _state.corrupt_state_before_save(obj)
+
+
+def take_oom_dispatch(label: str) -> None:
+    """Tracked-step dispatch hook (no-op when disarmed): the
+    ``oomStepAt``-th dispatch raises a realistic RESOURCE_EXHAUSTED
+    before execution (once per plan)."""
+    if _state is not None:
+        _state.take_oom_dispatch(label)
+
+
+def take_disk_full(path: str) -> None:
+    """Payload-write hook (no-op when disarmed): armed ``diskFullAt``
+    entries raise a raw ``OSError(ENOSPC)`` at their k-th matching
+    write (once per entry)."""
+    if _state is not None:
+        _state.take_disk_full(path)
+
+
+def host_mem_pressure(poll_index: int) -> bool:
+    """Host-memory-governor poll hook (False when disarmed): True means
+    "report zero free bytes NOW" (once per plan)."""
+    if _state is None:
+        return False
+    return _state.host_mem_pressure(poll_index)
 
 
 def write_count() -> int:

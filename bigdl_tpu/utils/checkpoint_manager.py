@@ -272,6 +272,12 @@ class CheckpointManager:
                             config.get_bool("bigdl.checkpoint.asyncWrite",
                                             False))
         self._writer = _AsyncWriter() if self.async_write else None
+        #: disk-full degradation: once storage is exhausted (and an
+        #: emergency oldest-first GC could not free enough), snapshots
+        #: are kept in host memory only — newest one, restorable — and
+        #: no further disk writes are attempted
+        self._storage_degraded = False
+        self._memory_snapshot: Optional[Dict[str, Any]] = None
         #: manifest of the snapshot load_latest most recently restored
         self.last_loaded_manifest: Optional[Dict[str, Any]] = None
         #: topology decision of that load: "same", "reshard", or None
@@ -314,6 +320,81 @@ class CheckpointManager:
             self._write_snapshot_inner(blobs, neval, topology, fps)
 
     def _write_snapshot_inner(self, blobs: Dict[str, bytes], neval: int,
+                              topology: Optional[Dict[str, Any]] = None,
+                              fps: Optional[Dict[str, str]] = None
+                              ) -> None:
+        from bigdl_tpu.resources.errors import StorageExhaustedError
+        if self._storage_degraded:
+            self._keep_memory_snapshot(blobs, neval, topology, fps)
+            return
+        try:
+            self._write_snapshot_files(blobs, neval, topology, fps)
+            return
+        except StorageExhaustedError as e:
+            # the disk is full mid-save: free space oldest-first beyond
+            # keep_last and retry ONCE — retention is exactly the state
+            # the run can afford to lose
+            if self._emergency_gc():
+                try:
+                    self._write_snapshot_files(blobs, neval, topology, fps)
+                    logger.warning(
+                        "checkpoint storage exhausted at snapshot %d — "
+                        "emergency oldest-first GC freed space and the "
+                        "save landed", neval)
+                    return
+                except StorageExhaustedError as e2:
+                    e = e2
+            # no space to be found: degrade to in-memory-only snapshots
+            # (one warning + Resources/storage_degraded) — training NEVER
+            # crashes on a full disk
+            self._storage_degraded = True
+            from bigdl_tpu.resources import storage as _rstorage
+            _rstorage.note_degraded("checkpoints", e)
+            self._keep_memory_snapshot(blobs, neval, topology, fps)
+
+    def _keep_memory_snapshot(self, blobs: Dict[str, bytes], neval: int,
+                              topology: Optional[Dict[str, Any]],
+                              fps: Optional[Dict[str, str]]) -> None:
+        """Degraded mode: retain the newest snapshot as detached bytes in
+        host RAM (bounded to ONE — the blobs were already captured, so
+        this costs no extra serialization work)."""
+        self._memory_snapshot = {
+            "blobs": blobs, "neval": int(neval), "topology": topology,
+            "fps": dict(fps or {}),
+        }
+        telemetry.counter(
+            "Resources/memory_snapshots",
+            help="snapshots retained in RAM only (disk full)").inc()
+
+    def _emergency_gc(self) -> bool:
+        """Oldest-first deletion beyond ``keep_last`` (at least the
+        newest snapshot is always kept), regardless of whether retention
+        was configured — run only on storage exhaustion.  True when
+        anything was removed (worth retrying the save)."""
+        from bigdl_tpu.utils import file_io
+        keep = max(1, self.keep_last)
+        victims = self.candidates()[keep:]
+        removed = False
+        for n, has_manifest in reversed(victims):     # oldest first
+            names = ((f"commit.{n}", f"model.{n}", f"optimMethod.{n}",
+                      f"manifest.{n}") if has_manifest else
+                     (f"model.{n}", f"optimMethod.{n}"))
+            for name in names:      # commit first: never a committed
+                try:                # half-snapshot, even mid-crash
+                    file_io.remove(file_io.join(self.path, name))
+                    removed = True
+                except Exception as e:
+                    logger.warning(
+                        "emergency checkpoint GC could not remove %s: %r",
+                        name, e)
+        if removed:
+            telemetry.counter(
+                "Resources/emergency_gc",
+                help="emergency oldest-first checkpoint GCs on "
+                     "storage exhaustion").inc()
+        return removed
+
+    def _write_snapshot_files(self, blobs: Dict[str, bytes], neval: int,
                               topology: Optional[Dict[str, Any]] = None,
                               fps: Optional[Dict[str, str]] = None
                               ) -> None:
@@ -534,6 +615,9 @@ class CheckpointManager:
         restoring older state would masquerade as progress loss.  The
         manifest of the snapshot actually loaded (None for legacy pairs)
         is left in :attr:`last_loaded_manifest`."""
+        mem = self._restore_memory_snapshot(expected_topology)
+        if mem is not None:
+            return mem
         for n, has_manifest in self.candidates():
             try:
                 manifest = self._read_manifest(n) if has_manifest else None
@@ -568,6 +652,51 @@ class CheckpointManager:
                     "back to the next-older snapshot", n,
                     type(e).__name__, e)
         return None
+
+    def _restore_memory_snapshot(
+            self, expected_topology: Optional[Dict[str, Any]] = None
+            ) -> Optional[Tuple[Any, Any, int]]:
+        """The degraded-mode candidate: the in-RAM snapshot, taken only
+        when it is NEWER than every committed disk snapshot (a disk
+        snapshot that landed after degradation would be newer truth).
+        Fingerprint-verified like a disk restore; an unusable memory
+        snapshot falls back to the disk walk."""
+        mem = self._memory_snapshot
+        if mem is None:
+            return None
+        disk = self.candidates()
+        if disk and disk[0][0] >= mem["neval"]:
+            return None
+        n = mem["neval"]
+        try:
+            mode = "same"
+            if expected_topology is not None and mem.get("topology"):
+                from bigdl_tpu.utils import elastic
+                mode = elastic.check_restore_topology(
+                    mem["topology"], expected_topology)
+            fake_manifest = {"files": {
+                name: {"fingerprint": fp}
+                for name, fp in mem.get("fps", {}).items()}}
+            model = pickle.loads(mem["blobs"][f"model.{n}"])
+            self._check_fingerprint(f"model.{n}", model, fake_manifest)
+            optim = pickle.loads(mem["blobs"][f"optimMethod.{n}"])
+            self._check_fingerprint(f"optimMethod.{n}", optim,
+                                    fake_manifest)
+            self.last_loaded_manifest = None
+            self.last_restore_mode = mode
+            logger.warning(
+                "restoring snapshot %d from the in-memory store "
+                "(checkpoint storage is degraded — disk full)", n)
+            return model, optim, n
+        except Exception as e:
+            from bigdl_tpu.utils import elastic
+            if isinstance(e, (SnapshotSchemaError,
+                              elastic.TopologyMismatchError)):
+                raise
+            logger.warning(
+                "in-memory snapshot %d failed to restore (%s: %s) — "
+                "falling back to the disk walk", n, type(e).__name__, e)
+            return None
 
     # ---- retention ------------------------------------------------------
 

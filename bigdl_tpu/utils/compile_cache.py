@@ -308,6 +308,10 @@ class CompileCache:
         self.misses = 0
         self.errors = 0
         self.writes = 0
+        #: disk-full degradation latch: once storage is exhausted, every
+        #: further store is skipped up front (the PR 8 lock-loser path —
+        #: this process keeps serving executables from memory)
+        self.degraded = False
 
     @classmethod
     def from_config(cls) -> Optional["CompileCache"]:
@@ -429,6 +433,8 @@ class CompileCache:
         — with the executable still serving from memory — when the lock
         cannot be acquired within the backoff window or the write fails;
         a cache store must never fail a training run."""
+        if self.degraded:
+            return False    # disk already known full: memory-only mode
         try:
             os.makedirs(self.path, exist_ok=True)
             if not self._acquire_lock():
@@ -483,6 +489,15 @@ class CompileCache:
             finally:
                 self._release_lock()
         except Exception as e:
+            from bigdl_tpu.resources.errors import is_storage_exhausted
+            if is_storage_exhausted(e):
+                # the disk is full, not flaky: latch memory-only mode so
+                # every later signature skips the (pointless, multi-MB)
+                # store attempt — one structured warning for the run
+                self.degraded = True
+                from bigdl_tpu.resources import storage as _rstorage
+                _rstorage.note_degraded("compile_cache", e)
+                return False
             logger.warning(
                 "compile cache: store of entry %s failed (%s: %s) — "
                 "continuing with the in-memory executable", key,
@@ -690,7 +705,7 @@ class CachedStep:
         exe = self._mem.get(key)
         if exe is None:
             exe = self._compile_entry(args, key)
-        return exe(*args)
+        return self._dispatch(exe, args)
 
     def call_with_signature(self, args: Tuple, key):
         """Dispatch with a signature the caller already computed — the
@@ -701,7 +716,23 @@ class CachedStep:
         exe = self._mem.get(key)
         if exe is None:
             exe = self._compile_entry(args, key)
-        return exe(*args)
+        return self._dispatch(exe, args)
+
+    def _dispatch(self, exe, args: Tuple):
+        """Execute through the RESOURCE_EXHAUSTED classifier: a real XLA
+        allocation failure — or the ``oomStepAt`` injector's replica,
+        raised BEFORE execution so device state is untouched — surfaces
+        as the structured :class:`DeviceMemoryError` the driver's
+        microbatch re-plan keys on."""
+        try:
+            _chaos.take_oom_dispatch(self.label)
+            return exe(*args)
+        except Exception as e:
+            from bigdl_tpu.resources.device import classify_dispatch_error
+            err = classify_dispatch_error(e, self.label)
+            if err is not None:
+                raise err from e
+            raise
 
     def warmup(self, *args) -> None:
         """AOT: make sure the executable for this signature exists
@@ -796,6 +827,13 @@ class CachedStep:
                     topology=self.topology)
                 audit_summary = report.census.summary()
                 report.raise_or_warn()
+            # HBM preflight BEFORE the first dispatch (and before the
+            # store): with bigdl.resources.deviceMemBudgetMB set, a step
+            # whose peak-buffer estimate cannot fit raises the structured
+            # DeviceMemoryError while training state is still untouched —
+            # the driver answers with a microbatch re-plan
+            from bigdl_tpu.resources.device import preflight as _preflight
+            _preflight(exe, self.label)
             if (not loaded and self._cache is not None
                     and cache_key is not None):
                 self._store(cache_key, exe, sig_hash, fingerprint, _se,

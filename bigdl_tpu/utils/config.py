@@ -175,6 +175,21 @@ _DEFAULTS: Dict[str, Any] = {
     "bigdl.telemetry.profileOnSlowStep": None,  # dir: capture jax.profiler + timeline
     "bigdl.telemetry.mfu": False,          # estimate fused-step FLOPs -> MFU logging
     "bigdl.telemetry.peakTflops": None,    # chip peak for MFU% (None: log TFLOP/s)
+    "bigdl.telemetry.maxTimelineDumps": 8,  # timeline dump files per run
+    # (slow-step detector + watchdog), oldest-first eviction; 0 disables
+    # resource-exhaustion resilience (bigdl_tpu/resources): HBM preflight
+    # + microbatch backoff, host-memory governor, disk-full degradation
+    "bigdl.resources.deviceMemBudgetMB": 0,  # HBM budget per fused step;
+    # preflight + dispatch-OOM -> microbatch re-plan; 0 = preflight off
+    "bigdl.resources.hostMemBudgetMB": 0,  # soft host budget over all
+    # accounted rings/queues; breach shrinks depths; 0 = accounting only
+    # resource-exhaustion fault injection (utils/chaos.py)
+    "bigdl.chaos.oomStepAt": 0,        # k: k-th step dispatch raises a
+    # realistic RESOURCE_EXHAUSTED (once per plan)
+    "bigdl.chaos.diskFullAt": None,    # "k"/"k:substr" (comma-separable):
+    # the k-th write_bytes [matching substr] raises ENOSPC, once each
+    "bigdl.chaos.hostMemPressureAt": 0,  # k: governor poll k reports
+    # zero free bytes (once per plan) — shrinker/backpressure prey
 }
 
 _OVERRIDES: Dict[str, Any] = {}

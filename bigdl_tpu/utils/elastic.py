@@ -656,9 +656,13 @@ class HungStepWatchdog:
             logger.error("hung-step diagnostics: %s", diagnostics)
         if self.timeline_dir and telemetry.tracing_enabled():
             try:
-                os.makedirs(str(self.timeline_dir), exist_ok=True)
-                telemetry.export_chrome_trace(os.path.join(
-                    str(self.timeline_dir), "watchdog_timeline.json"))
+                # bounded (bigdl.telemetry.maxTimelineDumps, oldest-first
+                # eviction) and disk-full-guarded: a watchdog firing in a
+                # loop must not fill the disk with dump files
+                from bigdl_tpu.resources import storage as _rstorage
+                _rstorage.bounded_timeline_export(os.path.join(
+                    str(self.timeline_dir),
+                    f"watchdog_{self.fired}_timeline.json"))
             except Exception as e:  # diagnostics must not mask the abort
                 logger.warning("watchdog timeline dump failed: %r", e)
         if self.on_fire is not None:

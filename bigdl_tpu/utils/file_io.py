@@ -45,10 +45,22 @@ _NON_TRANSIENT = (FileNotFoundError, FileExistsError, IsADirectoryError,
 
 
 def _is_transient(e: BaseException) -> bool:
-    if getattr(e, "fatal", False):   # chaos "writer died" simulation
-        return False
+    if getattr(e, "fatal", False):   # chaos "writer died" simulation,
+        return False                 # storage exhaustion
     return (isinstance(e, (OSError, TimeoutError)) and
             not isinstance(e, _NON_TRANSIENT))
+
+
+def _reraise_classified(e: BaseException, path: str):
+    """Re-raise a write failure, folding raw ENOSPC/EDQUOT (real or
+    chaos-injected) into the structured ``StorageExhaustedError`` the
+    degradation paths key on.  A full disk is not a blip: the classified
+    error is ``fatal`` so the transient retry never absorbs it."""
+    from bigdl_tpu.resources.errors import (StorageExhaustedError,
+                                            is_storage_exhausted)
+    if not isinstance(e, StorageExhaustedError) and is_storage_exhausted(e):
+        raise StorageExhaustedError(path, e) from e
+    raise e
 
 
 def retrying(fn, *args, op: str = ""):
@@ -182,16 +194,17 @@ def _write_bytes_remote(path: str, data: bytes, overwrite: bool) -> None:
                 f.write(partial)
         raise
     try:
+        chaos.take_disk_full(path)
         with fs.open(tmp, "wb") as f:
             f.write(payload)
         fs.mv(tmp, p)
-    except BaseException:
+    except BaseException as e:
         try:
             if fs.exists(tmp):
                 fs.rm(tmp)
         except Exception:
             pass
-        raise
+        _reraise_classified(e, path)
 
 
 def write_bytes(path: str, data: bytes, overwrite: bool = True) -> None:
@@ -224,13 +237,19 @@ def write_bytes(path: str, data: bytes, overwrite: bool = True) -> None:
             os.unlink(tmp)
         raise
     try:
+        chaos.take_disk_full(path)
+    except BaseException as e:
+        os.close(fd)            # fdopen below never adopted it
+        os.unlink(tmp)
+        _reraise_classified(e, path)
+    try:
         with os.fdopen(fd, "wb") as f:
             f.write(payload)
         os.replace(tmp, path)
-    except BaseException:
+    except BaseException as e:
         if os.path.exists(tmp):
             os.unlink(tmp)
-        raise
+        _reraise_classified(e, path)
 
 
 def read_bytes(path: str) -> bytes:

@@ -1,0 +1,598 @@
+"""Resource-exhaustion resilience: the ISSUE 14 acceptance suite.
+
+Three exhaustion classes, each chaos-proven: device OOM answered by a
+microbatch re-plan (weight parity with the uninjected run, zero
+post-warmup retraces), disk-full degradation across checkpoints /
+compile cache / telemetry exports (training never crashes), and the
+host-memory governor (byte accounting, edge-triggered pressure,
+deterministic depth shrink).  The combined test at the bottom runs ALL
+three faults in ONE training run — the issue's acceptance gate.
+
+Parity tests use full-batch datasets (one iteration per epoch) so a
+replayed trajectory is bit-comparable to an uninterrupted one — the
+same protocol as ``test_chaos``.
+"""
+
+import errno
+import io
+import os
+
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+import bigdl_tpu.optim as optim
+from bigdl_tpu import telemetry
+from bigdl_tpu.dataset import LocalDataSet, SampleToMiniBatch
+from bigdl_tpu.dataset.datasets import synthetic_separable
+from bigdl_tpu.resources import (GOVERNOR, DeviceMemoryError,
+                                 HostMemoryError, StorageExhaustedError,
+                                 is_oom_error, is_storage_exhausted,
+                                 item_nbytes, storage)
+from bigdl_tpu.resources import device as rdevice
+from bigdl_tpu.resources import microbatch
+from bigdl_tpu.utils import chaos, config, file_io
+
+
+def _mlp(seed=11):
+    import jax
+    m = (nn.Sequential().add(nn.Linear(4, 16)).add(nn.Tanh())
+         .add(nn.Linear(16, 2)).add(nn.LogSoftMax()))
+    m.reset(jax.random.PRNGKey(seed))
+    return m
+
+
+def _full_batch_ds(samples):
+    return LocalDataSet(samples).transform(SampleToMiniBatch(len(samples)))
+
+
+def _train(samples, epochs, ckpt_dir=None, seed=11, ckpt_trigger=None):
+    model = _mlp(seed=seed)
+    opt = optim.Optimizer.create(model, _full_batch_ds(samples),
+                                 nn.ClassNLLCriterion())
+    opt.set_optim_method(optim.SGD(learning_rate=0.3, momentum=0.9))
+    opt.set_end_when(optim.max_epoch(epochs))
+    if ckpt_dir is not None:
+        opt.set_checkpoint(str(ckpt_dir),
+                           ckpt_trigger or optim.every_epoch())
+    opt.optimize()
+    w, _ = model.get_parameters()
+    return np.asarray(w), opt
+
+
+def _counter_value(name):
+    return telemetry.counter(name).value
+
+
+@pytest.fixture(autouse=True)
+def _resource_env():
+    """Zero retry sleeps; fresh governor/degradation/chaos state around
+    every test (all three are process-global)."""
+    config.set_property("bigdl.failure.retryTimeInterval", 0.0)
+    GOVERNOR.reset()
+    storage.reset()
+    yield
+    chaos.uninstall()
+    GOVERNOR.reset()
+    storage.reset()
+    for key in ("bigdl.failure.retryTimeInterval",
+                "bigdl.failure.retryTimes",
+                "bigdl.resources.deviceMemBudgetMB",
+                "bigdl.resources.hostMemBudgetMB",
+                "bigdl.chaos.oomStepAt", "bigdl.chaos.diskFullAt",
+                "bigdl.chaos.hostMemPressureAt",
+                "bigdl.telemetry.maxTimelineDumps",
+                "bigdl.compile.cacheDir"):
+        config.clear_property(key)
+
+
+# ---------------------------------------------------------------------------
+# microbatch planning math
+# ---------------------------------------------------------------------------
+
+
+class TestMicrobatchPlan:
+    def test_snap_k_smallest_divisor(self):
+        assert microbatch.snap_k(128, 3) == 4
+        assert microbatch.snap_k(12, 5) == 6
+        assert microbatch.snap_k(7, 2) == 7      # prime: straight to B
+        assert microbatch.snap_k(8, 99) == 8     # k clamps to B
+        assert microbatch.snap_k(16, 1) == 1
+
+    def test_next_k_doubling_schedule_terminates(self):
+        ks, k = [], 1
+        while True:
+            k = microbatch.next_k(12, k)
+            if k is None:
+                break
+            ks.append(k)
+        assert ks == [2, 4, 12], ks
+        assert microbatch.next_k(1, 1) is None   # nothing left to split
+
+    def test_scan_mean_matches_full_batch_mean(self):
+        import jax.numpy as jnp
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(12, 5).astype(np.float32))
+
+        def fn(chunk):
+            return {"m": jnp.mean(chunk * chunk, axis=0),
+                    "s": jnp.mean(jnp.tanh(chunk), axis=0)}
+
+        full = fn(x)
+        for k in (2, 3, 4, 6, 12):
+            out = microbatch.scan_mean(fn, x, k)
+            for key in full:
+                np.testing.assert_allclose(
+                    np.asarray(out[key]), np.asarray(full[key]),
+                    rtol=1e-6, atol=1e-7)
+
+    def test_scan_mean_preserves_integer_dtype(self):
+        """Module-state counters are integer leaves: equal-per-chunk
+        values must floor-divide back exactly, never promote to float
+        (a promoted carry would drift the re-planned step's signature)."""
+        import jax.numpy as jnp
+        x = jnp.ones((8, 3), np.float32)
+
+        def fn(chunk):
+            return jnp.full((), 7, jnp.int32)
+
+        out = microbatch.scan_mean(fn, x, 4)
+        assert out.dtype == jnp.int32
+        assert int(out) == 7
+
+
+# ---------------------------------------------------------------------------
+# device preflight
+# ---------------------------------------------------------------------------
+
+
+class _FakeMemAnalysis:
+    def __init__(self, arg, out, temp):
+        self.argument_size_in_bytes = arg
+        self.output_size_in_bytes = out
+        self.temp_size_in_bytes = temp
+
+
+class _FakeCompiled:
+    def __init__(self, arg=0, out=0, temp=0, broken=False):
+        self._ma = _FakeMemAnalysis(arg, out, temp)
+        self._broken = broken
+
+    def memory_analysis(self):
+        if self._broken:
+            raise RuntimeError("backend cannot report memory analysis")
+        return self._ma
+
+
+class TestDevicePreflight:
+    def test_preflight_off_without_budget(self):
+        assert rdevice.preflight(_FakeCompiled(1 << 40, 0, 0), "s") is None
+
+    def test_preflight_passes_under_budget(self):
+        config.set_property("bigdl.resources.deviceMemBudgetMB", 10)
+        peak = rdevice.preflight(_FakeCompiled(1 << 20, 1 << 20, 0), "s")
+        assert peak == 2 << 20
+
+    def test_preflight_breach_raises_structured(self):
+        config.set_property("bigdl.resources.deviceMemBudgetMB", 1)
+        with pytest.raises(DeviceMemoryError) as ei:
+            rdevice.preflight(_FakeCompiled(0, 0, 2 << 20), "fused")
+        e = ei.value
+        assert e.phase == "preflight" and e.label == "fused"
+        assert e.peak_bytes == 2 << 20 and e.budget_bytes == 1 << 20
+
+    def test_preflight_never_false_positive_when_unreportable(self):
+        config.set_property("bigdl.resources.deviceMemBudgetMB", 1)
+        assert rdevice.preflight(_FakeCompiled(broken=True), "s") is None
+
+    def test_classify_dispatch_error(self):
+        oom = RuntimeError("RESOURCE_EXHAUSTED: Out of memory while "
+                           "trying to allocate 17179869184 bytes")
+        err = rdevice.classify_dispatch_error(oom, "fused")
+        assert isinstance(err, DeviceMemoryError)
+        assert err.phase == "dispatch" and err.__cause__ is oom
+        assert rdevice.classify_dispatch_error(
+            ValueError("shape mismatch"), "fused") is None
+
+    def test_oom_marker_classifier(self):
+        assert is_oom_error(RuntimeError("OOM when allocating tensor"))
+        assert not is_oom_error(RuntimeError("divide by zero"))
+
+
+# ---------------------------------------------------------------------------
+# host-memory governor
+# ---------------------------------------------------------------------------
+
+
+class TestGovernor:
+    def test_account_clamped_ledger(self):
+        a = GOVERNOR.account("t_ring")
+        assert GOVERNOR.account("t_ring") is a     # idempotent
+        a.add(100)
+        a.sub(30)
+        assert a.nbytes == 70
+        a.sub(1000)                                # clamp, never negative
+        assert a.nbytes == 0
+        a.set(5)
+        assert a.nbytes == 5
+        b = GOVERNOR.account("t_window")
+        b.add(7)
+        assert GOVERNOR.total_bytes() == 12
+
+    def test_item_nbytes_estimates(self):
+        arr = np.zeros((4, 4), np.float32)
+        assert item_nbytes(arr) == 64
+        assert item_nbytes(b"abcd") == 4
+        assert item_nbytes("abc") == 3
+        assert item_nbytes(None) == 0
+        assert item_nbytes({"a": arr, "b": b"xy"}) == 66
+        assert item_nbytes([arr, [arr]]) == 128
+        deep = [[[[[arr]]]]]                       # past the depth cap
+        assert item_nbytes(deep) == 0
+
+    def test_free_bytes_sentinel_without_budget(self):
+        assert GOVERNOR.free_bytes() == 1 << 62
+        config.set_property("bigdl.resources.hostMemBudgetMB", 1)
+        GOVERNOR.account("t").add(1 << 19)
+        assert GOVERNOR.free_bytes() == (1 << 20) - (1 << 19)
+
+    def test_check_item_escalates_oversized_item(self):
+        GOVERNOR.check_item("t", 1 << 40)          # no budget: no-op
+        config.set_property("bigdl.resources.hostMemBudgetMB", 1)
+        GOVERNOR.check_item("t", 1 << 20)          # exactly at budget: ok
+        before = _counter_value("Resources/host_budget_exceeded")
+        with pytest.raises(HostMemoryError) as ei:
+            GOVERNOR.check_item("t_batch", (1 << 20) + 1)
+        e = ei.value
+        assert e.account == "t_batch" and e.budget_bytes == 1 << 20
+        assert _counter_value(
+            "Resources/host_budget_exceeded") == before + 1
+
+    def test_poll_edge_triggered_shrinkers(self):
+        """A sustained breach fires the shrinkers ONCE per excursion;
+        recovery re-arms the edge."""
+        config.set_property("bigdl.resources.hostMemBudgetMB", 1)
+        fired = []
+        GOVERNOR.register_shrinker("t", lambda: fired.append(1))
+        acct = GOVERNOR.account("t_ring")
+        acct.add(2 << 20)
+        assert GOVERNOR.poll() is True
+        assert GOVERNOR.under_pressure()
+        assert GOVERNOR.poll() is False            # still under: no re-fire
+        assert len(fired) == 1
+        acct.set(0)
+        assert GOVERNOR.poll() is False            # recovered
+        assert not GOVERNOR.under_pressure()
+        acct.add(2 << 20)
+        assert GOVERNOR.poll() is True             # second excursion
+        assert len(fired) == 2
+
+    def test_broken_shrinker_does_not_kill_the_poll(self):
+        config.set_property("bigdl.resources.hostMemBudgetMB", 1)
+
+        def bad():
+            raise RuntimeError("shrinker bug")
+
+        GOVERNOR.register_shrinker("bad", bad)
+        GOVERNOR.account("t").add(2 << 20)
+        assert GOVERNOR.poll() is True             # no propagation
+
+    def test_injected_pressure_fires_once_per_plan(self):
+        config.set_property("bigdl.chaos.hostMemPressureAt", 2)
+        chaos.install()
+        fired = []
+        GOVERNOR.register_shrinker("t", lambda: fired.append(1))
+        assert GOVERNOR.poll() is False            # poll 1: armed, quiet
+        assert GOVERNOR.poll() is True             # poll 2: injected
+        assert chaos._state.pressure_fired == 1
+        assert GOVERNOR.poll() is False            # once per plan
+        assert len(fired) == 1
+
+    def test_summary_scalars_roll_up(self):
+        GOVERNOR.account("t_ring").add(10)
+        GOVERNOR.account("t_window").add(5)
+        scalars = dict(GOVERNOR.summary_scalars())
+        assert scalars["Resources/host_bytes"] == 15.0
+        assert scalars["Resources/host_bytes_t_ring"] == 10.0
+        assert scalars["Resources/host_bytes_t_window"] == 5.0
+        assert "Resources/host_pressure_events" in scalars
+        # and the registry provider surfaces the same tags
+        tags = {t for t, _ in telemetry.REGISTRY.summary_scalars()}
+        assert "Resources/host_bytes" in tags
+
+
+# ---------------------------------------------------------------------------
+# chaos injectors
+# ---------------------------------------------------------------------------
+
+
+class TestChaosInjectors:
+    def test_parse_disk_full_plan(self):
+        assert chaos._parse_disk_full(None) == []
+        assert chaos._parse_disk_full("3") == [
+            {"k": 3, "substr": "", "count": 0, "fired": False}]
+        assert chaos._parse_disk_full("2:ckpt") == [
+            {"k": 2, "substr": "ckpt", "count": 0, "fired": False}]
+        assert chaos._parse_disk_full("2:checkpoints, 1:compile_cache") == [
+            {"k": 2, "substr": "checkpoints", "count": 0, "fired": False},
+            {"k": 1, "substr": "compile_cache", "count": 0, "fired": False}]
+
+    def test_take_oom_dispatch_once_at_k(self):
+        config.set_property("bigdl.chaos.oomStepAt", 3)
+        chaos.install()
+        chaos.take_oom_dispatch("s")
+        chaos.take_oom_dispatch("s")
+        with pytest.raises(RuntimeError) as ei:
+            chaos.take_oom_dispatch("s")
+        assert is_oom_error(ei.value), "must replicate the XLA message"
+        chaos.take_oom_dispatch("s")               # once per plan
+        assert chaos._state.oom_fired == 1
+        assert chaos._state.step_dispatches == 4
+
+    def test_take_disk_full_substring_matched(self):
+        config.set_property("bigdl.chaos.diskFullAt", "2:ckpt")
+        chaos.install()
+        chaos.take_disk_full("/tmp/other/file")    # no substring match
+        chaos.take_disk_full("/tmp/ckpt/model.1")  # match 1 of 2
+        with pytest.raises(OSError) as ei:
+            chaos.take_disk_full("/tmp/ckpt/optimMethod.1")
+        assert ei.value.errno == errno.ENOSPC
+        assert not isinstance(ei.value, StorageExhaustedError), \
+            "the injector must raise the RAW error so classification " \
+            "at the choke point is exercised, not bypassed"
+        assert is_storage_exhausted(ei.value)
+        chaos.take_disk_full("/tmp/ckpt/manifest.1")   # entry spent
+        assert chaos._state.disk_full_fired == 1
+
+    def test_disarmed_hooks_are_noops(self):
+        chaos.take_oom_dispatch("s")
+        chaos.take_disk_full("/tmp/x")
+        assert chaos.host_mem_pressure(99) is False
+
+
+# ---------------------------------------------------------------------------
+# disk-full degradation
+# ---------------------------------------------------------------------------
+
+
+class TestStorageDegradation:
+    def test_write_bytes_classifies_enospc(self, tmp_path):
+        config.set_property("bigdl.chaos.diskFullAt", "1")
+        chaos.install()
+        with pytest.raises(StorageExhaustedError) as ei:
+            file_io.write_bytes(str(tmp_path / "payload"), b"x" * 64)
+        e = ei.value
+        assert e.fatal is True and e.errno == errno.ENOSPC
+        assert "payload" in e.path
+        # the torn temp never commits
+        assert not (tmp_path / "payload").exists()
+
+    def test_note_degraded_once_semantics(self):
+        before = _counter_value("Resources/storage_degraded"
+                                "{component=checkpoints}")
+        err = OSError(errno.ENOSPC, "No space left on device")
+        assert storage.note_degraded("checkpoints", err) is True
+        assert storage.note_degraded("checkpoints", err) is False
+        assert storage.is_degraded("checkpoints")
+        assert storage.is_degraded()
+        assert not storage.is_degraded("compile_cache")
+        assert "checkpoints" in storage.degraded_components()
+        assert telemetry.counter(
+            "Resources/storage_degraded",
+            labels={"component": "checkpoints"}).value == before + 1
+
+    def test_guarded_export_degrades_and_skips(self):
+        ran = []
+        assert storage.guarded_export("telemetry", lambda: ran.append(1))
+        assert ran == [1]
+
+        def full():
+            raise OSError(errno.ENOSPC, "No space left on device")
+
+        assert storage.guarded_export("telemetry", full) is False
+        assert storage.is_degraded("telemetry")
+        # degraded: the export is skipped without even calling fn
+        assert storage.guarded_export("telemetry",
+                                      lambda: ran.append(2)) is False
+        assert ran == [1]
+
+    def test_guarded_export_propagates_non_storage_errors(self):
+        def boom():
+            raise ValueError("not a disk problem")
+
+        with pytest.raises(ValueError):
+            storage.guarded_export("telemetry", boom)
+        assert not storage.is_degraded("telemetry")
+
+    def test_bounded_timeline_export_evicts_oldest(self, tmp_path):
+        config.set_property("bigdl.telemetry.maxTimelineDumps", 3)
+        paths = [str(tmp_path / f"dump_{i}.json") for i in range(5)]
+        for p in paths:
+            assert storage.bounded_timeline_export(p) is True
+        assert storage.timeline_dump_count() == 3
+        survivors = sorted(os.listdir(tmp_path))
+        assert survivors == ["dump_2.json", "dump_3.json", "dump_4.json"]
+
+    def test_bounded_timeline_export_cap_zero_disables(self, tmp_path):
+        config.set_property("bigdl.telemetry.maxTimelineDumps", 0)
+        assert storage.bounded_timeline_export(
+            str(tmp_path / "d.json")) is False
+        assert os.listdir(tmp_path) == []
+
+    def test_checkpoint_degrades_to_memory_snapshot(self, tmp_path):
+        """Disk fills during snapshot 2: the save must NOT crash, disk
+        restore must land on the newest PRE-ENOSPC snapshot, and
+        load_latest must prefer the newer in-RAM snapshot."""
+        from bigdl_tpu.optim.optimizer import Checkpoint
+        ckpt = Checkpoint(str(tmp_path), optim.every_epoch())
+        m, sgd = _mlp(), optim.SGD(learning_rate=0.1)
+        ckpt.save(m, sgd, 1)
+        config.set_property("bigdl.chaos.diskFullAt", "1:model.2")
+        chaos.install()
+        ckpt.save(m, sgd, 2)                       # degrades, no crash
+        assert chaos._state.disk_full_fired == 1
+        assert storage.is_degraded("checkpoints")
+        _, _, n = ckpt.latest()
+        assert n == 1, "disk restore must land on the pre-ENOSPC snapshot"
+        # the degraded-mode RAM snapshot is newer and wins load_latest
+        restored = ckpt.manager.load_latest()
+        assert restored is not None and restored[2] == 2
+        # further saves stay in-memory, still no crash, no new files
+        names_before = sorted(os.listdir(tmp_path))
+        ckpt.save(m, sgd, 3)
+        assert sorted(os.listdir(tmp_path)) == names_before
+        assert ckpt.manager.load_latest()[2] == 3
+
+
+# ---------------------------------------------------------------------------
+# microbatch backoff: injected device OOM -> re-plan -> weight parity
+# ---------------------------------------------------------------------------
+
+
+class TestMicrobatchBackoff:
+    def test_oom_replan_reaches_weight_parity(self, tmp_path):
+        """The tentpole's core claim: a device OOM at step k is answered
+        by a microbatch re-plan (k accumulation chunks, Kahan mean), the
+        run finishes, the weights are allclose to the uninjected run,
+        and the re-planned program never trips the strict retrace gate."""
+        samples = synthetic_separable(128, 4, n_classes=2, seed=7)
+        w_clean, _ = _train(samples, epochs=4)
+
+        replans_before = _counter_value("Resources/microbatch_replans")
+        config.set_property("bigdl.chaos.oomStepAt", 2)
+        chaos.install()
+        w_chaos, opt = _train(samples, epochs=4,
+                              ckpt_dir=tmp_path / "ckpt",
+                              ckpt_trigger=optim.several_iteration(1))
+        assert chaos._state.oom_fired == 1, "the injected OOM never fired"
+        assert opt._microbatch_k > 1, "the driver never re-planned"
+        np.testing.assert_allclose(w_chaos, w_clean, rtol=1e-5, atol=1e-7)
+        sent = opt._retrace_sentinel
+        assert sent is not None and sent.retraces == 0, \
+            "the re-planned program must register as a FRESH signature"
+        assert _counter_value(
+            "Resources/microbatch_replans") >= replans_before + 1
+
+    def test_oom_without_split_left_is_fatal(self):
+        """Per-sample already (B == 1): no further split exists, so the
+        structured DeviceMemoryError must surface, not loop."""
+        samples = synthetic_separable(1, 4, n_classes=2, seed=7)
+        config.set_property("bigdl.chaos.oomStepAt", 1)
+        config.set_property("bigdl.failure.retryTimes", 2)
+        chaos.install()
+        model = _mlp()
+        opt = optim.Optimizer.create(model, _full_batch_ds(samples),
+                                     nn.ClassNLLCriterion())
+        opt.set_optim_method(optim.SGD(learning_rate=0.3))
+        opt.set_end_when(optim.max_epoch(2))
+        with pytest.raises(DeviceMemoryError):
+            opt.optimize()
+
+
+# ---------------------------------------------------------------------------
+# governor depth shrink: deterministic batch stream
+# ---------------------------------------------------------------------------
+
+
+def _png_records(n=12, hw=(40, 48), seed=3):
+    from PIL import Image
+    from bigdl_tpu.dataset.image import LabeledImageBytes
+    rng = np.random.RandomState(seed)
+    recs = []
+    for i in range(n):
+        img = rng.randint(0, 256, size=hw + (3,)).astype(np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(img).save(buf, "PNG")
+        recs.append(LabeledImageBytes(f"r{i}", float(i % 5 + 1),
+                                      buf.getvalue()))
+    return recs
+
+
+class TestGovernorShrinkDeterminism:
+    def test_mid_epoch_depth_shrink_keeps_batches_bit_identical(self):
+        """Injected pressure mid-stream halves the ingest ring depths;
+        the emitted batch stream must stay BIT-identical — backpressure
+        may change timing, never data."""
+        from bigdl_tpu.dataset.ingest import StreamingIngest
+
+        def _eng():
+            # deterministic decode (center crop, no flip): any payload
+            # difference is then attributable to the shrink, not RNG
+            return StreamingIngest(4, crop=(32, 32), decode_workers=2,
+                                   random_crop=False, hflip=False)
+
+        recs = _png_records(n=16)
+        clean = [(b.get_input().copy(), b.get_target().copy())
+                 for b in _eng()(iter(recs))]
+        assert len(clean) == 4
+
+        GOVERNOR.reset()
+        config.set_property("bigdl.chaos.hostMemPressureAt", 2)
+        chaos.install()
+        eng2 = _eng()
+        shrunk = [(b.get_input().copy(), b.get_target().copy())
+                  for b in eng2(iter(recs))]
+        assert chaos._state.pressure_fired == 1, \
+            "the injected pressure excursion never fired"
+        assert len(shrunk) == len(clean)
+        for (xi, yi), (xc, yc) in zip(shrunk, clean):
+            np.testing.assert_array_equal(xi, xc)
+            np.testing.assert_array_equal(yi, yc)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance gate: ALL THREE faults in ONE run
+# ---------------------------------------------------------------------------
+
+
+class TestCombinedChaos:
+    def test_one_run_survives_all_three_exhaustion_faults(self, tmp_path):
+        """ISSUE 14 acceptance: one training run takes a device OOM at
+        step 2, a full disk during BOTH a checkpoint snapshot and a
+        compile-cache store, and an injected host-memory pressure
+        excursion — and still completes with weight parity against the
+        uninjected run, zero post-warmup retraces, the ``Resources/*``
+        counters firing for every fault class, and no crash."""
+        samples = synthetic_separable(128, 4, n_classes=2, seed=7)
+        w_clean, _ = _train(samples, epochs=6)
+
+        pressure_before = _counter_value("Resources/host_pressure")
+        oom_before = _counter_value("Resources/device_oom")
+        replans_before = _counter_value("Resources/microbatch_replans")
+
+        GOVERNOR.reset()
+        config.set_property("bigdl.chaos.oomStepAt", 2)
+        # snapshot 1's writes land in .../ckpt; the SECOND matching
+        # write (optimMethod.1) hits the full disk -> checkpoint manager
+        # degrades to the in-RAM snapshot; the FIRST write into the
+        # compile-cache dir degrades the cache to memory-only
+        config.set_property("bigdl.chaos.diskFullAt",
+                            "2:ckpt,1:compile_cache")
+        config.set_property("bigdl.chaos.hostMemPressureAt", 3)
+        config.set_property("bigdl.compile.cacheDir",
+                            str(tmp_path / "compile_cache"))
+        chaos.install()
+
+        w_chaos, opt = _train(samples, epochs=6,
+                              ckpt_dir=tmp_path / "ckpt",
+                              ckpt_trigger=optim.several_iteration(1))
+
+        st = chaos._state
+        assert st.oom_fired == 1, "device OOM never fired"
+        assert st.disk_full_fired >= 1, "disk-full never fired"
+        assert st.pressure_fired == 1, "host pressure never fired"
+
+        # every fault class left its structured trace
+        assert storage.is_degraded("checkpoints")
+        assert _counter_value("Resources/device_oom") >= oom_before + 1
+        assert _counter_value(
+            "Resources/microbatch_replans") >= replans_before + 1
+        assert _counter_value(
+            "Resources/host_pressure") >= pressure_before + 1
+
+        # ... and the run itself is unharmed
+        np.testing.assert_allclose(w_chaos, w_clean, rtol=1e-5, atol=1e-7)
+        assert opt._microbatch_k > 1
+        sent = opt._retrace_sentinel
+        assert sent is not None and sent.retraces == 0, \
+            f"post-warmup retraces after the re-plan: {sent.last_diff}"
