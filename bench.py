@@ -583,6 +583,77 @@ def bench_ingest(batch: int = 128, out_path: str = None):
              f"starve {snap['starve_s']}s, backpressure "
              f"{snap['backpressure_s']}s, mean queue "
              f"{snap['mean_queue_depth']}")
+    # acceptance bar: on a multi-core host the pipelined engine must
+    # sustain >= 0.8x the measured ceiling.  The ceiling is the slowest
+    # stage when the cores can truly overlap the stages, and the
+    # cpu-bound rate when they cannot (effective = min of the two); a
+    # 1-core host has no overlap to win, so the bar is informational.
+    if cores > 1:
+        assert stream_rate >= 0.8 * effective, (
+            f"streaming ingest {stream_rate:,.0f} img/s is below 0.8x the "
+            f"effective ceiling {effective:,.0f} img/s (slowest stage "
+            f"{slowest:,.0f}, cpu-bound {cpu_bound:,.0f}) on {cores} cores")
+
+    # stage 5: decoded-epoch cache — same records with the cache enabled.
+    # Epoch 1 decodes and fills the segment ring; epoch 2 skips JPEG
+    # decode entirely (frames come back from RAM).  Then a governor
+    # pressure excursion is injected and must shrink the cache's
+    # accounted bytes (the budget authority stays in charge).
+    from bigdl_tpu.resources import GOVERNOR
+    from bigdl_tpu.utils import chaos, config
+    config.set_property("bigdl.ingest.epochCache", True)
+    try:
+        eng_c = StreamingIngest(batch)
+        t0 = time.time()
+        n_ep1 = sum(b.size() for b in eng_c(iter(records)))
+        cache_ep1 = n_ep1 / (time.time() - t0)
+        t0 = time.time()
+        n_ep2 = sum(b.size() for b in eng_c(iter(records)))
+        cache_ep2 = n_ep2 / (time.time() - t0)
+        cache_stats = eng_c.epoch_cache.stats()
+        acct = f"ingest_epoch_cache:{eng_c.name}"
+        cache_bytes = dict(GOVERNOR.summary_scalars()).get(
+            f"Resources/host_bytes_{acct}", 0.0)
+        # injected host-memory pressure -> the governor's shrinkers fire
+        # -> the cache evicts RAM segments and its account drops
+        config.set_property("bigdl.chaos.hostMemPressureAt", 1)
+        chaos.install()
+        try:
+            GOVERNOR.poll()
+        finally:
+            chaos.uninstall()
+            config.clear_property("bigdl.chaos.hostMemPressureAt")
+        shrunk_bytes = dict(GOVERNOR.summary_scalars()).get(
+            f"Resources/host_bytes_{acct}", 0.0)
+        _log(f"  epoch cache: epoch1 {cache_ep1:,.0f} img/s (fill), epoch2 "
+             f"{cache_ep2:,.0f} img/s ({cache_ep2 / cache_ep1:.2f}x), "
+             f"{cache_stats['hits']} hits / {cache_stats['misses']} misses, "
+             f"{cache_bytes / 1e6:,.1f} MB cached; injected pressure "
+             f"shrank to {shrunk_bytes / 1e6:,.1f} MB")
+        assert cache_stats["hits"] > 0, "epoch 2 never hit the epoch cache"
+        assert cache_bytes > 0, "epoch cache bytes invisible to the governor"
+        assert shrunk_bytes < cache_bytes, (
+            "injected governor pressure did not shrink the epoch cache")
+        # the 2x bar only exists where decode was actually the bottleneck
+        # (the other stages must have >= 2x headroom over decode)
+        if cores > 1 and decode_rate <= 0.5 * min(read_rate, assemble_rate):
+            assert cache_ep2 >= 2.0 * cache_ep1, (
+                f"cached epoch 2 {cache_ep2:,.0f} img/s is under 2x the "
+                f"decode-bound epoch 1 {cache_ep1:,.0f} img/s")
+        epoch_cache_record = {
+            "epoch1_imgs_per_sec": round(cache_ep1, 1),
+            "epoch2_imgs_per_sec": round(cache_ep2, 1),
+            "epoch2_vs_epoch1": round(cache_ep2 / cache_ep1, 3),
+            "hits": cache_stats["hits"],
+            "misses": cache_stats["misses"],
+            "ram_segments": cache_stats["ram_segments"],
+            "governor_account": acct,
+            "cache_bytes": int(cache_bytes),
+            "cache_bytes_after_pressure": int(shrunk_bytes),
+        }
+        eng_c.epoch_cache.close()
+    finally:
+        config.clear_property("bigdl.ingest.epochCache")
 
     record = {
         "metric": "mt_ingest_imgs_per_sec",
@@ -604,6 +675,12 @@ def bench_ingest(batch: int = 128, out_path: str = None):
         "ingest_vs_slowest_stage": round(stream_rate / slowest, 3),
         "ingest_vs_cpu_bound": round(stream_rate / cpu_bound, 3),
         "ingest_vs_effective_ceiling": round(stream_rate / effective, 3),
+        # the acceptance bar asserted above (>= 0.8x effective ceiling on
+        # a multi-core host), recorded so regressions are diffable
+        "ingest_bar": {"threshold": 0.8,
+                       "asserted": cores > 1,
+                       "ratio": round(stream_rate / effective, 3)},
+        "epoch_cache": epoch_cache_record,
         "engine_stages": stages,
         "native_assembler": native_available(),
         "host_cores": cores,
@@ -947,6 +1024,21 @@ def bench_realdata(batch: int = 128, steps: int = 20, warmup: int = 4,
          f"(drift x{drift:.2f}); transfer-bound ceiling "
          f"[{bounds[0]:,.1f}, {bounds[1]:,.1f}] img/s; uint8 e2e "
          f"sustained {med_u8:,.1f}")
+    # drift flag: when the link moved more than 25% between the pre/post
+    # roofline samples, the bracket no longer pins the regime the
+    # training iterations saw — a mean-vs-median gap can then be the
+    # LINK moving, not iteration stalls, and scoring against either
+    # single sample is blind.  The flag rides next to the side-by-side
+    # mean/median report so a reader (or a regression diff) can't take
+    # the ceiling ratio at face value on a flagged run.
+    drift_flagged = (abs(drift - 1.0) > 0.25
+                     or not (bounds[0] * 0.9 <= med_u8 <= bounds[1] * 1.1))
+    _log(f"  throughput report: f32 stall-inclusive mean {rate_f32:,.1f} / "
+         f"sustained median {med_f32:,.1f}; u8 stall-inclusive mean "
+         f"{rate_u8:,.1f} / sustained median {med_u8:,.1f} img/s; link "
+         f"drift x{drift:.2f}"
+         + (" [DRIFT FLAGGED: ceiling bracket unreliable]"
+            if drift_flagged else ""))
     stages = {"seqfile_read_recs_per_sec": round(read_rate, 1),
               "jpeg_decode_imgs_per_sec": round(decode_rate, 1),
               "native_assemble_imgs_per_sec": round(assemble_rate, 1),
@@ -980,6 +1072,20 @@ def bench_realdata(batch: int = 128, steps: int = 20, warmup: int = 4,
               "train_f32_upload_imgs_per_sec": round(rate_f32, 1),
               "train_u8_sustained_median_imgs_per_sec": round(med_u8, 1),
               "sustained_median_imgs_per_sec": round(best_med, 1),
+              # stall-inclusive mean AND sustained median, side by side
+              # per upload layout, with the link-drift flag that says
+              # whether the ceiling bracket can be trusted for this run
+              "throughput_report": {
+                  "f32": {"stall_inclusive_mean_imgs_per_sec":
+                              round(rate_f32, 1),
+                          "sustained_median_imgs_per_sec":
+                              round(med_f32, 1)},
+                  "u8": {"stall_inclusive_mean_imgs_per_sec":
+                             round(rate_u8, 1),
+                         "sustained_median_imgs_per_sec":
+                             round(med_u8, 1)},
+                  "upload_link_drift": round(drift, 3),
+                  "drift_flagged": drift_flagged},
               # the uint8 leg's sustained median scored against both
               # roofline samples' ceilings: inside (or above) the
               # bracket = the framework delivers whatever the drifting

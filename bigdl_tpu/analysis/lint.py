@@ -128,6 +128,21 @@ Rules
     ``AllReduceParameter``), so the declared contract and the source
     stay greppably in sync.  The allowlist stays empty.
 
+``host-augment-in-hot-path``
+    In the dataset package's hot-path modules (``bigdl_tpu/dataset/``),
+    per-pixel host augmentation calls — ``cv2.resize`` / ``cv2.flip`` /
+    ``cv2.warpAffine`` / ``cv2.cvtColor`` / ``cv2.normalize`` & co.,
+    ``np.flip`` / ``fliplr`` / ``flipud`` / ``rot90``, or a PIL-style
+    ``.crop(...)`` method call.  The real-data hot path ships raw uint8
+    frames and runs crop/flip/normalize/ColorJitter on device
+    (``nn.DeviceAugment`` + ``dataset/device_augment.py``) — host
+    augmentation silently drifting back in re-pins the decode pool as
+    the bottleneck.  The DECLARED host-fallback modules are exempt:
+    ``dataset/image.py`` (the reference host transformer library) and
+    ``dataset/mt_batch.py`` (the synchronous MT path + the mixed-shape
+    pre-crop fallback).  (``cv2.imdecode`` is decode, not augmentation,
+    and is never flagged.)
+
 Silencing: append ``# lint: allow(<rule-name>)`` to the offending line,
 or list ``<relpath>:<rule-name>`` in an allowlist file (one per line,
 ``#`` comments) — the CI gate keeps the repo allowlist empty, so every
@@ -209,6 +224,19 @@ BUFFER_CTORS_NP = {"empty", "zeros"}
 ACCOUNTING_CALLS = {"account", "item_nbytes", "check_item", "_charge",
                     "_slot_nbytes"}
 
+#: dataset hot-path scope for the host-augmentation rule; the declared
+#: host-fallback modules (reference host transformer library + the
+#: synchronous MT path with its mixed-shape pre-crop) are exempt
+DATASET_SCOPE = os.path.join("dataset", "")
+HOST_AUGMENT_FALLBACK_FILES = (os.path.join("dataset", "image.py"),
+                               os.path.join("dataset", "mt_batch.py"))
+#: per-pixel augmentation calls that belong on device (nn.DeviceAugment)
+HOST_AUGMENT_CV2 = {"resize", "flip", "warpAffine", "warpPerspective",
+                    "cvtColor", "GaussianBlur", "copyMakeBorder",
+                    "normalize", "rotate"}
+HOST_AUGMENT_NP = {"flip", "fliplr", "flipud", "rot90"}
+HOST_AUGMENT_METHODS = {"crop"}         # PIL Image.crop
+
 _ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([a-z0-9_,\- ]+)\)")
 
 #: every rule the linter can emit — the CLI validates --rule against it
@@ -217,6 +245,7 @@ KNOWN_RULES = frozenset({
     "signal-handler-in-hot-path", "jnp-dtype-drop", "untracked-jit",
     "undeclared-collective", "unguarded-io-in-stage-thread",
     "unbounded-queue-in-serving", "unaccounted-buffer-in-stage",
+    "host-augment-in-hot-path",
     "bare-except", "swallowed-exception",
     "blocking-under-lock", "lock-order", "syntax",
 })
@@ -652,6 +681,42 @@ def _handler_swallows(handler: ast.ExceptHandler) -> bool:
     return all(isinstance(n, (ast.Pass, ast.Continue)) for n in body)
 
 
+def _rule_host_augment(path: str, rel: str, tree: ast.AST) -> List[Finding]:
+    """Per-pixel augmentation calls in dataset hot-path modules: the
+    real-data path ships raw uint8 frames and augments on device, so a
+    cv2/numpy crop/flip/normalize call drifting into ``dataset/``
+    outside the declared host-fallback modules re-pins the decode pool
+    as the bottleneck — silently, which is why this is a lint rule and
+    not a code-review note."""
+    if DATASET_SCOPE not in rel:
+        return []
+    if any(rel.endswith(t) for t in HOST_AUGMENT_FALLBACK_FILES):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        qual = _qualifier(node)
+        flagged = None
+        if qual == "cv2" and name in HOST_AUGMENT_CV2:
+            flagged = f"cv2.{name}(...)"
+        elif qual in ("np", "numpy") and name in HOST_AUGMENT_NP:
+            flagged = f"{qual}.{name}(...)"
+        elif (isinstance(node.func, ast.Attribute) and
+                name in HOST_AUGMENT_METHODS):
+            flagged = f".{name}(...)"
+        if flagged:
+            out.append(Finding(
+                rel, node.lineno, "host-augment-in-hot-path",
+                f"{flagged} is per-pixel host augmentation on the "
+                "ingest hot path — run it on device (nn.DeviceAugment /"
+                " dataset/device_augment.py) or move the code into a "
+                "declared host-fallback module (dataset/image.py, "
+                "dataset/mt_batch.py)"))
+    return out
+
+
 def _rule_exceptions(path: str, rel: str, tree: ast.AST) -> List[Finding]:
     out: List[Finding] = []
     threaded = any(rel.endswith(t) for t in THREADED_FILES)
@@ -863,6 +928,7 @@ def lint_paths(targets: Sequence[str],
                          _rule_unguarded_io(path, rel, tree) +
                          _rule_unbounded_queue(path, rel, tree) +
                          _rule_unaccounted_buffer(path, rel, tree) +
+                         _rule_host_augment(path, rel, tree) +
                          _rule_exceptions(path, rel, tree))
         if any(rel.endswith(t) for t in THREADED_FILES):
             lv = _LockVisitor(rel)

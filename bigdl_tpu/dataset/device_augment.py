@@ -1,0 +1,97 @@
+"""On-device augmentation for the real-data hot path (ISSUE 16).
+
+The host MT path (``mt_batch.assemble_batch_u8``) spends its decode
+threads on crop + flip + HWC->CHW transpose — per-pixel work that the
+chip does for free inside the fused step.  In device-augment mode the
+ingest pipeline packs FULL decoded uint8 frames (one cheap ``np.stack``
+memcpy) plus two tiny ride-along tensors — the per-record crop offsets
+and flip flags drawn from the SAME clone-and-commit RNG stream as the
+host path — and these transforms run on device:
+
+``crop_flip_transpose``
+    vmapped ``lax.dynamic_slice`` crop + ``where``-select flip +
+    NHWC->NCHW transpose over the uint8 batch.  Operation-for-operation
+    identical to the host fallback (``im[oy:oy+ch, ox:ox+cw]``,
+    ``patch[:, ::-1]``, ``patch.transpose(2, 0, 1)``) on the same bytes
+    with the same draws, so trained-weight bit-parity against the host
+    path is provable (test_prefetch_determinism.py asserts it).
+
+``color_jitter``
+    optional per-record brightness/contrast/saturation jitter keyed by
+    ride-along int32 seeds drawn from the clone-and-commit stream —
+    replays reproduce bit-exactly.  OFF by default (the host reference
+    path has no jitter, so parity only holds with it disabled).
+
+No function here calls ``jax.jit``: the transforms trace into the
+tracked fused step (compile_cache.tracked_jit) like any other module
+apply, keeping the one-registered-jit-entry-point invariant.  All
+shapes are static per (batch, crop) configuration, so the strict
+retrace sentinel stays quiet after warmup.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["crop_flip_transpose", "color_jitter"]
+
+
+def crop_flip_transpose(frames, offsets, flips, crop_h, crop_w):
+    """Crop + horizontal-flip + NHWC->NCHW transpose on device.
+
+    frames:  (N, H, W, C) uint8 full decoded frames
+    offsets: (N, 2) int32 ``(oy, ox)`` crop origins (host-drawn)
+    flips:   (N,) uint8 flip flags (host-drawn)
+    returns  (N, C, crop_h, crop_w) uint8
+
+    Bit-exact mirror of the host path on the same inputs: dynamic_slice
+    with host-validated in-bounds origins never clamps, the flip is the
+    same ``[:, ::-1]`` reversal, and uint8 survives every step untouched.
+    """
+    channels = frames.shape[-1]
+
+    def one(frame, off, flip):
+        patch = lax.dynamic_slice(
+            frame, (off[0], off[1], jnp.int32(0)),
+            (crop_h, crop_w, channels))
+        patch = jnp.where(flip.astype(jnp.bool_), patch[:, ::-1, :], patch)
+        return jnp.transpose(patch, (2, 0, 1))
+
+    return jax.vmap(one)(frames, offsets.astype(jnp.int32),
+                         flips.astype(jnp.uint8))
+
+
+def color_jitter(images, seeds, brightness=0.0, contrast=0.0,
+                 saturation=0.0):
+    """Per-record ColorJitter over a uint8 NCHW (BGR) batch.
+
+    images: (N, C, H, W) uint8, BGR channel order (cv2 decode layout)
+    seeds:  (N,) int32 per-record keys, drawn from the clone-and-commit
+            stream by the packer so a replayed batch jitters identically
+    Each factor is sampled uniformly from ``[1 - x, 1 + x]``; zero
+    disables that leg at trace time (no dead ops in the HLO).  Output is
+    rounded, clipped to [0, 255], and returned as uint8 so the module
+    chain (DeviceAugment -> ChannelNormalize) is unchanged.
+    """
+
+    def one(img, seed):
+        key = jax.random.PRNGKey(seed)
+        kb, kc, ks = jax.random.split(key, 3)
+        x = img.astype(jnp.float32)
+        if brightness:
+            x = x * jax.random.uniform(
+                kb, (), minval=1.0 - brightness, maxval=1.0 + brightness)
+        if contrast:
+            factor = jax.random.uniform(
+                kc, (), minval=1.0 - contrast, maxval=1.0 + contrast)
+            mean = jnp.mean(x, keepdims=True)
+            x = mean + (x - mean) * factor
+        if saturation:
+            factor = jax.random.uniform(
+                ks, (), minval=1.0 - saturation, maxval=1.0 + saturation)
+            # BGR luma: channel 0 is blue, 2 is red.
+            gray = (0.114 * x[0] + 0.587 * x[1] + 0.299 * x[2])[None]
+            x = gray + (x - gray) * factor
+        return jnp.clip(jnp.round(x), 0, 255).astype(jnp.uint8)
+
+    return jax.vmap(one)(images, seeds.astype(jnp.int32))

@@ -72,6 +72,14 @@ Configuration (``bigdl.ingest.*``, see ``utils/config.py``):
 ``bigdl.ingest.maxStageRestarts``dead-stage restarts before escalation
 ``bigdl.ingest.fallbackOnFailure`` dead engine → sync path mid-epoch
 ``bigdl.ingest.stallTimeoutSec`` wedged-ring detection window (0 = off)
+``bigdl.ingest.deviceAugment``   pack FULL u8 frames + ride-along crop
+                                 offsets/flips; crop/flip/transpose runs
+                                 on device (``nn.DeviceAugment``)
+``bigdl.ingest.autoscale.*``     supervisor-driven decode/assemble worker
+                                 scaling (:class:`AutoscalePolicy`)
+``bigdl.ingest.epochCache*``     decoded-frame cache across epochs
+                                 (``dataset/epoch_cache.py``)
+``bigdl.ingest.zeroCopyUpload``  dlpack handoff at ``engine.to_device``
 ===============================  =============================================
 """
 
@@ -83,6 +91,7 @@ import threading
 import time
 import weakref
 from collections import deque
+from concurrent import futures
 from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -239,9 +248,17 @@ class _StageSupervisor:
 
     def __init__(self, max_restarts: int, stall_timeout: float,
                  diagnose, rings: Sequence["_Ring"],
-                 run_stats: Optional[dict] = None):
+                 run_stats: Optional[dict] = None,
+                 autoscale=None, autoscale_interval: float = 0.25):
         self.max_restarts = max(0, int(max_restarts))
         self.stall_timeout = float(stall_timeout)
+        #: autoscale tick: called every ``autoscale_interval`` from the
+        #: monitor loop (restart + scaling share one supervisor — the
+        #: stage-lifecycle authority).  A failing tick disables itself
+        #: rather than killing a working engine.
+        self._autoscale = autoscale
+        self._autoscale_interval = max(0.01, float(autoscale_interval))
+        self._autoscale_due = time.monotonic() + self._autoscale_interval
         self._diagnose = diagnose          # () -> stats dict, for errors
         #: THIS run's StageStats (progress source for the stall check —
         #: the engine-wide diagnose merge would let a sibling shard
@@ -341,6 +358,19 @@ class _StageSupervisor:
             st["thread"] = st["factory"]()
         if self.stall_timeout > 0:
             self._check_stall()
+        if self._autoscale is not None:
+            now = time.monotonic()
+            if now >= self._autoscale_due:
+                self._autoscale_due = now + self._autoscale_interval
+                try:
+                    self._autoscale()
+                except BaseException as e:
+                    # scaling is an optimization, never a failure mode:
+                    # a tick that cannot act (thread exhaustion on a
+                    # spawn, …) logs once and stops trying
+                    logger.warning(
+                        "ingest autoscaler disabled after error: %r", e)
+                    self._autoscale = None
 
     def _check_stall(self) -> None:
         waiting = self.consumer_waiting_since
@@ -408,6 +438,11 @@ class StageStats:
         with self._lock:
             self._occ_sum += depth
             self._occ_n += 1
+
+    def stall_seconds(self) -> Tuple[float, float]:
+        """(starve_s, backpressure_s) — the autoscaler's raw signals."""
+        with self._lock:
+            return self.starve_s, self.backpressure_s
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -533,6 +568,169 @@ class _Ring:
                 self._charge(item, -1)
         except queue.Empty:
             pass
+
+
+class _DecodePool:
+    """Resizable decode worker pool — the stage autoscaler's actuator.
+
+    ``concurrent.futures.ThreadPoolExecutor`` can grow its pool but
+    never shrink it; the autoscaler needs both directions.  Workers
+    pull ``(future, fn, args)`` tickets from an internal queue and
+    resolve real :class:`concurrent.futures.Future` objects, so every
+    call site written against the executor API (``submit``,
+    ``Future.result``, ``shutdown(cancel_futures=True)``) works
+    unchanged — including the assembler's dead-decode-worker resubmit
+    path, which observes exceptions through the future exactly as with
+    the executor.  ``set_workers`` retires surplus workers
+    cooperatively: each worker re-checks the target between tickets and
+    exits when the pool is over target; a mid-decode worker finishes
+    its ticket first, so no decode is ever abandoned by a scale-down.
+
+    The ticket queue is unbounded by construction but bounded in
+    practice: the assembler's decode window (``decoded_ring_depth``,
+    governor-shrinkable) is the only submitter and never holds more
+    than ``window`` tickets in flight."""
+
+    def __init__(self, workers: int, thread_name_prefix: str = "decode"):
+        self._tickets: "queue.Queue" = queue.Queue()
+        self._prefix = thread_name_prefix
+        self._lock = threading.Lock()
+        self._target = max(1, int(workers))
+        self._alive = 0
+        self._seq = 0
+        self._shutdown = False
+        for _ in range(self._target):
+            self._spawn()
+
+    def _spawn(self) -> None:
+        with self._lock:
+            self._alive += 1
+            self._seq += 1
+            name = f"{self._prefix}-{self._seq}"
+        t = threading.Thread(target=self._worker, daemon=True, name=name)
+        t.start()
+
+    def _worker(self) -> None:
+        while True:
+            with self._lock:
+                if self._shutdown or self._alive > self._target:
+                    self._alive -= 1
+                    return
+            try:
+                ticket = self._tickets.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            fut, fn, args = ticket
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(fn(*args))
+            except BaseException as e:
+                # surfaces at Future.result() on the assembler — same
+                # taxonomy routing as the executor path
+                fut.set_exception(e)
+
+    @property
+    def workers(self) -> int:
+        return self._target
+
+    def set_workers(self, n: int) -> int:
+        """Resize toward ``n`` (floor 1); returns the new target.
+        Growth spawns immediately; shrink is cooperative (workers exit
+        between tickets, never mid-decode)."""
+        n = max(1, int(n))
+        with self._lock:
+            if self._shutdown:
+                return self._target
+            grow = n - self._target
+            self._target = n
+        for _ in range(grow):
+            self._spawn()
+        return n
+
+    def submit(self, fn, *args) -> "futures.Future":
+        fut: "futures.Future" = futures.Future()
+        self._tickets.put((fut, fn, args))
+        return fut
+
+    def shutdown(self, wait: bool = False,
+                 cancel_futures: bool = False) -> None:
+        with self._lock:
+            self._shutdown = True
+        if cancel_futures:
+            try:
+                while True:
+                    fut, _fn, _args = self._tickets.get_nowait()
+                    fut.cancel()
+            except queue.Empty:
+                pass
+
+
+class AutoscalePolicy:
+    """Deterministic hysteresis policy for ingest stage autoscaling.
+
+    Pure state machine — no clocks, no randomness: a fixed sequence of
+    signal samples always produces the same action sequence (asserted
+    by tests/test_ingest.py), so autoscaling can never make a run
+    nondeterministic in anything but wall-clock.
+
+    Per :meth:`decide` call (one per ``bigdl.ingest.autoscale.
+    intervalSec`` interval), the signals are the assemble stage's
+    starve and backpressure FRACTIONS over the interval just ended:
+    starve = the assembler waited on decode (the scale-UP signal),
+    backpressure = the batch ring was full, i.e. the consumer is the
+    bottleneck and more decode workers cannot help (a scale-DOWN
+    signal).  ``patience`` consecutive same-direction signals are
+    required before acting; after an action the policy holds for
+    ``cooldown`` intervals so the new worker count's effect is measured
+    before the next decision.  The host-memory governor is the upper-
+    bound authority: under pressure the policy never scales up and
+    steps down toward the floor."""
+
+    def __init__(self, min_workers: int, max_workers: int,
+                 up_starve_frac: float, down_starve_frac: float,
+                 patience: int, cooldown: int):
+        self.min_workers = max(1, int(min_workers))
+        self.max_workers = max(self.min_workers, int(max_workers))
+        self.up_starve_frac = float(up_starve_frac)
+        self.down_starve_frac = float(down_starve_frac)
+        self.patience = max(1, int(patience))
+        self.cooldown = max(0, int(cooldown))
+        self._up_streak = 0
+        self._down_streak = 0
+        self._hold = 0
+
+    def decide(self, starve_frac: float, backpressure_frac: float,
+               workers: int, under_pressure: bool = False) -> int:
+        """One interval's decision: +1 add a worker, -1 retire one, 0
+        hold."""
+        if self._hold > 0:
+            self._hold -= 1
+            return 0
+        down = (workers > self.min_workers and
+                (under_pressure or
+                 starve_frac <= self.down_starve_frac or
+                 backpressure_frac >= self.up_starve_frac))
+        up = (not down and not under_pressure and
+              workers < self.max_workers and
+              starve_frac >= self.up_starve_frac)
+        if up:
+            self._up_streak += 1
+            self._down_streak = 0
+        elif down:
+            self._down_streak += 1
+            self._up_streak = 0
+        else:
+            self._up_streak = self._down_streak = 0
+        if self._up_streak >= self.patience:
+            self._up_streak = 0
+            self._hold = self.cooldown
+            return 1
+        if self._down_streak >= self.patience:
+            self._down_streak = 0
+            self._hold = self.cooldown
+            return -1
+        return 0
 
 
 class ShardedSeqFileReader:
@@ -726,6 +924,8 @@ class StreamingIngest(Transformer):
                  std: Sequence[float] = (1.0, 1.0, 1.0),
                  random_crop: bool = True, hflip: bool = True,
                  device_normalize: bool = False,
+                 device_augment: Optional[bool] = None,
+                 device_jitter: bool = False,
                  decode_workers: Optional[int] = None,
                  record_ring_depth: Optional[int] = None,
                  decoded_ring_depth: Optional[int] = None,
@@ -735,7 +935,9 @@ class StreamingIngest(Transformer):
                  max_bad_records: Optional[int] = None,
                  max_stage_restarts: Optional[int] = None,
                  fallback_on_failure: Optional[bool] = None,
-                 stall_timeout: Optional[float] = None):
+                 stall_timeout: Optional[float] = None,
+                 autoscale: Optional[bool] = None,
+                 epoch_cache: Optional[bool] = None):
         if name is None:
             with _NAME_LOCK:
                 name = f"ingest{_NAME_SEQ[0]}"
@@ -748,6 +950,18 @@ class StreamingIngest(Transformer):
         self.mean, self.std = mean, std
         self.random_crop, self.hflip = random_crop, hflip
         self.device_normalize = device_normalize
+        # device_augment: pack FULL uint8 NHWC frames plus ride-along
+        # crop offsets/flips (drawn host-side from the clone-and-commit
+        # stream, so parity with the host path is provable) and leave
+        # crop/flip/transpose to nn.DeviceAugment inside the fused step.
+        # Implies the uint8-upload layout: pair with nn.ChannelNormalize.
+        self.device_augment = (
+            device_augment if device_augment is not None
+            else config.get_bool("bigdl.ingest.deviceAugment", False))
+        # device_jitter: additionally ride along one int32 ColorJitter
+        # seed per record, drawn from the same stream (breaks host-path
+        # bit-parity by design — the host path has no jitter)
+        self.device_jitter = bool(device_jitter)
         cores = max(1, os.cpu_count() or 1)
         self.decode_workers = (decode_workers if decode_workers is not None
                                else config.get_int("bigdl.ingest.decodeWorkers",
@@ -775,6 +989,31 @@ class StreamingIngest(Transformer):
         self.stall_timeout = (
             stall_timeout if stall_timeout is not None
             else config.get_float("bigdl.ingest.stallTimeoutSec", 0.0))
+        self.autoscale = (
+            autoscale if autoscale is not None
+            else config.get_bool("bigdl.ingest.autoscale.enabled", True))
+        #: live worker counts per stage (the Ingest/<stage>/workers
+        #: gauges) and the autoscaler's action counters — mutated by the
+        #: supervisor tick, read by summary_scalars and the driver's
+        #: end-of-run decomposition log
+        self.stage_workers = {"decode": self.decode_workers,
+                              "assemble": self.assemble_threads}
+        self.autoscale_events = {"up": 0, "down": 0}
+        #: decoded-epoch cache, engine-lifetime (epoch 2 is a second run
+        #: of the SAME transformer instance — the cache must outlive runs)
+        use_cache = (epoch_cache if epoch_cache is not None
+                     else config.get_bool("bigdl.ingest.epochCache", False))
+        self.epoch_cache = None
+        if use_cache:
+            from bigdl_tpu.dataset.epoch_cache import DecodedEpochCache
+            self.epoch_cache = DecodedEpochCache(
+                name=self.name,
+                cache_dir=config.get_property(
+                    "bigdl.ingest.epochCacheDir"),
+                budget_mb=config.get_int(
+                    "bigdl.ingest.epochCacheBudgetMB", 0),
+                segment_records=config.get_int(
+                    "bigdl.ingest.epochCacheSegmentRecords", 256))
         # per-run stage stats: a ShardedDataSet applies ONE transformer
         # instance to every shard, so several runs can be live at once —
         # each run appends its own dict and stats() merges them
@@ -882,11 +1121,11 @@ class StreamingIngest(Transformer):
 
     def __call__(self, it: Iterator) -> Iterator:
         import logging
-        from concurrent.futures import ThreadPoolExecutor
         from bigdl_tpu.dataset.mt_batch import (MTLabeledBGRImgToBatch,
                                                 _check_crop_fits,
                                                 assemble_batch,
-                                                assemble_batch_u8)
+                                                assemble_batch_u8,
+                                                crop_flip_host)
         from bigdl_tpu.dataset.sample import MiniBatch
         from bigdl_tpu.utils import chaos, file_io
         from bigdl_tpu.utils.random_generator import RandomGenerator
@@ -970,8 +1209,9 @@ class StreamingIngest(Transformer):
                            producer=stats["assemble"],
                            consumer=stats["consume"],
                            account=bat_acct, sizer=_bat_nbytes)
-        pool = ThreadPoolExecutor(self.decode_workers,
-                                  thread_name_prefix="ingest-decode")
+        pool = _DecodePool(self.decode_workers,
+                           thread_name_prefix="ingest-decode")
+        epoch_cache = self.epoch_cache
         ch, cw = self.crop
 
         # shared stage state: everything a RESTARTED stage thread needs to
@@ -984,6 +1224,7 @@ class StreamingIngest(Transformer):
                "done": False,        # upstream exhausted / error queued
                "aborted": False,     # teardown stop observed mid-wait
                "imgs": [], "recs": [], "offsets": [], "flips": [],
+               "seeds": [],          # ride-along ColorJitter keys (jitter on)
                "items": 0,           # records fully handled (chaos kill key)
                "decode_restarts": 0}
         asm_done = [False]
@@ -997,6 +1238,7 @@ class StreamingIngest(Transformer):
                 while True:
                     if chaos.kill_stage_thread("reader", rd["index"]):
                         return          # silent death — supervisor's job
+                    chaos.starve_stage("read", rd["index"])
                     t0 = time.monotonic()
                     try:
                         rec = next(it)
@@ -1030,19 +1272,25 @@ class StreamingIngest(Transformer):
                 record_ring.put(e, stop)
                 rd_done[0] = True
 
-        def timed_decode(idx: int, data: bytes) -> np.ndarray:
+        def timed_decode(idx: int, rec) -> np.ndarray:
             if chaos.kill_stage_thread("decode", idx):
                 raise _StageKilledError(
                     f"decode worker died at record {idx}")
+            chaos.starve_stage("decode", idx)
             t0 = time.monotonic()
             chaos.on_decode(idx)
-            try:
-                img = MTLabeledBGRImgToBatch._decode(data)
-            except Exception as e:
-                # junk bytes, not junk machinery: quarantinable
-                raise IngestDataError(
-                    f"undecodable image at stream position {idx}: "
-                    f"{e!r}") from e
+            key = getattr(rec, "name", None)
+            img = epoch_cache.get(key) if epoch_cache is not None else None
+            if img is None:
+                try:
+                    img = MTLabeledBGRImgToBatch._decode(rec.bytes)
+                except Exception as e:
+                    # junk bytes, not junk machinery: quarantinable
+                    raise IngestDataError(
+                        f"undecodable image at stream position {idx}: "
+                        f"{e!r}") from e
+                if epoch_cache is not None:
+                    epoch_cache.put(key, img)
             t1 = time.monotonic()
             stats["decode"].add(items=1, busy_s=t1 - t0)
             telemetry.add_span_s("ingest/decode", t0, t1)
@@ -1077,7 +1325,7 @@ class StreamingIngest(Transformer):
                 idx, rec = item
                 _dec_charge(rec, +1)
                 pending.append((idx, rec,
-                                pool.submit(timed_decode, idx, rec.bytes)))
+                                pool.submit(timed_decode, idx, rec)))
 
         def pack_batch() -> Tuple["MiniBatch", int, float]:
             """The ONE batch-packing path (native assemble + labels)
@@ -1091,7 +1339,24 @@ class StreamingIngest(Transformer):
             t0 = time.monotonic()
             offs = np.asarray(asm["offsets"], np.int32).reshape(len(imgs), 2)
             fl = np.asarray(asm["flips"], np.uint8)
-            if self.device_normalize:
+            if self.device_augment:
+                # ship FULL uint8 frames + the ride-along draws; the
+                # per-pixel crop/flip/transpose belongs to
+                # nn.DeviceAugment inside the fused step.  One np.stack
+                # memcpy when the batch's source frames share a shape;
+                # a mixed-shape batch pre-crops on the declared host
+                # fallback (crop_flip_host) and ships identity
+                # ride-alongs — same trained weights either way.
+                if len({im.shape for im in imgs}) == 1:
+                    frames = np.stack(imgs)
+                else:
+                    frames = crop_flip_host(imgs, self.crop, offs, fl)
+                    offs = np.zeros_like(offs)
+                    fl = np.zeros_like(fl)
+                x = [frames, offs, fl]
+                if self.device_jitter:
+                    x.append(np.asarray(asm["seeds"], np.int32))
+            elif self.device_normalize:
                 x = assemble_batch_u8(imgs, self.crop, offs, fl,
                                       n_threads=self.assemble_threads)
             else:
@@ -1134,6 +1399,12 @@ class StreamingIngest(Transformer):
             asm["recs"].append(rec)
             asm["offsets"].append((oy, ox))
             asm["flips"].append(fl)
+            if self.device_jitter:
+                # the per-record ColorJitter key rides the same clone-
+                # and-commit stream: an extra draw AFTER crop/flip, so
+                # it is replay-deterministic (and intentionally not
+                # host-path-parity — the host path has no jitter)
+                asm["seeds"].append(drawer.random_int(0, 2 ** 31 - 1))
             return True
 
         def emit() -> bool:
@@ -1147,7 +1418,7 @@ class StreamingIngest(Transformer):
                 # on a teardown-aborted put the DRAWN batch stays in the
                 # shared lists: the fallback drain re-emits it with its
                 # already-drawn offsets/flips instead of losing it
-                for key in ("imgs", "recs", "offsets", "flips"):
+                for key in ("imgs", "recs", "offsets", "flips", "seeds"):
                     asm[key].clear()
             return ok
 
@@ -1158,6 +1429,7 @@ class StreamingIngest(Transformer):
                 while True:
                     if chaos.kill_stage_thread("assembler", asm["items"]):
                         return          # silent death — supervisor's job
+                    chaos.starve_stage("assemble", asm["items"])
                     fill(block=True)
                     if asm["aborted"]:
                         asm_done[0] = True   # orderly teardown exit
@@ -1195,8 +1467,7 @@ class StreamingIngest(Transformer):
                             asm["decode_restarts"], self.max_stage_restarts)
                         _dec_charge(rec, +1)
                         pending.appendleft(
-                            (idx, rec, pool.submit(timed_decode, idx,
-                                                   rec.bytes)))
+                            (idx, rec, pool.submit(timed_decode, idx, rec)))
                         continue
                     except BaseException as e:
                         if _is_data_error(e):
@@ -1233,10 +1504,63 @@ class StreamingIngest(Transformer):
                 return t
             return factory
 
+        autoscale_tick = None
+        if self.autoscale:
+            cores = max(1, os.cpu_count() or 1)
+            as_max = config.get_int("bigdl.ingest.autoscale.max", 0) or cores
+            policy = AutoscalePolicy(
+                min_workers=config.get_int("bigdl.ingest.autoscale.min", 1),
+                max_workers=as_max,
+                up_starve_frac=config.get_float(
+                    "bigdl.ingest.autoscale.upStarveFrac", 0.2),
+                down_starve_frac=config.get_float(
+                    "bigdl.ingest.autoscale.downStarveFrac", 0.02),
+                patience=config.get_int("bigdl.ingest.autoscale.patience",
+                                        2),
+                cooldown=config.get_int("bigdl.ingest.autoscale.cooldown",
+                                        3))
+            prev = {"starve": 0.0, "backpressure": 0.0,
+                    "t": time.monotonic()}
+
+            def autoscale_tick() -> None:
+                """One supervisor-cadence decision: per-interval deltas
+                of the assemble stage's stall counters become fractions
+                of the interval, the pure policy decides, the pool (and
+                the native assembler's thread count, in tandem) acts."""
+                starve, bp = stats["assemble"].stall_seconds()
+                now = time.monotonic()
+                dt = max(now - prev["t"], 1e-9)
+                starve_frac = (starve - prev["starve"]) / dt
+                bp_frac = (bp - prev["backpressure"]) / dt
+                prev.update(starve=starve, backpressure=bp, t=now)
+                delta = policy.decide(starve_frac, bp_frac, pool.workers,
+                                      _governor.under_pressure())
+                if not delta:
+                    return
+                n = pool.set_workers(pool.workers + delta)
+                self.assemble_threads = n
+                self.stage_workers["decode"] = n
+                self.stage_workers["assemble"] = n
+                direction = "up" if delta > 0 else "down"
+                self.autoscale_events[direction] += 1
+                telemetry.counter(
+                    f"Ingest/autoscale_{direction}",
+                    labels={"stage": "decode"}, summary=True,
+                    help="ingest worker-scaling actions taken by the "
+                         "stage autoscaler").inc()
+                logger.info(
+                    "ingest '%s' autoscale %s: decode/assemble workers "
+                    "-> %d (starve %.2f, backpressure %.2f of interval)",
+                    self.name, direction, n, starve_frac, bp_frac)
+
         sup = _StageSupervisor(self.max_stage_restarts, self.stall_timeout,
                                diagnose=self.stats,
                                rings=[record_ring, batch_ring],
-                               run_stats=stats)
+                               run_stats=stats,
+                               autoscale=autoscale_tick,
+                               autoscale_interval=config.get_float(
+                                   "bigdl.ingest.autoscale.intervalSec",
+                                   0.25))
         self.supervisor = sup
         sup.register("reader", _thread_factory(reader, "ingest-reader"),
                      rd_done)
@@ -1362,7 +1686,7 @@ class StreamingIngest(Transformer):
                 # yield itself, so the RNG position commits here
                 batch, n, pack_s = pack_batch()
                 stats["assemble"].add(items=n, busy_s=pack_s)
-                for key in ("imgs", "recs", "offsets", "flips"):
+                for key in ("imgs", "recs", "offsets", "flips", "seeds"):
                     asm[key].clear()
                 if primary:
                     shared_rng.np.set_state(drawer.np.get_state())
@@ -1376,7 +1700,7 @@ class StreamingIngest(Transformer):
 
             for idx, rec in _sync_record_source():
                 try:
-                    img = timed_decode(idx, rec.bytes)
+                    img = timed_decode(idx, rec)
                 except BaseException as e:
                     if _is_data_error(e):
                         quarantine.admit("decode", idx, rec.name, e)
@@ -1488,6 +1812,18 @@ def summary_scalars():
             if snap["mean_queue_depth"]:
                 out.append((f"{prefix}/{stage}/queue_depth",
                             snap["mean_queue_depth"]))
+        # per-stage worker gauges + autoscale action counters (ISSUE 16:
+        # the driver decomposition log and charts must show what the
+        # autoscaler actually did, not just its throughput effect)
+        for stage, n in eng.stage_workers.items():
+            out.append((f"{prefix}/{stage}/workers", n))
+        for direction, n in eng.autoscale_events.items():
+            if n:
+                out.append((f"{prefix}/autoscale_{direction}", n))
+        if eng.epoch_cache is not None:
+            cache = eng.epoch_cache.stats()
+            out.append((f"{prefix}/epoch_cache_hits", cache["hits"]))
+            out.append((f"{prefix}/epoch_cache_misses", cache["misses"]))
         # self-healing series surface only once they are nonzero: a
         # clean run's charts stay exactly as before.  Summed over every
         # ACTIVE run — a multi-shard pipeline must not report just the

@@ -142,6 +142,34 @@ def assemble_batch_u8(images: Sequence[np.ndarray],
     return out
 
 
+def crop_flip_host(images: Sequence[np.ndarray],
+                   crop: Tuple[int, int],
+                   offsets: np.ndarray,
+                   flips: np.ndarray) -> np.ndarray:
+    """Host-fallback leg of device-augment ingest: apply crop + flip on
+    the host and return a UNIFORM (N, crop_h, crop_w, C) uint8 NHWC
+    stack.  Full-frame packing needs one static frame shape per batch;
+    when a batch mixes source sizes the packer pre-crops here (this
+    module is a declared host-fallback for the ``host-augment-in-hot-
+    path`` lint rule) and ships identity ride-alongs — zero offsets,
+    zero flips — so ``nn.DeviceAugment`` reduces to the NHWC->NCHW
+    transpose and the trained weights stay bit-identical."""
+    _check_crop_fits(images, crop)
+    ch, cw = crop
+    n = len(images)
+    channels = images[0].shape[2] if images[0].ndim == 3 else 1
+    out = np.empty((n, ch, cw, channels), np.uint8)
+    for i, im in enumerate(images):
+        if im.ndim != 3:
+            im = im[:, :, None]
+        oy, ox = int(offsets[i, 0]), int(offsets[i, 1])
+        patch = im[oy:oy + ch, ox:ox + cw]
+        if flips[i]:
+            patch = patch[:, ::-1]
+        out[i] = patch
+    return out
+
+
 class MTLabeledBGRImgToBatch(Transformer):
     """Compressed byte records → training MiniBatches, multi-threaded.
 

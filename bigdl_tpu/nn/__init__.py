@@ -51,7 +51,7 @@ from bigdl_tpu.nn.structural import (Identity, Echo, Contiguous, Reshape,
                                      MM, MV,
                                      DotProduct, Pack, Reverse,
                                      MulConstant, AddConstant,
-                                     ChannelNormalize)
+                                     ChannelNormalize, DeviceAugment)
 from bigdl_tpu.nn.table import (Concat, ConcatTable, ParallelTable, MapTable,
                                 JoinTable, SplitTable, SelectTable,
                                 NarrowTable, FlattenTable, MixtureTable,

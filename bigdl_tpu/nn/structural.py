@@ -632,6 +632,44 @@ class ChannelNormalize(Module):
         return out, state
 
 
+class DeviceAugment(Module):
+    """On-device crop/flip(/ColorJitter) head for device-augment ingest
+    (ISSUE 16): consumes the ``[frames_u8_NHWC, offsets_i32, flips_u8]``
+    (optionally ``+ [jitter_seeds_i32]``) input list that
+    ``StreamingIngest`` packs in ``deviceAugment`` mode and emits the
+    uint8 NCHW crop batch the host MT path would have produced — the
+    per-pixel crop/flip/transpose work moves off the decode threads and
+    into the fused step, so only raw full frames plus a few bytes of
+    ride-along metadata cross the host->device link.  Place it first,
+    ahead of ``ChannelNormalize``.  The crop offsets and flip flags are
+    host-drawn from the clone-and-commit RNG stream, so trained weights
+    are bit-identical to the host path (asserted in
+    test_prefetch_determinism.py).  ``color_jitter`` is a dict of
+    ``brightness``/``contrast``/``saturation`` factors; it requires the
+    packer's ride-along seeds and breaks host-path parity by design."""
+
+    layout_role = "spatial"
+
+    def __init__(self, crop_h, crop_w, color_jitter=None, name=None):
+        super().__init__(name)
+        self.crop_h = int(crop_h)
+        self.crop_w = int(crop_w)
+        self.color_jitter = dict(color_jitter) if color_jitter else None
+
+    def apply(self, params, input, state, training=False, rng=None):
+        from bigdl_tpu.dataset import device_augment as _aug
+        if not isinstance(input, (list, tuple)) or len(input) < 3:
+            # Already-assembled NCHW batch (host path): pass through so
+            # one model definition serves both ingest modes.
+            return input, state
+        frames, offsets, flips = input[0], input[1], input[2]
+        out = _aug.crop_flip_transpose(frames, offsets, flips,
+                                       self.crop_h, self.crop_w)
+        if self.color_jitter and len(input) > 3:
+            out = _aug.color_jitter(out, input[3], **self.color_jitter)
+        return out, state
+
+
 class AddConstant(Module):
     """Add a scalar constant (reference ``nn/AddConstant.scala``)."""
 

@@ -1331,10 +1331,28 @@ class Optimizer:
             for stage, snap in eng.stats().items():
                 logger.info(
                     "Ingest %s stage %s: %d items, %.1f/s, busy %.1fs, "
-                    "starve %.1fs, backpressure %.1fs", eng.name, stage,
+                    "starve %.1fs, backpressure %.1fs, workers %d",
+                    eng.name, stage,
                     snap["items"], snap["throughput_per_sec"],
                     snap["busy_s"], snap["starve_s"],
-                    snap["backpressure_s"])
+                    snap["backpressure_s"],
+                    eng.stage_workers.get(stage, 1))
+            ups, downs = (eng.autoscale_events["up"],
+                          eng.autoscale_events["down"])
+            if ups or downs:
+                logger.info(
+                    "Ingest %s autoscaler: %d scale-up(s), %d "
+                    "scale-down(s), final decode workers %d", eng.name,
+                    ups, downs, eng.stage_workers["decode"])
+            if eng.epoch_cache is not None:
+                cache = eng.epoch_cache.stats()
+                logger.info(
+                    "Ingest %s epoch cache: %d hit(s), %d miss(es), "
+                    "%d RAM + %d disk segment(s), %.1f MB RAM, "
+                    "%d corrupt, %d evicted", eng.name, cache["hits"],
+                    cache["misses"], cache["ram_segments"],
+                    cache["disk_segments"], cache["ram_bytes"] / 2 ** 20,
+                    cache["corrupt_segments"], cache["evicted_segments"])
         # where the step time went, one line (the full series is in the
         # Telemetry/* scalars and the telemetry.json snapshot)
         acct = step_account.summary()

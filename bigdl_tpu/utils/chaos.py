@@ -73,6 +73,14 @@ identically under pytest, a soak script, or a real cluster rehearsal:
                                 item (default 1) — no error surfaced, no
                                 done flag: exactly the failure the stage
                                 supervisor must detect and restart.
+``bigdl.chaos.starveStageAt``   "stage:k" or "stage:k:seconds" (stage in
+                                read / decode / assemble): from its k-th
+                                item the named ingest stage THROTTLES
+                                (each item pauses ~50 ms) for ``seconds``
+                                (default 1.0) — the stage stays alive but
+                                its downstream starves, exactly the
+                                signal the stage autoscaler must answer
+                                with added workers (once per plan).
 ``bigdl.chaos.corruptCompileCacheAt`` k: the k-th compile-cache entry
                                 written gets one bit flipped AFTER its
                                 manifest checksum was computed — a
@@ -205,6 +213,9 @@ class _ChaosState:
             "bigdl.chaos.transientReads", 0)
         self.kill_stage, self.kill_stage_after = _parse_kill(
             config.get_property("bigdl.chaos.killStageThread"))
+        (self.starve_stage_name, self.starve_stage_after,
+         self.starve_stage_seconds) = _parse_starve(
+            config.get_property("bigdl.chaos.starveStageAt"))
         self.corrupt_cache_at = config.get_int(
             "bigdl.chaos.corruptCompileCacheAt", 0)
         self.hang_compile_at, self.hang_compile_seconds = _parse_stall(
@@ -236,6 +247,9 @@ class _ChaosState:
         self.record_faults_fired: set = set()   # positions fired once
         self.decode_faults_fired: set = set()
         self.stage_kills = 0
+        self.stage_starve_started: Optional[float] = None
+        self.stage_starve_done = False
+        self.stage_starve_throttles = 0
         self.preempts = 0
         self.stalls = 0
         self.topology_changes = 0
@@ -529,6 +543,29 @@ class _ChaosState:
             self.stage_kills = 1
         return True
 
+    def starve_stage(self, stage: str, items: int) -> None:
+        """Called by each ingest stage with its running item count: once
+        the named stage reaches its ``starveStageAt`` item, every call
+        inside the window pauses ~50 ms — the stage stays alive but its
+        output rate collapses, so the DOWNSTREAM stage starves (the
+        autoscaler's scale-up signal, forced on demand).  The window
+        closes ``seconds`` after the first throttled item; once per
+        plan."""
+        import time as _time
+        if (self.starve_stage_name != stage or self.stage_starve_done or
+                items < self.starve_stage_after):
+            return
+        with self._lock:
+            if self.stage_starve_started is None:
+                self.stage_starve_started = _time.monotonic()
+            remaining = (self.stage_starve_started +
+                         self.starve_stage_seconds - _time.monotonic())
+            if remaining <= 0:
+                self.stage_starve_done = True
+                return
+            self.stage_starve_throttles += 1
+        _time.sleep(min(0.05, remaining))
+
     # ---- resource-exhaustion hooks -------------------------------------
 
     def take_oom_dispatch(self, label: str) -> None:
@@ -767,6 +804,18 @@ def _parse_disk_full(value):
     return entries
 
 
+def _parse_starve(value) -> Tuple[Optional[str], int, float]:
+    """``"stage:k"`` -> (stage, k, 1.0); ``"stage:k:seconds"`` ->
+    (stage, k, seconds); falsy -> (None, 0, 0.0)."""
+    if not value:
+        return (None, 0, 0.0)
+    parts = str(value).split(":")
+    stage = parts[0].strip()
+    k = int(parts[1]) if len(parts) > 1 else 1
+    secs = float(parts[2]) if len(parts) > 2 else 1.0
+    return (stage, k, secs)
+
+
 def _parse_kill(value) -> Tuple[Optional[str], int]:
     """``"stage"`` -> (stage, 1); ``"stage:k"`` -> (stage, k); falsy ->
     (None, 0)."""
@@ -878,6 +927,14 @@ def kill_stage_thread(stage: str, items: int) -> bool:
     if _state is None:
         return False
     return _state.kill_stage_thread(stage, items)
+
+
+def starve_stage(stage: str, items: int) -> None:
+    """Ingest stage-throttle hook (no-op when disarmed): from the armed
+    stage's ``starveStageAt``-th item each call pauses ~50 ms for the
+    window, collapsing its output rate so its downstream starves."""
+    if _state is not None:
+        _state.starve_stage(stage, items)
 
 
 def take_bitflip() -> Optional[int]:

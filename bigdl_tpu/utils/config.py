@@ -107,6 +107,28 @@ _DEFAULTS: Dict[str, Any] = {
     "bigdl.ingest.decodedRingDepth": None, # in-flight decode window; None = 2x batch
     "bigdl.ingest.batchRingDepth": 2,      # assembled batches buffered ahead
     "bigdl.ingest.batchesInFlight": 2,     # device uploads in flight (transfer-ahead)
+    "bigdl.ingest.deviceAugment": False,   # pack FULL uint8 frames + ride-along
+    # crop offsets/flips; crop/flip/transpose runs on device (nn.DeviceAugment)
+    "bigdl.ingest.zeroCopyUpload": True,   # dlpack handoff of assembler buffers
+    # at the host->device crossing (engine.to_device); falls back per-array
+    # stage autoscaling (dataset/ingest.py _Autoscaler): the supervisor
+    # adds/retires decode workers (and native assemble threads) from the
+    # per-stage starve/backpressure signals, governor as upper bound
+    "bigdl.ingest.autoscale.enabled": True,   # scale decode/assemble workers
+    "bigdl.ingest.autoscale.min": 1,          # decode-worker floor
+    "bigdl.ingest.autoscale.max": 0,          # worker ceiling; 0 = host cores
+    "bigdl.ingest.autoscale.intervalSec": 0.25,  # decision cadence
+    "bigdl.ingest.autoscale.upStarveFrac": 0.2,  # assemble starve frac -> +1
+    "bigdl.ingest.autoscale.downStarveFrac": 0.02,  # below this (or
+    # backpressure-bound) -> -1 toward the floor
+    "bigdl.ingest.autoscale.patience": 2,     # consecutive signals before acting
+    "bigdl.ingest.autoscale.cooldown": 3,     # hold intervals after an action
+    # decoded-epoch cache (dataset/epoch_cache.py): repeated-epoch training
+    # pays JPEG decode once; RAM segments, optional checksummed disk spill
+    "bigdl.ingest.epochCache": False,      # cache decoded frames across epochs
+    "bigdl.ingest.epochCacheDir": None,    # disk-spill dir; None = RAM only
+    "bigdl.ingest.epochCacheBudgetMB": 0,  # cache byte cap; 0 = governor only
+    "bigdl.ingest.epochCacheSegmentRecords": 256,  # records per segment
     # self-healing ingest (error taxonomy + quarantine + supervision)
     "bigdl.ingest.maxBadRecords": 0,       # data-error quarantine budget; 0 = fail fast
     "bigdl.ingest.maxStageRestarts": 2,    # dead-stage restarts before escalation
@@ -190,6 +212,9 @@ _DEFAULTS: Dict[str, Any] = {
     # the k-th write_bytes [matching substr] raises ENOSPC, once each
     "bigdl.chaos.hostMemPressureAt": 0,  # k: governor poll k reports
     # zero free bytes (once per plan) — shrinker/backpressure prey
+    "bigdl.chaos.starveStageAt": None,   # "stage:k" / "stage:k:seconds":
+    # the named ingest stage throttles from its k-th item for the window —
+    # downstream stages starve; autoscaler acceptance prey
 }
 
 _OVERRIDES: Dict[str, Any] = {}

@@ -1,0 +1,199 @@
+"""Decoded-epoch cache (tentpole: decode JPEG once, feed every epoch).
+
+Covers the segment ring's RAM and disk legs, the corruption quarantine
+(bit-flipped segment fixture — satellite c), governor accounting and
+pressure-driven shrink, and end-to-end engine parity: cached epochs must
+stay bit-identical to the uncached (and synchronous) batch stream."""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.dataset.epoch_cache import DecodedEpochCache
+from bigdl_tpu.dataset.image import LabeledImageBytes
+from bigdl_tpu.resources import GOVERNOR
+from bigdl_tpu.utils import chaos, config
+from bigdl_tpu.utils.random_generator import RandomGenerator
+
+
+@pytest.fixture(autouse=True)
+def _clean_env():
+    GOVERNOR.reset()
+    yield
+    chaos.uninstall()
+    GOVERNOR.reset()
+    for k in ("bigdl.ingest.epochCache", "bigdl.ingest.epochCacheDir",
+              "bigdl.ingest.epochCacheBudgetMB",
+              "bigdl.ingest.epochCacheSegmentRecords",
+              "bigdl.resources.hostMemBudgetMB",
+              "bigdl.chaos.hostMemPressureAt"):
+        config.clear_property(k)
+
+
+def _frames(n, seed=0, hw=(8, 6)):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, 256, size=hw + (3,)).astype(np.uint8)
+            for _ in range(n)]
+
+
+class TestSegmentRing:
+    def test_ram_roundtrip_bit_exact(self):
+        cache = DecodedEpochCache("t", segment_records=4)
+        frames = _frames(10)
+        for i, f in enumerate(frames):
+            cache.put(f"r{i}", f)
+        for i, f in enumerate(frames):      # sealed segments + open tail
+            np.testing.assert_array_equal(cache.get(f"r{i}"), f)
+        s = cache.stats()
+        assert s["hits"] == 10 and s["ram_segments"] == 2
+        assert s["open_records"] == 2
+        cache.close()
+
+    def test_unknown_and_unnamed_keys_are_misses(self):
+        cache = DecodedEpochCache("t")
+        assert cache.get("nope") is None
+        cache.put(None, _frames(1)[0])      # unnamed record: never cached
+        assert cache.stats()["open_records"] == 0
+        assert cache.stats()["misses"] == 1
+        cache.close()
+
+    def test_disk_spill_and_readback(self, tmp_path):
+        cache = DecodedEpochCache("t", cache_dir=str(tmp_path),
+                                  segment_records=4)
+        frames = _frames(8, seed=1)
+        for i, f in enumerate(frames):
+            cache.put(f"r{i}", f)
+        s = cache.stats()
+        assert s["disk_segments"] == 2 and s["ram_segments"] == 0
+        assert s["ram_bytes"] == 0          # RAM released at the spill
+        assert len(list(tmp_path.glob("*.bin"))) == 2
+        for i, f in enumerate(frames):
+            np.testing.assert_array_equal(cache.get(f"r{i}"), f)
+        cache.close()
+
+    def test_budget_cap_stops_admission_without_crashing(self):
+        cache = DecodedEpochCache("t", budget_mb=0, segment_records=2)
+        cache._cap = lambda: 1              # nothing fits
+        for i, f in enumerate(_frames(4)):
+            cache.put(f"r{i}", f)
+        assert cache.stats()["ram_bytes"] <= 1
+        cache.close()
+
+
+class TestCorruptionQuarantine:
+    def _spilled(self, tmp_path, n=4):
+        cache = DecodedEpochCache("t", cache_dir=str(tmp_path),
+                                  segment_records=n)
+        frames = _frames(n, seed=2)
+        for i, f in enumerate(frames):
+            cache.put(f"r{i}", f)
+        (path,) = list(tmp_path.glob("*.bin"))
+        return cache, frames, path
+
+    def test_bitflipped_segment_quarantined_not_crash(self, tmp_path):
+        """Satellite c: one flipped payload bit fails the segment CRC;
+        every read of that segment returns a miss (the caller re-decodes)
+        and the segment is counted quarantined — never an exception."""
+        cache, frames, path = self._spilled(tmp_path)
+        blob = bytearray(path.read_bytes())
+        blob[-3] ^= 0x40                    # payload bit
+        path.write_bytes(bytes(blob))
+        assert cache.get("r0") is None
+        assert cache.stats()["corrupt_segments"] == 1
+        assert cache.get("r1") is None      # whole segment dropped at once
+        assert cache.stats()["corrupt_segments"] == 1
+        # the cache still admits and serves fresh segments afterwards
+        fresh = _frames(1, seed=9)[0]
+        for i in range(4):
+            cache.put(f"s{i}", fresh)
+        np.testing.assert_array_equal(cache.get("s0"), fresh)
+        cache.close()
+
+    def test_truncated_header_quarantined(self, tmp_path):
+        cache, _, path = self._spilled(tmp_path)
+        path.write_bytes(path.read_bytes()[:7])
+        assert cache.get("r2") is None
+        assert cache.stats()["corrupt_segments"] == 1
+        cache.close()
+
+    def test_deleted_segment_file_quarantined(self, tmp_path):
+        cache, _, path = self._spilled(tmp_path)
+        os.remove(path)
+        assert cache.get("r0") is None
+        assert cache.stats()["corrupt_segments"] == 1
+        cache.close()
+
+
+class TestGovernorIntegration:
+    def test_bytes_ride_a_named_account(self):
+        cache = DecodedEpochCache("eng0", segment_records=2)
+        for i, f in enumerate(_frames(4, seed=3)):
+            cache.put(f"r{i}", f)
+        scalars = dict(GOVERNOR.summary_scalars())
+        key = "Resources/host_bytes_ingest_epoch_cache:eng0"
+        assert scalars[key] > 0
+        cache.close()
+        assert dict(GOVERNOR.summary_scalars())[key] == 0.0
+
+    def test_injected_pressure_shrinks_the_cache(self):
+        """The governor stays the authority: a pressure excursion fires
+        the cache's (weakly-registered) shrinker and evicts the oldest
+        RAM segments, dropping the accounted bytes."""
+        cache = DecodedEpochCache("eng1", segment_records=2)
+        for i, f in enumerate(_frames(8, seed=4)):
+            cache.put(f"r{i}", f)
+        before = cache.stats()["ram_bytes"]
+        config.set_property("bigdl.chaos.hostMemPressureAt", 1)
+        chaos.install()
+        assert GOVERNOR.poll() is True
+        after = cache.stats()
+        assert after["ram_bytes"] < before
+        assert after["evicted_segments"] >= 1
+        # evicted records re-decode (miss), surviving ones still hit
+        assert cache.get("r0") is None
+        cache.close()
+
+
+class TestEngineEndToEnd:
+    def _png_records(self, n=12, hw=(40, 48), seed=3):
+        from PIL import Image
+        rng = np.random.RandomState(seed)
+        recs = []
+        for i in range(n):
+            img = rng.randint(0, 256, size=hw + (3,)).astype(np.uint8)
+            buf = io.BytesIO()
+            Image.fromarray(img).save(buf, "PNG")
+            recs.append(LabeledImageBytes(f"r{i}", float(i % 5 + 1),
+                                          buf.getvalue()))
+        return recs
+
+    def _batches(self, transformer, recs, seed=20240731):
+        RandomGenerator.RNG().set_seed(seed)
+        return [(b.get_input().copy(), b.get_target().copy())
+                for b in transformer(iter(recs))]
+
+    def test_cached_epochs_bit_identical_and_hitting(self):
+        """Epoch 2 must serve every decode from the cache AND stay
+        bit-identical to the uncached stream: the crop/flip draws happen
+        after the cache, so caching is a pure throughput property."""
+        from bigdl_tpu.dataset.ingest import StreamingIngest
+        from bigdl_tpu.dataset.mt_batch import MTLabeledBGRImgToBatch
+
+        recs = self._png_records()
+        sync1 = self._batches(MTLabeledBGRImgToBatch(4, crop=(32, 32)),
+                              recs, seed=11)
+        sync2 = self._batches(MTLabeledBGRImgToBatch(4, crop=(32, 32)),
+                              recs, seed=12)
+        config.set_property("bigdl.ingest.epochCache", True)
+        eng = StreamingIngest(4, crop=(32, 32), decode_workers=2)
+        assert eng.epoch_cache is not None
+        got1 = self._batches(eng, recs, seed=11)
+        assert eng.epoch_cache.stats()["misses"] == len(recs)
+        got2 = self._batches(eng, recs, seed=12)
+        assert eng.epoch_cache.stats()["hits"] == len(recs)
+        for sync, got in ((sync1, got1), (sync2, got2)):
+            for (xs, ys), (xg, yg) in zip(sync, got):
+                np.testing.assert_array_equal(xs, xg)
+                np.testing.assert_array_equal(ys, yg)

@@ -249,6 +249,43 @@ class TestChaosDocDrift:
             f"chaos.py reads unregistered keys: {sorted(used - registered)}"
 
 
+class TestIngestDocDrift:
+    """Every ``bigdl.ingest.*`` key the code registers must have a row
+    in docs/configuration.md — and vice versa (satellite e: the
+    autoscale.* / epochCache* knobs ride the same drift guard as the
+    chaos keys)."""
+
+    # dotted sub-keys (autoscale.enabled, ...) must match whole: a key
+    # can never end at a dot
+    _KEY = re.compile(r"bigdl\.ingest\.[A-Za-z0-9]+(?:\.[A-Za-z0-9]+)*")
+
+    def _keys_in(self, *parts):
+        with open(os.path.join(_REPO, *parts), encoding="utf-8") as f:
+            return set(self._KEY.findall(f.read()))
+
+    def test_config_defaults_match_docs_both_ways(self):
+        code = self._keys_in("bigdl_tpu", "utils", "config.py")
+        docs = self._keys_in("docs", "configuration.md")
+        assert code - docs == set(), \
+            f"ingest keys missing a docs row: {sorted(code - docs)}"
+        # prose may name a dot-boundary PREFIX of a key family
+        # ("bigdl.ingest.autoscale" for the knob group) — only a
+        # documented key that is neither registered nor such a prefix
+        # is drift
+        unknown = {d for d in docs - code
+                   if not any(k.startswith(d + ".") for k in code)}
+        assert unknown == set(), \
+            f"documented ingest keys unknown to config.py: {sorted(unknown)}"
+
+    def test_ingest_module_keys_are_registered_defaults(self):
+        used = self._keys_in("bigdl_tpu", "dataset", "ingest.py")
+        registered = self._keys_in("bigdl_tpu", "utils", "config.py")
+        unknown = {u for u in used - registered
+                   if not any(k.startswith(u + ".") for k in registered)}
+        assert unknown == set(), \
+            f"ingest.py reads unregistered keys: {sorted(unknown)}"
+
+
 class TestSemanticCheckpointFingerprint:
     """Satellite d: a snapshot whose payload checksums verify but whose
     save-time fingerprint mismatches is refused with a structured log

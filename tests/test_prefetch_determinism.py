@@ -241,6 +241,153 @@ def test_abandoned_read_ahead_does_not_advance_caller_rng():
         np.testing.assert_array_equal(np.asarray(sa), np.asarray(sb))
 
 
+# ---------------------------------------------------------------------------
+# on-device augmentation vs the host MT path (satellite: bit-parity)
+# ---------------------------------------------------------------------------
+
+def _augment_on_device(batch):
+    """Apply the device-side crop/flip/transpose to a device-augment
+    MiniBatch and return the resulting uint8 NCHW array on host."""
+    from bigdl_tpu.dataset.device_augment import crop_flip_transpose
+    frames, offs, flips = batch[0], batch[1], batch[2]
+    return np.asarray(crop_flip_transpose(frames, offs, flips, 32, 32))
+
+
+@pytest.mark.parametrize("workers,rec_d,dec_d,batch_d", DEPTHS)
+def test_device_augment_bit_identical_to_host_path(workers, rec_d, dec_d,
+                                                   batch_d):
+    """Device-augment mode ships full frames + ride-along crop offsets /
+    flip flags; applying the device transform must reproduce the host
+    path's cropped uint8 batches BIT-IDENTICALLY — same drawer, same
+    draw order, same pixels — at every ``bigdl.ingest.*`` depth."""
+    from bigdl_tpu.dataset.ingest import StreamingIngest
+    from bigdl_tpu.dataset.mt_batch import MTLabeledBGRImgToBatch
+
+    recs = _jpeg_records()
+    sync = _batches(MTLabeledBGRImgToBatch(4, crop=(32, 32),
+                                           device_normalize=True), recs)
+    eng = StreamingIngest(4, crop=(32, 32), device_augment=True,
+                          decode_workers=workers, record_ring_depth=rec_d,
+                          decoded_ring_depth=dec_d, batch_ring_depth=batch_d)
+    got, got_state = _batches(eng, recs)
+    (sync_batches, sync_state) = sync
+    assert len(got) == len(sync_batches)
+    for (xs, ys), (xg, yg) in zip(sync_batches, got):
+        assert isinstance(xg, list) and len(xg) == 3
+        assert xg[0].dtype == np.uint8 and xg[0].shape[-1] == 3  # NHWC full
+        np.testing.assert_array_equal(xs, _augment_on_device(xg))
+        np.testing.assert_array_equal(ys, yg)
+    for sa, sb in zip(sync_state, got_state):
+        np.testing.assert_array_equal(np.asarray(sa), np.asarray(sb))
+
+
+def test_device_augment_mixed_shapes_host_fallback_parity():
+    """Mixed-shape batches cannot stack full frames; the engine pre-crops
+    on host (identity ride-alongs) and the result must still match the
+    host path bit for bit after the device transform."""
+    from bigdl_tpu.dataset.ingest import StreamingIngest
+    from bigdl_tpu.dataset.mt_batch import MTLabeledBGRImgToBatch
+
+    wide = _jpeg_records(n=6, hw=(40, 48), seed=3)
+    tall = _jpeg_records(n=6, hw=(36, 52), seed=4)
+    # interleave so EVERY batch of 4 mixes shapes (stacking impossible)
+    recs = [r for pair in zip(wide, tall) for r in pair]
+    sync = _batches(MTLabeledBGRImgToBatch(4, crop=(32, 32),
+                                           device_normalize=True), recs)
+    eng = StreamingIngest(4, crop=(32, 32), device_augment=True,
+                          decode_workers=2)
+    got, _ = _batches(eng, recs)
+    for (xs, _), (xg, _) in zip(sync[0], got):
+        np.testing.assert_array_equal(np.asarray(xg[1]), 0)  # identity offs
+        np.testing.assert_array_equal(np.asarray(xg[2]), 0)  # identity flips
+        np.testing.assert_array_equal(xs, _augment_on_device(xg))
+
+
+def test_device_jitter_seeds_depth_invariant():
+    """The per-record ColorJitter seeds ride the same clone-and-commit
+    drawer as the crop/flip draws, so the seed sequence is identical at
+    every pipeline depth — and the jitter transform is a pure function
+    of (pixels, seed)."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.dataset.device_augment import color_jitter
+    from bigdl_tpu.dataset.ingest import StreamingIngest
+
+    recs = _jpeg_records()
+
+    def seeds_at(workers, rec_d, dec_d, batch_d):
+        eng = StreamingIngest(4, crop=(32, 32), device_augment=True,
+                              device_jitter=True, decode_workers=workers,
+                              record_ring_depth=rec_d,
+                              decoded_ring_depth=dec_d,
+                              batch_ring_depth=batch_d)
+        out, _ = _batches(eng, recs)
+        for x, _ in out:
+            assert len(x) == 4            # frames, offs, flips, seeds
+        return [np.asarray(x[3]) for x, _ in out]
+
+    shallow = seeds_at(1, 1, 1, 1)
+    deep = seeds_at(3, 64, 16, 4)
+    for a, b in zip(shallow, deep):
+        np.testing.assert_array_equal(a, b)
+
+    imgs = jnp.asarray(np.random.RandomState(0).randint(
+        0, 256, (4, 3, 32, 32)).astype(np.uint8))
+    j1 = np.asarray(color_jitter(imgs, shallow[0], brightness=0.4,
+                                 contrast=0.4, saturation=0.4))
+    j2 = np.asarray(color_jitter(imgs, shallow[0], brightness=0.4,
+                                 contrast=0.4, saturation=0.4))
+    np.testing.assert_array_equal(j1, j2)
+    assert j1.dtype == np.uint8 and j1.shape == (4, 3, 32, 32)
+
+
+@pytest.mark.parametrize("ingest_depths", [(1, 1, 1, 1), (3, 64, 16, 4)])
+def test_trained_weights_identical_device_augment_vs_host(ingest_depths):
+    """Trained-weight parity for the tentpole: a model headed by
+    ``nn.DeviceAugment`` + ``nn.ChannelNormalize`` reaches bit-identical
+    weights whether fed cropped uint8 batches by the host MT path
+    (DeviceAugment passes plain tensors through) or full frames +
+    ride-alongs by the device-augment streaming engine."""
+    import jax
+
+    from bigdl_tpu.dataset.ingest import StreamingIngest
+    from bigdl_tpu.dataset.mt_batch import MTLabeledBGRImgToBatch
+
+    recs = _jpeg_records(n=16, hw=(36, 36))
+
+    def train(transformer, prefetch_depth):
+        config.set_property("bigdl.prefetch.depth", prefetch_depth)
+        try:
+            RandomGenerator.RNG().set_seed(4242)
+            ds = LocalDataSet(recs).transform(transformer)
+            model = (nn.Sequential()
+                     .add(nn.DeviceAugment(32, 32))
+                     .add(nn.ChannelNormalize((104.0, 117.0, 123.0),
+                                              (1.0, 1.0, 1.0)))
+                     .add(nn.Reshape((3 * 32 * 32,)))
+                     .add(nn.Linear(3 * 32 * 32, 4)).add(nn.LogSoftMax()))
+            model.reset(jax.random.PRNGKey(7))
+            opt = optim.Optimizer.create(model, ds, nn.ClassNLLCriterion())
+            opt.set_optim_method(optim.SGD(learning_rate=0.05,
+                                           momentum=0.9))
+            opt.set_end_when(optim.max_epoch(3))
+            opt.optimize()
+            w, _ = model.get_parameters()
+            return np.asarray(w)
+        finally:
+            config.clear_property("bigdl.prefetch.depth")
+
+    w_host = train(MTLabeledBGRImgToBatch(4, crop=(32, 32),
+                                          device_normalize=True), 0)
+    workers, rec_d, dec_d, batch_d = ingest_depths
+    w_dev = train(
+        StreamingIngest(4, crop=(32, 32), device_augment=True,
+                        decode_workers=workers, record_ring_depth=rec_d,
+                        decoded_ring_depth=dec_d, batch_ring_depth=batch_d),
+        2)
+    np.testing.assert_array_equal(w_host, w_dev)
+
+
 @pytest.mark.parametrize("ingest_depths", [(1, 1, 1, 1), (3, 64, 16, 4)])
 def test_trained_weights_identical_sync_vs_streaming(ingest_depths):
     """Full training parity across epoch rollovers: momentum SGD over an
