@@ -143,6 +143,16 @@ Rules
     pre-crop fallback).  (``cv2.imdecode`` is decode, not augmentation,
     and is never flagged.)
 
+``unsupervised-thread-in-fleet``
+    In the fleet control plane (``bigdl_tpu/fleet/``), a raw
+    ``threading.Thread(...)`` construction anywhere outside
+    ``FleetSupervisor.spawn``.  Every fleet thread must be born through
+    the supervisor so fleet stop can drain it and diagnostics can
+    enumerate it — a thread the supervisor cannot see is a thread a
+    chaos test cannot prove anything about.  The one legitimate
+    construction site (inside ``spawn`` itself) carries the inline
+    allow; the allowlist stays empty.
+
 Silencing: append ``# lint: allow(<rule-name>)`` to the offending line,
 or list ``<relpath>:<rule-name>`` in an allowlist file (one per line,
 ``#`` comments) — the CI gate keeps the repo allowlist empty, so every
@@ -228,6 +238,7 @@ ACCOUNTING_CALLS = {"account", "item_nbytes", "check_item", "_charge",
 #: host-fallback modules (reference host transformer library + the
 #: synchronous MT path with its mixed-shape pre-crop) are exempt
 DATASET_SCOPE = os.path.join("dataset", "")
+FLEET_SCOPE = os.path.join("fleet", "")
 HOST_AUGMENT_FALLBACK_FILES = (os.path.join("dataset", "image.py"),
                                os.path.join("dataset", "mt_batch.py"))
 #: per-pixel augmentation calls that belong on device (nn.DeviceAugment)
@@ -245,7 +256,7 @@ KNOWN_RULES = frozenset({
     "signal-handler-in-hot-path", "jnp-dtype-drop", "untracked-jit",
     "undeclared-collective", "unguarded-io-in-stage-thread",
     "unbounded-queue-in-serving", "unaccounted-buffer-in-stage",
-    "host-augment-in-hot-path",
+    "host-augment-in-hot-path", "unsupervised-thread-in-fleet",
     "bare-except", "swallowed-exception",
     "blocking-under-lock", "lock-order", "syntax",
 })
@@ -717,6 +728,31 @@ def _rule_host_augment(path: str, rel: str, tree: ast.AST) -> List[Finding]:
     return out
 
 
+def _rule_fleet_thread(path: str, rel: str, tree: ast.AST) -> List[Finding]:
+    """Raw ``threading.Thread`` construction in the fleet control plane:
+    every fleet thread must come from ``FleetSupervisor.spawn`` (the
+    registered, drainable, enumerable construction site).  A thread the
+    supervisor never saw cannot be joined at fleet stop and invisibly
+    weakens every chaos-accounting claim the fleet makes."""
+    if FLEET_SCOPE not in rel:
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _call_name(node) != "Thread":
+            continue
+        if _qualifier(node) not in ("threading", None):
+            continue
+        out.append(Finding(
+            rel, node.lineno, "unsupervised-thread-in-fleet",
+            "raw threading.Thread in the fleet control plane — every "
+            "fleet thread must be spawned through FleetSupervisor.spawn "
+            "so fleet stop can drain it and diagnostics can enumerate "
+            "it"))
+    return out
+
+
 def _rule_exceptions(path: str, rel: str, tree: ast.AST) -> List[Finding]:
     out: List[Finding] = []
     threaded = any(rel.endswith(t) for t in THREADED_FILES)
@@ -929,6 +965,7 @@ def lint_paths(targets: Sequence[str],
                          _rule_unbounded_queue(path, rel, tree) +
                          _rule_unaccounted_buffer(path, rel, tree) +
                          _rule_host_augment(path, rel, tree) +
+                         _rule_fleet_thread(path, rel, tree) +
                          _rule_exceptions(path, rel, tree))
         if any(rel.endswith(t) for t in THREADED_FILES):
             lv = _LockVisitor(rel)

@@ -1,0 +1,54 @@
+"""Fleet control plane: N models x N replicas as one supervised fleet.
+
+The original deployment story was "a plain Spark program" — the driver
+owned model lifecycle and a restart meant a full job resubmission.  This
+package is the layer that turns the rebuilt subsystems into
+self-operating serving machinery:
+
+* **zero-downtime hot swap** — a candidate model warm-loads its
+  executables through the compile cache and warms the full
+  ``bigdl.compile.buckets`` plan while the incumbent keeps serving; the
+  router then shifts traffic atomically and the old replicas drain
+  through ``ServingEngine.stop(grace)``.
+* **blue/green rollout gated on correctness** — promotion requires the
+  semantic state fingerprint captured at candidate-prepare time to
+  re-verify immediately before cutover AND a shadow-traffic parity
+  check (a sample of recently served live requests is mirrored to the
+  candidate; outputs compare bit-wise for deterministic swaps, allclose
+  otherwise) — any violation rolls back automatically and the incumbent
+  never stops serving.
+* **replica lifecycle supervision** — :class:`FleetSupervisor` restarts
+  crashed replicas within a restart budget, autoscales the replica
+  count from queue depth and the ``Serving/latency_ms`` p99 (a
+  :class:`FleetAutoscalePolicy` hysteresis state machine, with the
+  host-memory governor as upper-bound authority), and implements
+  checkpoint-to-serving promotion as one verified step: the train loop
+  publishes a snapshot, the fleet detects it via
+  ``CheckpointManager.watch_latest()``, deep-verifies (checksums + the
+  semantic fingerprint), warm-loads, and rolls.
+
+Chaos-proven: ``bigdl.chaos.killReplicaAt`` (async hard-kill of a
+batcher thread), ``bigdl.chaos.corruptCandidateAt`` (candidate weights
+rot after fingerprint capture), and ``bigdl.chaos.sigtermFleetAt``
+(fleet-wide preemption mid-rollout) — the per-request accounting
+identity (completed + shed + rejected + quarantined == submitted) holds
+exactly across every fault, and a clean rollout loses zero requests.
+
+See ``docs/programming-guide/optimization.md`` ("Running a fleet") for
+the rollout state diagram and the failure matrix.
+"""
+
+from bigdl_tpu.fleet.autoscale import FleetAutoscalePolicy
+from bigdl_tpu.fleet.replica import Replica, ReplicaKilled
+from bigdl_tpu.fleet.rollout import RolloutReport
+from bigdl_tpu.fleet.supervisor import FleetSupervisor
+from bigdl_tpu.fleet.fleet import Fleet
+
+__all__ = [
+    "Fleet",
+    "FleetAutoscalePolicy",
+    "FleetSupervisor",
+    "Replica",
+    "ReplicaKilled",
+    "RolloutReport",
+]

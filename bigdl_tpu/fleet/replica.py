@@ -1,0 +1,94 @@
+"""One serving replica: a ``ServingEngine`` plus the fleet-side
+lifecycle state the supervisor keys on.
+
+A replica is DISPOSABLE by design: the engine's one-way stop contract
+means a replica is never revived — a crashed or retired slot is
+replaced by a freshly built replica whose executables warm-load from
+the compile cache (~milliseconds, not a recompile).  The fleet
+distinguishes two ends of life:
+
+* **retired** — the fleet took it out of rotation deliberately (a
+  rollout's old side, a scale-down, fleet stop).  Queued work drains
+  within the grace window; nothing to repair.
+* **crashed** — the batcher thread died without an orderly drain (an
+  async kill, an escaped internal error).  The supervisor sweeps the
+  replica's stranded in-flight requests into ``shed`` (the accounting
+  identity survives the crash) and restarts the slot within its
+  restart budget.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from bigdl_tpu import telemetry
+from bigdl_tpu.serving.engine import ServingEngine
+from bigdl_tpu.utils import elastic
+
+
+class ReplicaKilled(BaseException):
+    """Async-raised into a replica's batcher thread by the chaos
+    harness (``bigdl.chaos.killReplicaAt``).  A ``BaseException`` on
+    purpose: the batcher's internal ``except Exception`` recovery must
+    NOT be able to absorb it — this models a hard crash (segfault,
+    OOM-kill), not a handleable dispatch error."""
+
+
+class Replica:
+    """A supervised serving replica: engine + slot identity + lifecycle
+    flags.  ``slot`` survives restarts (the restart budget is per slot,
+    not per engine instance); ``version`` names the model generation the
+    replica serves (rollouts bump it)."""
+
+    def __init__(self, service: str, slot: int, version: str, model,
+                 warm_row: Optional[np.ndarray] = None,
+                 engine_kw: Optional[Dict[str, Any]] = None):
+        self.service = service
+        self.slot = slot
+        self.version = version
+        self.retired = False
+        self.engine = ServingEngine(model, **(engine_kw or {}))
+        if warm_row is not None:
+            # AOT-warm every configured bucket BEFORE the replica takes
+            # traffic: with the compile cache armed this is a warm load,
+            # and the first routed request never pays a compile
+            self.engine.warmup(warm_row)
+
+    @property
+    def name(self) -> str:
+        return f"{self.service}/{self.version}#{self.slot}"
+
+    def healthy(self) -> bool:
+        """Routable: in rotation, batcher alive, admission open."""
+        return (not self.retired and not self.engine.terminal and
+                not self.engine.draining and self.engine.batcher_alive())
+
+    def crashed(self) -> bool:
+        """Died WITHOUT an orderly drain — the restart signal."""
+        return not self.retired and self.engine.crashed()
+
+    def retire(self, grace: Optional[float] = None) -> None:
+        """Deliberate end of life: out of rotation first (the flag), then
+        the engine's graceful drain.  Idempotent, like the stop contract
+        it rides on."""
+        self.retired = True
+        self.engine.stop(grace)
+
+    def kill(self) -> bool:
+        """Chaos only: hard-kill the batcher thread with an async-raised
+        :class:`ReplicaKilled`.  Returns True when the injection was
+        delivered (the thread was alive to receive it).  The exception
+        lands at the thread's next bytecode — the engine's ``finally``
+        still closes the engine and sheds QUEUED requests, but a popped
+        in-flight batch is stranded unaccounted, exactly the hole the
+        supervisor's sweep (``RequestHandle.abandon``) exists to plug."""
+        tid = self.engine.batcher_ident()
+        if tid is None or not self.engine.batcher_alive():
+            return False
+        delivered = elastic._async_raise(tid, ReplicaKilled)
+        if delivered:
+            telemetry.counter("Fleet/replica_kills",
+                              labels={"service": self.service}).inc()
+        return delivered

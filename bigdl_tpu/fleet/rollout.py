@@ -1,0 +1,260 @@
+"""Blue/green rollout: the gated state machine behind every model swap.
+
+::
+
+    PREPARE ──► VERIFY ──► SHADOW ──► CUTOVER ──► DRAIN
+       │           │          │          (atomic router swap; the
+       │           │          │           incumbent replicas then drain
+       │           │          │           via stop(grace) — zero
+       │           │          │           requests lost)
+       └───────────┴──────────┴──► ROLLBACK (candidate retired, the
+                                   incumbent NEVER stopped serving)
+
+* **PREPARE** — the candidate's semantic fingerprint (over its params
+  tree) is captured FIRST; then replicas are built: executables
+  warm-load through the compile cache and every ``bigdl.compile.
+  buckets`` variant warms before the candidate sees one live request.
+* **VERIFY** — the fingerprint recomputes immediately before cutover
+  and must match the capture: weights that rotted anywhere between
+  prepare and cutover (``bigdl.chaos.corruptCandidateAt`` models this)
+  are refused.  Checkpoint-promotion flows get the save-time manifest
+  fingerprint verified earlier, inside ``CheckpointManager.
+  load_latest`` deep verification — this leg covers the load-to-cutover
+  window on top.
+* **SHADOW** — up to ``bigdl.fleet.shadowSample`` recently COMPLETED
+  live requests are mirrored through the candidate and compared against
+  the incumbent's answers: bit-wise when ``bigdl.fleet.parityMode`` is
+  ``bitwise`` (an identical-weights infra swap must not change one
+  bit), ``np.allclose(parityRtol, parityAtol)`` for ``allclose``, or
+  skipped for ``off`` (a deliberately different model — a promoted
+  checkpoint — legitimately diverges past any tolerance).
+* **CUTOVER** — one pointer swap under the service lock: requests
+  admitted before it complete on the old replicas, requests after it
+  route to the new — no window where neither side serves.
+* **DRAIN** — old replicas retire through the engine's graceful
+  ``stop(grace)``; queued work completes (or sheds retriably past the
+  grace window, still accounted).
+
+A fleet-wide preemption (``elastic.preemption_requested``) observed at
+any phase boundary aborts into ROLLBACK — mid-rollout SIGTERM never
+leaves the router pointing at a half-warmed candidate.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from bigdl_tpu import telemetry
+from bigdl_tpu.utils import config, elastic
+
+logger = logging.getLogger("bigdl_tpu")
+
+
+@dataclass
+class RolloutReport:
+    """What one rollout did and how long each phase took.  Returned for
+    promoted AND rolled-back rollouts — the caller branches on
+    :attr:`promoted`; a rollback is an answered question, not an
+    exception."""
+
+    service: str
+    from_version: str
+    to_version: str
+    promoted: bool = False
+    rolled_back: bool = False
+    reason: str = ""
+    fingerprint_expected: Optional[str] = None
+    fingerprint_observed: Optional[str] = None
+    parity_mode: str = "bitwise"
+    parity_checked: int = 0
+    parity_max_abs_diff: float = 0.0
+    prepare_ms: float = 0.0
+    verify_ms: float = 0.0
+    shadow_ms: float = 0.0
+    drain_ms: float = 0.0
+    #: rollout-start -> traffic-on-candidate wall time (the hot-swap
+    #: headline: with a warm compile cache this is a small fraction of
+    #: one cold compile)
+    swap_ms: float = 0.0
+    cutover_ns: Optional[int] = None
+    replicas: int = 0
+    notes: List[str] = field(default_factory=list)
+
+
+def _params_fingerprint(model) -> str:
+    """Fingerprint key over the model's params tree.  Deliberately NOT
+    over the module object: engine construction memoizes compiled
+    callables onto the module (``_eval_jit``), which the object-graph
+    walk would see — the params tree is the stable semantic identity
+    across prepare/build/cutover."""
+    from bigdl_tpu.integrity import fingerprint_key, host_fingerprint
+    return fingerprint_key(host_fingerprint(model.parameters()[0]))
+
+
+def _parity_compare(got, want, mode: str, rtol: float,
+                    atol: float) -> Tuple[bool, float]:
+    """(outputs agree, max abs elementwise diff seen)."""
+    import jax
+    la = jax.tree_util.tree_leaves(got)
+    lb = jax.tree_util.tree_leaves(want)
+    if len(la) != len(lb):
+        return False, float("inf")
+    worst = 0.0
+    ok = True
+    for x, y in zip(la, lb):
+        x = np.asarray(x)
+        y = np.asarray(y)
+        if x.shape != y.shape or x.dtype != y.dtype:
+            return False, float("inf")
+        if x.size:
+            with np.errstate(invalid="ignore"):
+                worst = max(worst, float(np.max(np.abs(
+                    x.astype(np.float64) - y.astype(np.float64)))))
+        if mode == "bitwise":
+            ok = ok and bool(np.array_equal(x, y))
+        else:
+            ok = ok and bool(np.allclose(x, y, rtol=rtol, atol=atol))
+    return ok, worst
+
+
+def run_rollout(service, candidate_model,
+                expected_fingerprint: Optional[str] = None,
+                replicas: Optional[int] = None,
+                parity: Optional[str] = None,
+                grace: Optional[float] = None) -> RolloutReport:
+    """Drive one candidate through the full state machine against
+    ``service`` (a ``fleet._Service``).  Serialized per service by the
+    rollout lock — two concurrent rollouts of one service would race the
+    router.  See the module docstring for the phases."""
+    from bigdl_tpu.utils import chaos
+
+    mode = (parity if parity is not None else
+            str(config.get_property("bigdl.fleet.parityMode") or "bitwise"))
+    if mode not in ("bitwise", "allclose", "off"):
+        raise ValueError(f"unknown parity mode {mode!r} "
+                         "(bitwise | allclose | off)")
+    rtol = config.get_float("bigdl.fleet.parityRtol", 1e-5)
+    atol = config.get_float("bigdl.fleet.parityAtol", 1e-6)
+    shadow_n = config.get_int("bigdl.fleet.shadowSample", 8)
+    grace = (grace if grace is not None else
+             config.get_float("bigdl.fleet.gracePeriod", 5.0))
+
+    with service._rollout_lock:
+        t0 = telemetry.clock_ns()
+        report = RolloutReport(
+            service=service.name, from_version=service.version,
+            to_version=service.peek_next_version(), parity_mode=mode)
+        new: List[Any] = []
+
+        def rollback(reason: str, slug: str) -> RolloutReport:
+            for r in new:
+                # the candidate never entered the router: nothing queued
+                # beyond our own shadow mirrors, so a zero-grace retire
+                # is clean
+                r.retire(0.0)
+            report.rolled_back = True
+            report.reason = reason
+            telemetry.counter("Fleet/rollbacks",
+                              labels={"service": service.name,
+                                      "reason": slug}).inc()
+            logger.warning("fleet %s: rollout %s -> %s ROLLED BACK (%s) — "
+                           "incumbent keeps serving", service.name,
+                           report.from_version, report.to_version, reason)
+            return report
+
+        # ---- PREPARE ---------------------------------------------------
+        params = candidate_model.parameters()[0]
+        report.fingerprint_expected = (
+            expected_fingerprint if expected_fingerprint is not None
+            else _params_fingerprint(candidate_model))
+        # chaos window: the candidate's weights rot AFTER the expected
+        # fingerprint was captured — exactly what VERIFY must catch
+        chaos.corrupt_candidate(params)
+        if elastic.preemption_requested():
+            return rollback("preempted before prepare", "preempted")
+        n = int(replicas if replicas is not None
+                else (len(service.active_replicas()) or 1))
+        report.replicas = n
+        try:
+            for _ in range(n):
+                new.append(service.new_replica(candidate_model,
+                                               report.to_version))
+        except Exception as e:
+            return rollback(f"candidate prepare failed: {e!r}", "prepare")
+        report.prepare_ms = (telemetry.clock_ns() - t0) / 1e6
+
+        # ---- VERIFY ----------------------------------------------------
+        tv = telemetry.clock_ns()
+        report.fingerprint_observed = _params_fingerprint(candidate_model)
+        report.verify_ms = (telemetry.clock_ns() - tv) / 1e6
+        if report.fingerprint_observed != report.fingerprint_expected:
+            return rollback(
+                f"semantic fingerprint mismatch: expected "
+                f"{report.fingerprint_expected}, observed "
+                f"{report.fingerprint_observed} — candidate weights "
+                "changed between prepare and cutover", "fingerprint")
+        if elastic.preemption_requested():
+            return rollback("preempted before shadow parity", "preempted")
+
+        # ---- SHADOW ----------------------------------------------------
+        ts = telemetry.clock_ns()
+        if mode != "off":
+            sample = service.shadow_sample(shadow_n)
+            for payload, want in sample:
+                try:
+                    h = new[0].engine.submit(payload)
+                    got = h.result(timeout=max(grace, 5.0))
+                except Exception as e:
+                    return rollback(
+                        f"shadow mirror failed on the candidate: {e!r}",
+                        "shadow")
+                report.parity_checked += 1
+                ok, diff = _parity_compare(got, want, mode, rtol, atol)
+                report.parity_max_abs_diff = max(
+                    report.parity_max_abs_diff, diff)
+                if not ok:
+                    telemetry.counter(
+                        "Fleet/parity_failures",
+                        labels={"service": service.name}).inc()
+                    return rollback(
+                        f"shadow parity violation ({mode}): candidate "
+                        f"diverges from the incumbent by up to "
+                        f"{diff:.3e} on mirrored live traffic",
+                        "parity")
+            if report.parity_checked:
+                telemetry.counter(
+                    "Fleet/shadow_mirrored",
+                    labels={"service": service.name}).inc(
+                        report.parity_checked)
+            else:
+                report.notes.append(
+                    "no live traffic to mirror — parity vacuously clean")
+        report.shadow_ms = (telemetry.clock_ns() - ts) / 1e6
+        if elastic.preemption_requested():
+            return rollback("preempted before cutover", "preempted")
+
+        # ---- CUTOVER ---------------------------------------------------
+        cut_ns = telemetry.clock_ns()
+        old = service.cutover(new, candidate_model, report.to_version,
+                              cut_ns)
+        report.cutover_ns = cut_ns
+        report.swap_ms = (cut_ns - t0) / 1e6
+        report.promoted = True
+        telemetry.counter("Fleet/rollouts",
+                          labels={"service": service.name}).inc()
+        telemetry.gauge("Fleet/swap_ms").set(report.swap_ms)
+        logger.info("fleet %s: cutover %s -> %s after %.1f ms (%d "
+                    "replica(s), parity %s x%d)", service.name,
+                    report.from_version, report.to_version, report.swap_ms,
+                    n, mode, report.parity_checked)
+
+        # ---- DRAIN -----------------------------------------------------
+        td = telemetry.clock_ns()
+        for r in old:
+            r.retire(grace)
+        report.drain_ms = (telemetry.clock_ns() - td) / 1e6
+        return report

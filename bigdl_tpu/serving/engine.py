@@ -193,6 +193,28 @@ class RequestHandle:
     def error(self) -> Optional[BaseException]:
         return self._error if self._done.is_set() else None
 
+    def abandon(self, error: Optional[BaseException] = None,
+                reason: str = "abandoned") -> bool:
+        """Terminally shed this request from OUTSIDE the engine — the
+        fleet supervisor sweeping the in-flight requests of a crashed
+        replica (whose batcher died mid-dispatch and can never account
+        them).  First-wins like every terminal transition, so a racing
+        dispatch completion or drain shed is never double-counted; the
+        queued-payload bytes charged at admission are released here
+        because the engine that charged them may be dead.  True when
+        THIS call finished the request."""
+        err = error if error is not None else ServingInfraError(
+            "request abandoned by its supervisor — retriable")
+        if not self._finish("shed", error=err):
+            return False
+        if self.payload_nbytes:
+            _resource_governor.account("serving_admission").sub(
+                self.payload_nbytes)
+            self.payload_nbytes = 0
+        telemetry.counter("Serving/shed").inc()
+        telemetry.counter("Serving/shed", labels={"reason": reason}).inc()
+        return True
+
 
 def _service_ema(warmup: int):
     """The admission controller's batch service-time estimator: a PR 5
@@ -296,6 +318,15 @@ class ServingEngine:
     # -- lifecycle --------------------------------------------------------
 
     def start(self) -> "ServingEngine":
+        if self._closed:
+            # one-way lifecycle: a stopped engine's queue was swept and
+            # its counters closed out — "restarting" it would serve from
+            # a half-torn state.  Structured and retriable: build a new
+            # engine (warm-loading makes that cheap), don't revive this
+            # one.
+            raise ServingInfraError(
+                "engine is terminal: stop() is one-way — build a new "
+                "engine instead of restarting this one")
         if self._started:
             return self
         self._started = True
@@ -324,7 +355,18 @@ class ServingEngine:
         """Graceful shutdown: admission closes (late arrivals get a
         retriable :class:`Overloaded`), queued work drains within
         ``grace`` (default ``bigdl.serving.gracePeriod``) and leftovers
-        are shed retriably.  Idempotent."""
+        are shed retriably.
+
+        The restart/reuse contract (the router's drain-then-discard path
+        leans on it): ``stop()`` is IDEMPOTENT and TERMINAL.  A second
+        ``stop()`` — concurrent or sequential — re-sweeps leftovers and
+        returns; it never raises and never blocks on a dead thread.
+        After the first ``stop()`` returns, :attr:`terminal` is True,
+        ``submit()`` answers with a structured retriable
+        :class:`Overloaded` (reason ``"closed"``), and ``start()``
+        refuses with :class:`ServingInfraError` — an engine is never
+        revived from a half-torn state; build a new one (the compile
+        cache makes that a warm load, not a recompile)."""
         if not self._started or self._closed:
             self._closed = True     # before the sweep — see _batcher_loop
             self._drain_leftovers()
@@ -348,6 +390,46 @@ class ServingEngine:
 
     def close(self) -> None:
         self.stop()
+
+    @property
+    def terminal(self) -> bool:
+        """True once the engine can never serve again (``stop()``
+        finished, or the batcher thread exited and swept the queue):
+        ``submit()`` now returns structured retriable rejections and
+        ``start()`` refuses — the documented end state of the one-way
+        lifecycle."""
+        return self._closed
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def queue_depth(self) -> int:
+        """Current admission-queue depth (the fleet autoscaler's load
+        signal, cheap enough for every supervisor tick)."""
+        return self._q.qsize()
+
+    def batcher_alive(self) -> bool:
+        """True while the batcher thread is running — the liveness probe
+        a fleet supervisor polls."""
+        t = self._thread
+        return bool(t is not None and t.is_alive())
+
+    def batcher_ident(self) -> Optional[int]:
+        """The batcher thread's ident (None before ``start()``) — the
+        chaos harness's kill target."""
+        t = self._thread
+        return t.ident if t is not None else None
+
+    def crashed(self) -> bool:
+        """True when the batcher thread died WITHOUT an orderly drain or
+        stop — an async kill or an escaped internal error.  This (not
+        mere thread death, which a clean drain also produces) is the
+        signal a fleet supervisor keys replica restarts on."""
+        t = self._thread
+        return bool(self._started and t is not None and not t.is_alive()
+                    and not self._draining and
+                    not self._stop_event.is_set())
 
     def __enter__(self) -> "ServingEngine":
         return self

@@ -165,6 +165,31 @@ identically under pytest, a soak script, or a real cluster rehearsal:
                                 read-ahead) must fire and the batch
                                 stream must stay bit-identical.  Once
                                 per plan.
+``bigdl.chaos.killReplicaAt``   "k" or "k:replica": at the fleet's k-th
+                                SUBMITTED request, serving replica
+                                ``replica`` (default 0) of the submitting
+                                service has its batcher thread killed with
+                                an async-raised ``BaseException`` — a hard
+                                crash the engine's internal handler cannot
+                                absorb.  The fleet supervisor must detect
+                                the dead replica, sweep its stranded
+                                in-flight requests into ``shed``, and
+                                restart the slot.  Once per plan.
+``bigdl.chaos.corruptCandidateAt``  k: the k-th rollout candidate PREPARED
+                                gets one float of its weights nudged IN
+                                PLACE after the rollout captured the
+                                expected semantic fingerprint — the
+                                pre-cutover fingerprint re-verification
+                                must refuse promotion and roll back while
+                                the incumbent keeps serving.  Once per
+                                plan.
+``bigdl.chaos.sigtermFleetAt``  k: at the fleet's k-th submitted request
+                                the harness calls
+                                ``elastic.request_preemption`` ONCE — a
+                                fleet-wide SIGTERM.  Every replica
+                                self-drains, in-flight rollouts abort with
+                                rollback, and the fleet's accounting
+                                identity must still balance exactly.
 ==============================  =============================================
 
 Counters are process-local and monotonically increasing from
@@ -239,6 +264,12 @@ class _ChaosState:
             config.get_property("bigdl.chaos.diskFullAt"))
         self.host_pressure_at = config.get_int(
             "bigdl.chaos.hostMemPressureAt", 0)
+        self.kill_replica_at, self.kill_replica_index = _parse_indexed(
+            config.get_property("bigdl.chaos.killReplicaAt"), 0)
+        self.corrupt_candidate_at = config.get_int(
+            "bigdl.chaos.corruptCandidateAt", 0)
+        self.sigterm_fleet_at = config.get_int(
+            "bigdl.chaos.sigtermFleetAt", 0)
         self.writes = 0
         self.steps_failed = 0
         self.steps_seen = 0
@@ -270,6 +301,10 @@ class _ChaosState:
         self.oom_fired = 0
         self.disk_full_fired = 0
         self.pressure_fired = 0
+        self.replica_kills = 0
+        self.candidates_prepared = 0
+        self.candidate_corruptions = 0
+        self.fleet_sigterms = 0
         self._lock = threading.Lock()
 
     # ---- storage-layer hooks -------------------------------------------
@@ -632,6 +667,60 @@ class _ChaosState:
                 self.pressure_fired = 1
         return fire
 
+    # ---- fleet-control-plane hooks -------------------------------------
+
+    def kill_replica(self, submits: int) -> Optional[int]:
+        """Replica index to hard-kill NOW, or None: fires once when the
+        fleet's submitted-request count reaches ``killReplicaAt``.  The
+        fleet async-raises a ``BaseException`` into the victim batcher
+        thread — the crash its supervisor must detect and restart."""
+        if not self.kill_replica_at:
+            return None
+        with self._lock:
+            fire = (submits >= self.kill_replica_at and
+                    self.replica_kills == 0)
+            if fire:
+                self.replica_kills = 1
+        return self.kill_replica_index if fire else None
+
+    def corrupt_candidate(self, model) -> bool:
+        """Called by the rollout path with each candidate model AFTER
+        its expected semantic fingerprint was captured: the
+        ``corruptCandidateAt``-th candidate prepared gets one float
+        nudged IN PLACE (the candidate is what would serve, so the
+        corruption must be visible to the pre-cutover re-verification —
+        unlike ``corrupt_state_before_save``, no protective copy).
+        True when the weights were changed.  Once per plan."""
+        if not self.corrupt_candidate_at:
+            return False
+        with self._lock:
+            self.candidates_prepared += 1
+            fire = (self.candidates_prepared == self.corrupt_candidate_at
+                    and self.candidate_corruptions == 0)
+            if fire:
+                self.candidate_corruptions = 1
+        if not fire:
+            return False
+        return _corrupt_first_float(model)
+
+    def sigterm_fleet(self, submits: int) -> bool:
+        """Fires ``elastic.request_preemption`` once when the fleet's
+        submitted-request count reaches ``sigtermFleetAt`` — the same
+        flag a real SIGTERM handler sets, so every replica self-drains
+        and in-flight rollouts abort exactly as under a scheduler
+        preemption."""
+        if not self.sigterm_fleet_at:
+            return False
+        with self._lock:
+            fire = (submits >= self.sigterm_fleet_at and
+                    self.fleet_sigterms == 0)
+            if fire:
+                self.fleet_sigterms = 1
+        if fire:
+            from bigdl_tpu.utils import elastic
+            elastic.request_preemption("chaos: injected fleet-wide SIGTERM")
+        return fire
+
 
 class CorruptRecord(ChaosError):
     """An injected corrupt ingest record — a DATA fault: the taxonomy
@@ -988,6 +1077,32 @@ def host_mem_pressure(poll_index: int) -> bool:
     if _state is None:
         return False
     return _state.host_mem_pressure(poll_index)
+
+
+def kill_replica(submits: int) -> Optional[int]:
+    """Fleet submit hook (None when disarmed): the replica index whose
+    batcher thread should be hard-killed NOW (once per plan)."""
+    if _state is None:
+        return None
+    return _state.kill_replica(submits)
+
+
+def corrupt_candidate(model) -> bool:
+    """Rollout candidate-prepared hook (False when disarmed): the
+    ``corruptCandidateAt``-th candidate gets one weight float nudged in
+    place, post-fingerprint — True when the model was changed."""
+    if _state is None:
+        return False
+    return _state.corrupt_candidate(model)
+
+
+def sigterm_fleet(submits: int) -> bool:
+    """Fleet submit hook (False when disarmed): requests fleet-wide
+    preemption at the ``sigtermFleetAt``-th submitted request (once per
+    plan)."""
+    if _state is None:
+        return False
+    return _state.sigterm_fleet(submits)
 
 
 def write_count() -> int:

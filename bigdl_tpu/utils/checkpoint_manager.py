@@ -278,6 +278,14 @@ class CheckpointManager:
         #: no further disk writes are attempted
         self._storage_degraded = False
         self._memory_snapshot: Optional[Dict[str, Any]] = None
+        #: watch_latest() poll cache: directory mtime at the last scan,
+        #: the answer it produced, and the snapshots already
+        #: shallow-verified (so an unstable-mtime window re-lists names
+        #: but never re-reads manifests)
+        self._watch_mtime: Optional[float] = None
+        self._watch_latest: Optional[int] = None
+        self._watch_scanned = False
+        self._watch_verified: set = set()
         #: manifest of the snapshot load_latest most recently restored
         self.last_loaded_manifest: Optional[Dict[str, Any]] = None
         #: topology decision of that load: "same", "reshard", or None
@@ -596,6 +604,50 @@ class CheckpointManager:
                 return (file_io.join(self.path, f"model.{n}"),
                         file_io.join(self.path, f"optimMethod.{n}"), n)
         return None
+
+    def watch_latest(self) -> Optional[int]:
+        """O(1)-per-tick poll for newly COMMITTED snapshots — the fleet
+        promotion watcher's fast path.
+
+        :meth:`latest_valid` lists the directory and stats payloads on
+        every call; at a supervisor cadence of tens of hertz that is
+        thousands of metadata round trips a minute against a usually
+        idle directory.  This helper keys on the directory's mtime —
+        every ``commit.N`` marker rename touches the parent directory —
+        so while the mtime holds steady the cached answer returns after
+        ONE stat: no listing, no manifest reads.  When the mtime moves,
+        the names-only candidate scan reruns and any snapshot not
+        already known good is shallow-verified once, then remembered.
+        Because directory mtimes on some stores carry whole-second
+        granularity, a scan taken while the directory is "hot" (mtime
+        within the last ~2 s) is not trusted as a fast-path anchor — the
+        next tick re-lists names, but the verified-set cache still keeps
+        manifest reads at one per NEW snapshot.
+
+        Returns the N of the newest committed, shallow-verified
+        snapshot, or None when there is none.  Deep verification —
+        payload checksums plus the semantic fingerprint — stays where
+        the bytes are read anyway: the :meth:`load_latest` call the
+        watcher makes when it decides to promote.  Disk-full degraded
+        in-memory snapshots are deliberately invisible here: they are
+        not committed durable state and must not trigger a promotion."""
+        from bigdl_tpu.utils import file_io
+        mtime = file_io.modified_time(self.path)
+        stable = (mtime is not None and (time.time() - mtime) >= 2.0)
+        if self._watch_scanned and stable and mtime == self._watch_mtime:
+            return self._watch_latest
+        latest: Optional[int] = None
+        cands = self.candidates()
+        self._watch_verified &= {n for n, _ in cands}
+        for n, has_manifest in cands:
+            if n in self._watch_verified or self.verify(n, has_manifest):
+                self._watch_verified.add(n)
+                latest = n
+                break
+        self._watch_mtime = mtime if stable else None
+        self._watch_latest = latest
+        self._watch_scanned = stable
+        return latest
 
     def load_latest(self, expected_topology: Optional[Dict[str, Any]] = None
                     ) -> Optional[Tuple[Any, Any, int]]:

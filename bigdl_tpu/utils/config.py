@@ -53,6 +53,13 @@ _DEFAULTS: Dict[str, Any] = {
     "bigdl.chaos.poisonRequestAt": None,  # "k" / "k:m": admission positions k..m poison
     "bigdl.chaos.hangDispatchAt": None,   # "k" / "k:seconds": k-th batch dispatch wedges
     "bigdl.chaos.burstArrivals": None,    # "k" / "k:n": n extra arrivals at position k
+    # fleet-control-plane faults (bigdl_tpu/fleet)
+    "bigdl.chaos.killReplicaAt": None,    # "k" / "k:replica": async-kill a replica's
+    # batcher thread at the fleet's k-th submitted request
+    "bigdl.chaos.corruptCandidateAt": 0,  # k: corrupt the k-th rollout candidate's
+    # weights after its fingerprint is taken (pre-cutover verify must catch it)
+    "bigdl.chaos.sigtermFleetAt": 0,      # k: fleet-wide preemption (SIGTERM) at
+    # the fleet's k-th submitted request
     # elastic training (utils/elastic.py): topology-elastic restore +
     # graceful preemption
     "bigdl.elastic.gracePeriod": 30.0,  # seconds for the final drain+snapshot
@@ -97,6 +104,28 @@ _DEFAULTS: Dict[str, Any] = {
     "bigdl.serving.warmupBatches": 3,      # dispatch-EMA warmup (compile exemption)
     "bigdl.serving.cooldownSteps": 8,      # batches after a watchdog fire before re-admission
     "bigdl.serving.gracePeriod": 5.0,      # drain window for SIGTERM / stop, seconds
+    # fleet control plane (bigdl_tpu/fleet): N models x N replicas under one
+    # supervisor — zero-downtime hot swap, blue/green rollout gated on the
+    # semantic checkpoint fingerprint + shadow-traffic parity, crash restarts,
+    # replica autoscaling, checkpoint-to-serving promotion
+    "bigdl.fleet.replicas": 1,             # replicas per service at add_model
+    "bigdl.fleet.minReplicas": 1,          # autoscale floor
+    "bigdl.fleet.maxReplicas": 4,          # autoscale ceiling
+    "bigdl.fleet.pollInterval": 0.05,      # supervisor tick period, seconds
+    "bigdl.fleet.maxReplicaRestarts": 2,   # crash restarts per replica slot
+    "bigdl.fleet.gracePeriod": 5.0,        # retired-replica drain window, seconds
+    "bigdl.fleet.shadowSample": 8,         # live requests mirrored per rollout
+    "bigdl.fleet.parityMode": "bitwise",   # bitwise | allclose | off
+    "bigdl.fleet.parityRtol": 1e-5,        # allclose rtol for shadow parity
+    "bigdl.fleet.parityAtol": 1e-6,        # allclose atol for shadow parity
+    "bigdl.fleet.promotionPollSec": 0.2,   # checkpoint watch_latest cadence
+    "bigdl.fleet.autoscale.enabled": False,   # scale replica count per service
+    "bigdl.fleet.autoscale.intervalSec": 0.25,  # decision cadence
+    "bigdl.fleet.autoscale.upQueueFrac": 0.5,   # mean queue fill frac -> +1
+    "bigdl.fleet.autoscale.downQueueFrac": 0.05,  # below this -> -1 toward floor
+    "bigdl.fleet.autoscale.p99Factor": 0.8,  # +1 when p99 > factor x deadline
+    "bigdl.fleet.autoscale.patience": 2,   # consecutive signals before acting
+    "bigdl.fleet.autoscale.cooldown": 3,   # hold intervals after an action
     # streaming ingest engine (dataset/ingest.py): stage-pipelined
     # real-data path — sharded seqfile readers -> record ring -> decode
     # pool -> decoded window -> native assembler -> batch ring -> device

@@ -289,3 +289,80 @@ class TestRemoteScheme:
                          "optimMethod.2"}
         assert mgr.latest_valid()[2] == 2
         assert mgr.load_latest()[2] == 2
+
+
+class TestWatchLatest:
+    """The fleet promotion watcher's O(1)-per-tick poll (ISSUE 17)."""
+
+    def test_empty_then_sees_new_commits(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        assert mgr.watch_latest() is None
+        mgr.save(_mlp(), _sgd(), 1)
+        assert mgr.watch_latest() == 1
+        mgr.save(_mlp(), _sgd(), 5)
+        assert mgr.watch_latest() == 5
+
+    def test_steady_state_is_one_stat_no_listing(self, tmp_path,
+                                                 monkeypatch):
+        """While the directory mtime holds stable, repeat polls return
+        the cached answer after a single stat — no listdir, no manifest
+        reads."""
+        import time as _time
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(_mlp(), _sgd(), 3)
+        # age the directory past the hot-window guard so the mtime is a
+        # trustworthy fast-path anchor
+        old = _time.time() - 60.0
+        os.utime(tmp_path, (old, old))
+        assert mgr.watch_latest() == 3
+        calls = {"candidates": 0}
+        real = mgr.candidates
+
+        def counting():
+            calls["candidates"] += 1
+            return real()
+
+        monkeypatch.setattr(mgr, "candidates", counting)
+        for _ in range(50):
+            assert mgr.watch_latest() == 3
+        assert calls["candidates"] == 0
+
+    def test_verify_runs_once_per_new_snapshot(self, tmp_path,
+                                               monkeypatch):
+        """A hot directory (mtime within the guard window) re-lists
+        names every tick, but known-good snapshots are never
+        re-verified — manifest reads stay at one per NEW snapshot."""
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(_mlp(), _sgd(), 1)
+        calls = {"verify": 0}
+        real = mgr.verify
+
+        def counting(n, has_manifest, deep=False):
+            calls["verify"] += 1
+            return real(n, has_manifest, deep)
+
+        monkeypatch.setattr(mgr, "verify", counting)
+        for _ in range(10):
+            assert mgr.watch_latest() == 1
+        assert calls["verify"] == 1
+        mgr.save(_mlp(), _sgd(), 2)
+        for _ in range(10):
+            assert mgr.watch_latest() == 2
+        assert calls["verify"] == 2
+
+    def test_uncommitted_snapshot_is_invisible(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(_mlp(), _sgd(), 1)
+        assert mgr.watch_latest() == 1
+        mgr2 = CheckpointManager(str(tmp_path))
+        mgr2.save(_mlp(), _sgd(), 9)
+        os.remove(tmp_path / "commit.9")
+        assert mgr.watch_latest() == 1
+
+    def test_corrupt_newest_falls_back_to_older(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(_mlp(), _sgd(), 1)
+        mgr.save(_mlp(), _sgd(), 2)
+        with open(tmp_path / "model.2", "r+b") as f:
+            f.truncate(10)
+        assert mgr.watch_latest() == 1

@@ -618,3 +618,87 @@ class TestUntrackedJitLint:
             "f = jax.jit(lambda x: x)\n"
             "e = f.lower(1).compile()\n"), name="utils/compile_cache.py")
         assert "untracked-jit" not in rules
+
+
+# ---------------------------------------------------------------------------
+# concurrent readers of one cache dir (ISSUE 17 satellite)
+# ---------------------------------------------------------------------------
+
+class TestConcurrentReaders:
+    def test_two_warm_loaders_while_a_third_writes(self, cache_dir):
+        """The fleet hot-swap access pattern: replicas of a candidate
+        warm-load the SAME committed entry concurrently while another
+        engine's compile stores a brand-new one into the same directory
+        — readers never observe a torn entry, never take a fresh
+        compile, and serve bit-identical results."""
+        import threading
+
+        from bigdl_tpu.serving import ServingEngine
+
+        config.set_property("bigdl.compile.buckets", "2,4")
+        try:
+            def eval_model(seed=7):
+                m = (nn.Sequential().add(nn.Linear(4, 16)).add(nn.Tanh())
+                     .add(nn.Linear(16, 3)))
+                m.reset(jax.random.PRNGKey(seed))
+                return m
+
+            def step_of(model):
+                fn = model._eval_jit[id(None)]
+                return getattr(fn, "__wrapped__", fn)
+
+            row = np.arange(4, dtype=np.float32)
+            seeder = ServingEngine(eval_model())
+            seeder.warmup(np.zeros((4,), np.float32))
+            assert step_of(seeder.model).compiles >= 1
+            want = seeder.submit(row).result(timeout=10.0)
+            seeder.stop()
+
+            barrier = threading.Barrier(3)
+            results, errors = {}, []
+
+            def reader(tag):
+                try:
+                    model = eval_model()
+                    barrier.wait(timeout=10)
+                    eng = ServingEngine(model)
+                    eng.warmup(np.zeros((4,), np.float32))
+                    results[tag] = (np.asarray(
+                        eng.submit(row).result(timeout=10.0)),
+                        step_of(model))
+                    eng.stop()
+                except Exception as e:       # surfaced after join
+                    errors.append((tag, e))
+
+            def writer():
+                try:
+                    cc = CompileCache(cache_dir)
+                    fp = backend_fingerprint()
+                    barrier.wait(timeout=10)
+                    for i in range(20):
+                        assert cc.store(f"feed{i:02d}", b"x" * 256,
+                                        "probe", f"sig{i}", None, fp)
+                except Exception as e:
+                    errors.append(("writer", e))
+
+            threads = [threading.Thread(target=reader, args=("r1",)),
+                       threading.Thread(target=reader, args=("r2",)),
+                       threading.Thread(target=writer)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert errors == []
+            for tag in ("r1", "r2"):
+                out, step = results[tag]
+                assert step.compiles == 0, \
+                    f"{tag} recompiled under a concurrent writer"
+                assert step.cache_hits >= 1
+                np.testing.assert_array_equal(out, want)
+            # the writer's entries all committed despite the read storm
+            cc = CompileCache(cache_dir)
+            fp = backend_fingerprint()
+            for i in range(20):
+                assert cc.load(f"feed{i:02d}", None, fp) == b"x" * 256
+        finally:
+            config.clear_property("bigdl.compile.buckets")

@@ -1,0 +1,689 @@
+"""Fleet control plane: chaos-proven multi-model serving with
+zero-downtime hot swap (ISSUE 17).
+
+The claims under test (bigdl_tpu/fleet/):
+
+- zero-downtime hot swap: a candidate warm-loads and warms beside the
+  serving incumbent, traffic shifts atomically at cutover, the old
+  replicas drain gracefully — ZERO requests lost during a clean rollout
+  (nothing shed, nothing quarantined, nothing unaccounted);
+- gated blue/green: the rollout refuses a candidate whose semantic
+  fingerprint rotted between prepare and cutover
+  (``bigdl.chaos.corruptCandidateAt``) or whose shadow-mirrored outputs
+  diverge from the incumbent's, and rolls back automatically with the
+  incumbent never missing a request;
+- replica lifecycle supervision: a hard-killed replica
+  (``bigdl.chaos.killReplicaAt``) is detected, its stranded in-flight
+  requests are swept into ``shed`` (retriable), and the slot restarts
+  within its budget; autoscaling follows queue depth + p99 latency under
+  the host-memory governor's ceiling; a committed checkpoint promotes to
+  serving as ONE verified step;
+- and through ALL of it — including a fleet-wide SIGTERM mid-plan — the
+  fleet accounting identity is exact:
+  ``completed + shed + rejected + quarantined == submitted``.
+"""
+
+import os
+import re
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import bigdl_tpu.nn as nn
+import bigdl_tpu.optim as optim
+from bigdl_tpu import telemetry
+from bigdl_tpu.fleet import (Fleet, FleetAutoscalePolicy, FleetSupervisor,
+                             Replica, ReplicaKilled)
+from bigdl_tpu.serving.engine import OUTCOMES, Overloaded, ServingInfraError
+from bigdl_tpu.utils import chaos, config, elastic
+from bigdl_tpu.utils.checkpoint_manager import CheckpointManager
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DIN, DOUT = 4, 3
+
+_FLEET_KEYS = (
+    "bigdl.compile.buckets",
+    "bigdl.fleet.replicas", "bigdl.fleet.maxReplicaRestarts",
+    "bigdl.fleet.gracePeriod", "bigdl.fleet.shadowSample",
+    "bigdl.fleet.parityMode", "bigdl.fleet.promotionPollSec",
+    "bigdl.fleet.autoscale.enabled", "bigdl.fleet.autoscale.intervalSec",
+    "bigdl.chaos.killReplicaAt", "bigdl.chaos.corruptCandidateAt",
+    "bigdl.chaos.sigtermFleetAt",
+)
+
+
+@pytest.fixture(autouse=True)
+def _fleet_env():
+    """Disarmed chaos, cleared preemption, clean knobs around every
+    test."""
+    elastic.clear_preemption()
+    config.set_property("bigdl.compile.buckets", "2,4")
+    yield
+    chaos.uninstall()
+    elastic.clear_preemption()
+    for k in _FLEET_KEYS:
+        config.clear_property(k)
+
+
+def _model(seed=7):
+    m = (nn.Sequential().add(nn.Linear(DIN, 16)).add(nn.Tanh())
+         .add(nn.Linear(16, DOUT)))
+    m.reset(jax.random.PRNGKey(seed))
+    return m
+
+
+_ROW = np.zeros((DIN,), np.float32)
+#: generous per-request deadline: these tests assert accounting and
+#: lifecycle, not tail latency — a CPU-CI hiccup must not shed for us
+_ENGINE_KW = {"deadline_ms": 5000.0}
+
+
+def _fleet(replicas=2, **kw):
+    fleet = Fleet(poll_interval=0.02, **kw)
+    fleet.add_model("svc", _model(), replicas=replicas, warm_row=_ROW,
+                    engine_kw=dict(_ENGINE_KW))
+    return fleet
+
+
+def _rows(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((n, DIN)).astype(np.float32)
+
+
+def _assert_identity(stats):
+    assert stats["unaccounted"] == 0, stats
+    assert sum(stats[o] for o in OUTCOMES) == stats["submitted"], stats
+
+
+def _wait(predicate, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+# ---------------------------------------------------------------------------
+# autoscale policy units
+# ---------------------------------------------------------------------------
+
+class TestAutoscalePolicy:
+    def _policy(self, patience=2, cooldown=3):
+        return FleetAutoscalePolicy(1, 4, up_queue_frac=0.5,
+                                    down_queue_frac=0.05, p99_factor=0.8,
+                                    patience=patience, cooldown=cooldown)
+
+    def test_scale_up_needs_patience(self):
+        p = self._policy()
+        assert p.decide(0.9, 0.0, 100.0, 1) == 0
+        assert p.decide(0.9, 0.0, 100.0, 1) == 1
+
+    def test_hot_p99_scales_up_with_shallow_queue(self):
+        p = self._policy()
+        assert p.decide(0.0, 90.0, 100.0, 1) == 0
+        assert p.decide(0.0, 90.0, 100.0, 1) == 1
+
+    def test_cooldown_holds_after_action(self):
+        p = self._policy(patience=1, cooldown=2)
+        assert p.decide(0.9, 0.0, 100.0, 1) == 1
+        assert p.decide(0.9, 0.0, 100.0, 2) == 0      # cooldown 1
+        assert p.decide(0.9, 0.0, 100.0, 2) == 0      # cooldown 2
+        assert p.decide(0.9, 0.0, 100.0, 2) == 1
+
+    def test_scale_down_on_idle(self):
+        p = self._policy(patience=2, cooldown=0)
+        assert p.decide(0.0, 0.0, 100.0, 3) == 0
+        assert p.decide(0.0, 0.0, 100.0, 3) == -1
+
+    def test_never_below_floor_or_above_ceiling(self):
+        p = self._policy(patience=1, cooldown=0)
+        assert p.decide(0.0, 0.0, 100.0, 1) == 0      # at the floor
+        assert p.decide(0.99, 200.0, 100.0, 4) == 0   # at the ceiling
+
+    def test_memory_pressure_caps_and_steps_down(self):
+        p = self._policy(patience=1, cooldown=0)
+        # pressure forbids up even with a saturated queue...
+        assert p.decide(0.99, 0.0, 100.0, 1, under_pressure=True) == 0
+        # ...and forces a step down while above the floor
+        assert p.decide(0.99, 0.0, 100.0, 3, under_pressure=True) == -1
+
+    def test_flapping_signal_never_acts(self):
+        p = self._policy(patience=2, cooldown=0)
+        for _ in range(5):
+            assert p.decide(0.9, 0.0, 100.0, 2) == 0
+            assert p.decide(0.2, 0.0, 100.0, 2) == 0  # streak reset
+
+    def test_deterministic_replay(self):
+        seq = [(0.9, 0.0), (0.9, 0.0), (0.0, 90.0), (0.0, 0.0),
+               (0.0, 0.0), (0.0, 0.0), (0.9, 0.0)]
+        runs = []
+        for _ in range(2):
+            p = self._policy()
+            runs.append([p.decide(q, l, 100.0, 2) for q, l in seq])
+        assert runs[0] == runs[1]
+
+
+# ---------------------------------------------------------------------------
+# basics: routing, accounting, lifecycle
+# ---------------------------------------------------------------------------
+
+class TestFleetBasics:
+    def test_serves_across_replicas_with_exact_identity(self):
+        fleet = _fleet(replicas=2)
+        try:
+            handles = [fleet.submit("svc", r) for r in _rows(24)]
+            outs = [h.result(timeout=10.0) for h in handles]
+            assert all(o.shape == (DOUT,) for o in outs)
+            assert fleet.quiesce(10.0)
+            s = fleet.stats("svc")
+            assert s["completed"] == 24 and s["replicas"] == 2
+            _assert_identity(s)
+        finally:
+            fleet.stop()
+
+    def test_results_bit_identical_across_replicas(self):
+        """Round-robin must be invisible: every replica of one version
+        answers bit-identically."""
+        fleet = _fleet(replicas=2)
+        try:
+            row = _rows(1)[0]
+            outs = [np.asarray(fleet.submit("svc", row).result(timeout=10.0))
+                    for _ in range(4)]
+            for o in outs[1:]:
+                np.testing.assert_array_equal(o, outs[0])
+        finally:
+            fleet.stop()
+
+    def test_unknown_service_is_a_keyerror(self):
+        fleet = _fleet(replicas=1)
+        try:
+            with pytest.raises(KeyError, match="unknown service"):
+                fleet.submit("nope", _ROW)
+            with pytest.raises(ValueError, match="already registered"):
+                fleet.add_model("svc", _model())
+        finally:
+            fleet.stop()
+
+    def test_stop_is_idempotent_and_final(self):
+        fleet = _fleet(replicas=1)
+        fleet.submit("svc", _ROW).result(timeout=10.0)
+        fleet.stop()
+        fleet.stop()
+        assert not fleet.supervisor.alive()
+        with pytest.raises(Overloaded):
+            fleet.submit("svc", _ROW)
+        _assert_identity(fleet.stats("svc"))
+
+    def test_supervisor_owns_every_fleet_thread(self):
+        fleet = _fleet(replicas=1)
+        try:
+            names = [t.name for t in fleet.supervisor.threads()]
+            assert "fleet-supervisor" in names
+            assert fleet.supervisor.ticks >= 0
+            assert _wait(lambda: fleet.supervisor.ticks > 0, 5.0)
+            assert fleet.supervisor.tick_errors == 0
+        finally:
+            fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# zero-downtime hot swap
+# ---------------------------------------------------------------------------
+
+class TestHotSwap:
+    def test_clean_rollout_under_load_loses_zero_requests(self):
+        """THE headline: live traffic flows continuously while the fleet
+        swaps versions — no request is lost (shed == quarantined ==
+        unaccounted == 0; everything completed or was rejected at the
+        door, retriably)."""
+        import threading
+
+        fleet = _fleet(replicas=2)
+        stop = threading.Event()
+        errors = []
+
+        def load():
+            rng = np.random.default_rng(1)
+            while not stop.is_set():
+                try:
+                    fleet.submit(
+                        "svc", rng.standard_normal((DIN,)).astype(
+                            np.float32))
+                except Overloaded:
+                    pass                      # rejected at the door: not lost
+                except Exception as e:        # anything else IS a loss
+                    errors.append(e)
+                time.sleep(0.003)
+
+        t = threading.Thread(target=load)
+        t.start()
+        try:
+            _wait(lambda: fleet.stats("svc")["completed"] > 5, 10.0)
+            report = fleet.rollout("svc", _model(seed=7), parity="bitwise")
+            assert report.promoted and not report.rolled_back
+            assert report.to_version == "v2"
+            # keep serving on the new version, then drain the ledger
+            _wait(lambda: fleet.stats("svc")["completed"] > 0, 5.0)
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        assert errors == []
+        assert fleet.quiesce(15.0)
+        s = fleet.stats("svc")
+        _assert_identity(s)
+        assert s["shed"] == 0 and s["quarantined"] == 0, \
+            f"requests lost during a clean rollout: {s}"
+        assert s["completed"] > 0 and s["version"] == "v2"
+        # swap-to-first-served latency was measured on the new version
+        assert _wait(lambda: fleet.stats("svc")["last_swap_to_serve_ms"]
+                     is not None, 5.0)
+        assert fleet.stats("svc")["last_swap_to_serve_ms"] >= 0.0
+        fleet.stop()
+
+    def test_shadow_parity_runs_on_live_traffic(self):
+        fleet = _fleet(replicas=1)
+        try:
+            for r in _rows(10):
+                fleet.submit("svc", r).result(timeout=10.0)
+            assert fleet.quiesce(10.0)
+            report = fleet.rollout("svc", _model(seed=7), parity="bitwise")
+            assert report.promoted
+            assert report.parity_checked > 0, \
+                "shadow traffic must actually mirror live requests"
+            assert report.parity_max_abs_diff == 0.0
+        finally:
+            fleet.stop()
+
+    def test_rollout_with_no_traffic_is_vacuously_clean(self):
+        fleet = _fleet(replicas=1)
+        try:
+            report = fleet.rollout("svc", _model(seed=7), parity="bitwise")
+            assert report.promoted and report.parity_checked == 0
+            assert any("vacuously" in n for n in report.notes)
+        finally:
+            fleet.stop()
+
+    def test_sequential_rollouts_bump_versions(self):
+        fleet = _fleet(replicas=1)
+        try:
+            assert fleet.rollout("svc", _model(7), parity="off").promoted
+            assert fleet.rollout("svc", _model(8), parity="off").promoted
+            assert fleet.stats("svc")["version"] == "v3"
+            fleet.submit("svc", _ROW).result(timeout=10.0)
+        finally:
+            fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# gated blue/green: rollback paths
+# ---------------------------------------------------------------------------
+
+class TestRollback:
+    def test_corrupt_candidate_rolls_back_on_fingerprint(self):
+        """``bigdl.chaos.corruptCandidateAt``: weights rot after the
+        expected fingerprint is captured — VERIFY refuses, the candidate
+        is retired, and the incumbent answers the very next request."""
+        config.set_property("bigdl.chaos.corruptCandidateAt", 1)
+        chaos.install()
+        fleet = _fleet(replicas=1)
+        try:
+            before = np.asarray(
+                fleet.submit("svc", _ROW).result(timeout=10.0))
+            report = fleet.rollout("svc", _model(seed=7), parity="bitwise")
+            assert report.rolled_back and not report.promoted
+            assert "fingerprint" in report.reason
+            assert report.fingerprint_observed != \
+                report.fingerprint_expected
+            assert chaos._state.candidate_corruptions == 1
+            after = np.asarray(
+                fleet.submit("svc", _ROW).result(timeout=10.0))
+            np.testing.assert_array_equal(after, before)
+            assert fleet.stats("svc")["version"] == "v1"
+        finally:
+            fleet.stop()
+
+    def test_divergent_candidate_rolls_back_on_parity(self):
+        """Bit-wise shadow parity: a candidate with different weights
+        must never survive an infra-swap rollout."""
+        fleet = _fleet(replicas=1)
+        try:
+            for r in _rows(6):
+                fleet.submit("svc", r).result(timeout=10.0)
+            assert fleet.quiesce(10.0)
+            report = fleet.rollout("svc", _model(seed=99), parity="bitwise")
+            assert report.rolled_back and "parity" in report.reason
+            assert report.parity_max_abs_diff > 0.0
+            assert fleet.stats("svc")["version"] == "v1"
+            fleet.submit("svc", _ROW).result(timeout=10.0)
+        finally:
+            fleet.stop()
+
+    def test_allclose_parity_admits_tiny_drift_only(self):
+        fleet = _fleet(replicas=1)
+        try:
+            for r in _rows(6):
+                fleet.submit("svc", r).result(timeout=10.0)
+            assert fleet.quiesce(10.0)
+            # same weights under allclose: promoted
+            assert fleet.rollout("svc", _model(seed=7),
+                                 parity="allclose").promoted
+            # different weights exceed rtol/atol: rolled back
+            report = fleet.rollout("svc", _model(seed=99),
+                                   parity="allclose")
+            assert report.rolled_back and "parity" in report.reason
+        finally:
+            fleet.stop()
+
+    def test_unknown_parity_mode_is_an_error(self):
+        fleet = _fleet(replicas=1)
+        try:
+            with pytest.raises(ValueError, match="parity mode"):
+                fleet.rollout("svc", _model(), parity="vibes")
+        finally:
+            fleet.stop()
+
+    def test_preemption_mid_rollout_aborts_to_incumbent(self):
+        """SIGTERM between rollout phases: the router must never point
+        at a half-warmed candidate."""
+        fleet = _fleet(replicas=1)
+        try:
+            elastic.request_preemption("test: mid-rollout SIGTERM")
+            report = fleet.rollout("svc", _model(seed=7), parity="off")
+            assert report.rolled_back and "preempted" in report.reason
+            assert fleet.stats("svc")["version"] == "v1"
+        finally:
+            fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# replica lifecycle supervision
+# ---------------------------------------------------------------------------
+
+class TestReplicaSupervision:
+    def test_killed_replica_restarts_and_identity_survives(self):
+        """``bigdl.chaos.killReplicaAt``: an async hard-kill strands the
+        batcher's in-flight batch unaccounted at the ENGINE — the
+        supervisor sweep abandons those handles into ``shed`` and
+        restarts the slot, and the FLEET identity stays exact."""
+        config.set_property("bigdl.chaos.killReplicaAt", "10:0")
+        chaos.install()
+        fleet = _fleet(replicas=2)
+        try:
+            for r in _rows(40):
+                try:
+                    fleet.submit("svc", r)
+                except Overloaded:
+                    pass
+                time.sleep(0.005)
+            assert chaos._state.replica_kills == 1
+            assert _wait(lambda: fleet.stats("svc")["restarts"] >= 1, 10.0)
+            assert fleet.quiesce(15.0)
+            s = fleet.stats("svc")
+            _assert_identity(s)
+            assert s["replicas"] == 2, "the killed slot must be replaced"
+            # the restarted fleet still serves
+            fleet.submit("svc", _ROW).result(timeout=10.0)
+        finally:
+            fleet.stop()
+        _assert_identity(fleet.stats("svc"))
+
+    def test_restart_budget_exhausted_abandons_slot(self):
+        config.set_property("bigdl.fleet.maxReplicaRestarts", 0)
+        fleet = _fleet(replicas=2)
+        try:
+            svc = fleet._services["svc"]
+            assert svc.kill_replica(0)
+            assert _wait(lambda: fleet.stats("svc")["replicas"] == 1, 10.0)
+            assert _wait(
+                lambda: not any(r.crashed() for r in
+                                svc.active_replicas()), 5.0)
+            # N-1 replicas, still serving, identity intact
+            fleet.submit("svc", _ROW).result(timeout=10.0)
+            assert fleet.quiesce(10.0)
+            _assert_identity(fleet.stats("svc"))
+            assert fleet.stats("svc")["restarts"] == 0
+        finally:
+            fleet.stop()
+
+    def test_autoscale_wiring_adds_and_retires_replicas(self):
+        """The supervisor's autoscale tick translates policy decisions
+        into replica lifecycle (the policy itself is unit-tested above;
+        here it is forced, so the test is deterministic)."""
+        config.set_property("bigdl.fleet.autoscale.enabled", True)
+        config.set_property("bigdl.fleet.autoscale.intervalSec", 0.02)
+        fleet = _fleet(replicas=1)
+        try:
+            svc = fleet._services["svc"]
+            svc._policy.decide = lambda *a, **k: 1
+            assert _wait(lambda: fleet.stats("svc")["replicas"] == 2, 10.0)
+            svc._policy.decide = lambda *a, **k: -1
+            assert _wait(lambda: fleet.stats("svc")["replicas"] == 1, 10.0)
+            fleet.submit("svc", _ROW).result(timeout=10.0)
+            assert fleet.quiesce(10.0)
+            _assert_identity(fleet.stats("svc"))
+        finally:
+            fleet.stop()
+
+    def test_fleet_sigterm_drains_with_exact_accounting(self):
+        config.set_property("bigdl.chaos.sigtermFleetAt", 5)
+        chaos.install()
+        fleet = _fleet(replicas=1)
+        try:
+            rejected = 0
+            for r in _rows(30):
+                try:
+                    fleet.submit("svc", r)
+                except Overloaded:
+                    rejected += 1
+                time.sleep(0.01)
+            assert chaos._state.fleet_sigterms == 1
+            assert elastic.preemption_requested()
+            assert rejected > 0, "late arrivals must reject retriably"
+            assert fleet.quiesce(15.0)
+            s = fleet.stats("svc")
+            _assert_identity(s)
+            assert s["completed"] > 0
+        finally:
+            fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint-to-serving promotion
+# ---------------------------------------------------------------------------
+
+class TestPromotion:
+    def _save(self, tmp_path, seed=7, n=1):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(_model(seed), optim.SGD(learning_rate=0.1), n)
+        return mgr
+
+    def test_new_snapshot_promotes_as_one_verified_step(self, tmp_path):
+        config.set_property("bigdl.fleet.promotionPollSec", 0.05)
+        fleet = _fleet(replicas=1)
+        try:
+            for r in _rows(4):
+                fleet.submit("svc", r).result(timeout=10.0)
+            fleet.watch("svc", str(tmp_path))
+            self._save(tmp_path, seed=7, n=3)
+            # wait on last_promotion, not version: the version flips at
+            # cutover, a beat before promotion_tick records the report
+            assert _wait(
+                lambda: fleet._services["svc"].last_promotion is not None,
+                15.0), fleet.stats("svc")
+            rep = fleet._services["svc"].last_promotion
+            assert rep.promoted
+            assert fleet.stats("svc")["version"] == "v2"
+            fleet.submit("svc", _ROW).result(timeout=10.0)
+            assert fleet.quiesce(10.0)
+            _assert_identity(fleet.stats("svc"))
+            # the same snapshot is never promoted twice
+            time.sleep(0.5)
+            assert fleet.stats("svc")["version"] == "v2"
+        finally:
+            fleet.stop()
+
+    def test_corrupt_snapshot_never_reaches_serving(self, tmp_path):
+        """A bitflipped payload passes the cheap watch poll but fails
+        deep verification at load — promotion is refused ONCE (no retry
+        loop) and the incumbent keeps serving."""
+        config.set_property("bigdl.fleet.promotionPollSec", 0.05)
+        fleet = _fleet(replicas=1)
+        try:
+            fleet.watch("svc", str(tmp_path))
+            self._save(tmp_path, seed=9, n=1)
+            path = tmp_path / "model.1"
+            blob = bytearray(path.read_bytes())
+            blob[len(blob) // 2] ^= 0xFF
+            path.write_bytes(bytes(blob))
+            svc = fleet._services["svc"]
+            assert _wait(lambda: svc._promo_attempted == 1, 15.0)
+            time.sleep(0.3)
+            assert fleet.stats("svc")["version"] == "v1"
+            assert svc.last_promotion is None
+            fleet.submit("svc", _ROW).result(timeout=10.0)
+        finally:
+            fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# the combined-chaos acceptance plan (ISSUE 17 acceptance criterion)
+# ---------------------------------------------------------------------------
+
+class TestCombinedChaosPlan:
+    def test_kill_plus_corrupt_plus_sigterm_exact_accounting(self):
+        """One plan arms all three fleet injectors: a replica hard-kill
+        mid-traffic, a corrupted candidate during the rollout (rollback
+        observed while the incumbent serves), then a fleet-wide SIGTERM.
+        The fleet accounting identity must hold EXACTLY across all of
+        it, and every chaos counter must show its fault actually
+        fired."""
+        config.set_property("bigdl.chaos.killReplicaAt", "8:0")
+        config.set_property("bigdl.chaos.corruptCandidateAt", 1)
+        config.set_property("bigdl.chaos.sigtermFleetAt", 60)
+        chaos.install()
+        fleet = _fleet(replicas=2)
+        try:
+            # phase A: traffic; the kill fires at fleet submit #8
+            for r in _rows(24, seed=1):
+                try:
+                    fleet.submit("svc", r)
+                except Overloaded:
+                    pass
+                time.sleep(0.005)
+            assert chaos._state.replica_kills == 1
+            assert _wait(lambda: fleet.stats("svc")["restarts"] >= 1, 10.0)
+
+            # phase B: rollout mid-plan; the candidate corrupts after
+            # fingerprint capture -> rollback, incumbent still serving
+            report = fleet.rollout("svc", _model(seed=7), parity="bitwise")
+            assert report.rolled_back and "fingerprint" in report.reason
+            assert chaos._state.candidate_corruptions == 1
+            fleet.submit("svc", _ROW).result(timeout=10.0)
+            assert fleet.stats("svc")["version"] == "v1"
+
+            # phase C: keep submitting until the fleet-wide SIGTERM at
+            # submit #60 flips everything to draining
+            rejected_late = 0
+            for r in _rows(60, seed=2):
+                try:
+                    fleet.submit("svc", r)
+                except Overloaded:
+                    rejected_late += 1
+                time.sleep(0.004)
+            assert chaos._state.fleet_sigterms == 1
+            assert elastic.preemption_requested()
+            assert rejected_late > 0
+
+            # the ledger closes exactly across every fault
+            assert fleet.quiesce(20.0)
+            s = fleet.stats("svc")
+            _assert_identity(s)
+            agg = fleet.stats()["fleet"]
+            assert agg["unaccounted"] == 0
+            assert sum(agg[o] for o in OUTCOMES) == agg["submitted"]
+            assert s["completed"] > 0 and s["rejected"] > 0
+        finally:
+            fleet.stop()
+        _assert_identity(fleet.stats("svc"))
+
+
+# ---------------------------------------------------------------------------
+# lint rule: unsupervised-thread-in-fleet
+# ---------------------------------------------------------------------------
+
+class TestFleetThreadLint:
+    def _lint(self, tmp_path, code, name="fleet/thing.py"):
+        from bigdl_tpu.analysis.lint import lint_paths
+        path = tmp_path / name
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(code)
+        return [f.rule for f in lint_paths([str(tmp_path)])]
+
+    def test_flags_raw_thread_in_fleet(self, tmp_path):
+        rules = self._lint(tmp_path, (
+            "import threading\n"
+            "t = threading.Thread(target=print)\n"
+            "from threading import Thread\n"
+            "u = Thread(target=print)\n"))
+        assert rules.count("unsupervised-thread-in-fleet") == 2
+
+    def test_outside_fleet_is_exempt(self, tmp_path):
+        rules = self._lint(tmp_path, (
+            "import threading\n"
+            "t = threading.Thread(target=print)\n"),
+            name="serving/thing.py")
+        assert "unsupervised-thread-in-fleet" not in rules
+
+    def test_inline_allow_silences(self, tmp_path):
+        rules = self._lint(tmp_path, (
+            "import threading\n"
+            "t = threading.Thread(  "
+            "# lint: allow(unsupervised-thread-in-fleet)\n"
+            "    target=print)\n"))
+        assert "unsupervised-thread-in-fleet" not in rules
+
+    def test_shipped_fleet_package_is_clean(self):
+        from bigdl_tpu.analysis.lint import lint_paths
+        findings = lint_paths([os.path.join(_REPO, "bigdl_tpu", "fleet")])
+        assert findings == [], "\n".join(str(f) for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# docs drift guard: bigdl.fleet.* keys
+# ---------------------------------------------------------------------------
+
+class TestFleetDocDrift:
+    """Every ``bigdl.fleet.*`` key the code registers must have a row in
+    docs/configuration.md — and vice versa (same guard as the chaos and
+    ingest key families)."""
+
+    _KEY = re.compile(r"bigdl\.fleet\.[A-Za-z0-9]+(?:\.[A-Za-z0-9]+)*")
+
+    def _keys_in(self, *parts):
+        with open(os.path.join(_REPO, *parts), encoding="utf-8") as f:
+            return set(self._KEY.findall(f.read()))
+
+    def test_config_defaults_match_docs_both_ways(self):
+        code = self._keys_in("bigdl_tpu", "utils", "config.py")
+        docs = self._keys_in("docs", "configuration.md")
+        assert code - docs == set(), \
+            f"fleet keys missing a docs row: {sorted(code - docs)}"
+        assert docs - code == set(), \
+            f"documented fleet keys unknown to config.py: " \
+            f"{sorted(docs - code)}"
+
+    def test_fleet_package_reads_registered_keys_only(self):
+        registered = self._keys_in("bigdl_tpu", "utils", "config.py")
+        pkg = os.path.join(_REPO, "bigdl_tpu", "fleet")
+        used = set()
+        for fn in os.listdir(pkg):
+            if fn.endswith(".py"):
+                used |= self._keys_in("bigdl_tpu", "fleet", fn)
+        assert used - registered == set(), \
+            f"fleet package reads unregistered keys: " \
+            f"{sorted(used - registered)}"

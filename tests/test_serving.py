@@ -529,3 +529,86 @@ def test_serving_bench_soak():
     rec = bench.bench_serving(soak=True, write=False)
     assert rec["calibrated"]["p99_ms"] <= rec["deadline_ms"]
     assert rec["overload"]["rejected"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Restart/reuse contract + supervisor abandon (ISSUE 17 satellites)
+# ---------------------------------------------------------------------------
+
+class TestStopContract:
+    def test_stop_is_idempotent_and_terminal(self):
+        eng = _engine()
+        h = eng.submit(_rows(1)[0])
+        h.result(timeout=5.0)
+        eng.stop()
+        assert eng.terminal and not eng.batcher_alive()
+        eng.stop()          # second stop: a quiet no-op, never a raise
+        eng.stop(grace=0.0)
+        assert eng.terminal
+        _assert_identity(eng.stats())
+
+    def test_post_stop_submit_is_structured_retriable(self):
+        eng = _engine()
+        eng.stop()
+        for _ in range(3):  # stable across repeats, not half-torn state
+            with pytest.raises(Overloaded) as ei:
+                eng.submit(_rows(1)[0])
+            assert ei.value.reason == "closed"
+            assert ei.value.retriable
+        _assert_identity(eng.stats())
+
+    def test_restart_after_stop_raises_structured(self):
+        eng = _engine()
+        eng.stop()
+        with pytest.raises(ServingInfraError, match="terminal"):
+            eng.start()
+        # the refusal did not corrupt the terminal state
+        assert eng.terminal
+        _assert_identity(eng.stats())
+
+    def test_lifecycle_introspection(self):
+        eng = _engine()
+        assert not eng.terminal and not eng.draining
+        assert eng.batcher_alive() and not eng.crashed()
+        assert isinstance(eng.batcher_ident(), int)
+        assert eng.queue_depth() == 0
+        eng.stop()
+        assert eng.terminal and not eng.batcher_alive()
+        assert not eng.crashed()    # orderly stop is not a crash
+
+    def test_abandon_sheds_once_and_releases_governor_bytes(self):
+        from bigdl_tpu.resources import GOVERNOR
+        eng = _engine(start=False)          # batcher never runs: the
+        h = eng.submit(_rows(1)[0])         # handle stays in flight
+        acct = GOVERNOR.account("serving_admission")
+        charged = h.payload_nbytes
+        assert charged > 0
+        before = acct.nbytes
+        assert h.abandon(reason="replica_crash") is True
+        assert h.outcome == "shed"
+        assert h.payload_nbytes == 0
+        assert acct.nbytes == before - charged
+        with pytest.raises(ServingInfraError, match="abandoned"):
+            h.result(timeout=0)
+        # terminal states are first-wins: a second abandon is a no-op
+        assert h.abandon() is False
+        assert acct.nbytes == before - charged
+        # abandon moves the outcome to the SUPERVISOR'S ledger (the
+        # fleet counts it as shed; tests/test_fleet.py asserts that
+        # identity) — the engine's own counts see the handle as
+        # stranded, and the later engine-side shed is a first-wins
+        # no-op, never a double count
+        assert eng.stats()["unaccounted"] == 1
+        eng.stop()
+        assert eng.stats()["unaccounted"] == 1
+        assert eng.stats()["shed"] == 0
+
+    def test_abandon_loses_to_completion(self):
+        eng = _engine()
+        h = eng.submit(_rows(1)[0])
+        out = h.result(timeout=5.0)
+        assert h.abandon() is False         # already completed: no-op
+        assert h.outcome == "completed"
+        np.testing.assert_array_equal(out, h.result(timeout=0))
+        eng.stop()
+        _assert_identity(eng.stats())
