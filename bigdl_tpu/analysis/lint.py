@@ -90,6 +90,18 @@ Rules
     that if every queue behind it is bounded.  (``SimpleQueue`` cannot
     be bounded at all and always flags.)
 
+``unbounded-decode-loop``
+    In the LM token-serving file (``bigdl_tpu/serving/lm.py``): any
+    ``while`` whose test is a bare constant (``while True``) or whose
+    test expression references no name/attribute matching
+    ``max|deadline|remaining|budget|bound|stop|drain|terminal``
+    (case-insensitive).  An autoregressive decode loop with no
+    max-steps/deadline bound is the serving equivalent of an unbounded
+    queue: one sequence that never emits EOS wedges its slot (and its
+    KV blocks) forever, and no drain can ever finish.  Every generation
+    loop must be a bounded ``for`` or test a budget/deadline/terminal
+    condition.  The allowlist stays empty.
+
 ``unguarded-io-in-stage-thread``
     In the ingest stage-thread file (``dataset/ingest.py``), raw file IO
     — builtin ``open(...)`` / ``os.open`` / ``io.open`` / an
@@ -255,7 +267,8 @@ KNOWN_RULES = frozenset({
     "host-sync-in-hot-path", "raw-clock-in-hot-path",
     "signal-handler-in-hot-path", "jnp-dtype-drop", "untracked-jit",
     "undeclared-collective", "unguarded-io-in-stage-thread",
-    "unbounded-queue-in-serving", "unaccounted-buffer-in-stage",
+    "unbounded-queue-in-serving", "unbounded-decode-loop",
+    "unaccounted-buffer-in-stage",
     "host-augment-in-hot-path", "unsupervised-thread-in-fleet",
     "bare-except", "swallowed-exception",
     "blocking-under-lock", "lock-order", "syntax",
@@ -626,6 +639,41 @@ def _rule_unbounded_queue(path: str, rel: str, tree: ast.AST) -> List[Finding]:
     return out
 
 
+#: loop-test identifiers that count as a bound on a decode-path while
+_DECODE_BOUND_RE = re.compile(
+    r"max|deadline|remaining|budget|bound|stop|drain|terminal", re.I)
+LM_SERVING_FILE = os.path.join("serving", "lm.py")
+
+
+def _rule_unbounded_decode(path: str, rel: str,
+                           tree: ast.AST) -> List[Finding]:
+    """``while`` loops in the LM serving file must be visibly bounded:
+    the test references a max/deadline/budget/terminal-style name, or
+    the loop is rewritten as a bounded ``for``.  One unbounded decode
+    loop wedges a slot (and its KV blocks) forever."""
+    if not rel.endswith(LM_SERVING_FILE):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.While):
+            continue
+        names = [n.id for n in ast.walk(node.test)
+                 if isinstance(n, ast.Name)]
+        names += [n.attr for n in ast.walk(node.test)
+                  if isinstance(n, ast.Attribute)]
+        bounded = (not isinstance(node.test, ast.Constant) and
+                   any(_DECODE_BOUND_RE.search(n) for n in names))
+        if not bounded:
+            out.append(Finding(
+                rel, node.lineno, "unbounded-decode-loop",
+                "while loop on the decode path with no visible "
+                "max-steps/deadline/terminal bound in its test — a "
+                "sequence that never finishes would wedge its slot and "
+                "KV blocks forever; use a bounded for, or test a "
+                "budget/deadline/terminal condition"))
+    return out
+
+
 def _rule_unaccounted_buffer(path: str, rel: str,
                              tree: ast.AST) -> List[Finding]:
     """Batch-scale host allocations in stage/serving files whose scope
@@ -963,6 +1011,7 @@ def lint_paths(targets: Sequence[str],
                          _rule_undeclared_collective(path, rel, tree) +
                          _rule_unguarded_io(path, rel, tree) +
                          _rule_unbounded_queue(path, rel, tree) +
+                         _rule_unbounded_decode(path, rel, tree) +
                          _rule_unaccounted_buffer(path, rel, tree) +
                          _rule_host_augment(path, rel, tree) +
                          _rule_fleet_thread(path, rel, tree) +

@@ -275,6 +275,30 @@ def eval_contract(sharded: bool = False) -> StepContract:
                         collectives=())
 
 
+def lm_prefill_contract() -> StepContract:
+    """The LM serving prefill step: a single-sequence causal forward
+    plus KV-pool scatter, all on one device — collective-free."""
+    return StepContract(label="lm_prefill", collectives=())
+
+
+def lm_decode_contract(label: str = "lm_decode") -> StepContract:
+    """The LM serving decode step (``lm_decode`` full-precision /
+    ``lm_decode_int8`` quantized-weight tier): one fixed-shape
+    batched token step over the paged KV cache, single-device —
+    collective-free.  The int8 tier computes its matmuls as
+    dequantized f32 contractions (convert + dot), so the precision
+    pass's f64 / f32-in-bf16 drift checks apply unchanged — this
+    contract is what the quantization gate audits against."""
+    return StepContract(label=label, collectives=())
+
+
+def lm_full_contract() -> StepContract:
+    """The LM serving full-forward step (sequential baseline + the
+    decode-parity reference): one causal forward, no cache writes,
+    collective-free."""
+    return StepContract(label="lm_full", collectives=())
+
+
 def default_contracts() -> Dict[str, StepContract]:
     """Canonical contracts for every known family — what the OFFLINE
     auditor (``python -m bigdl_tpu.analysis.hlo_audit <cacheDir>``)
@@ -297,4 +321,8 @@ def default_contracts() -> Dict[str, StepContract]:
         "pipeline": pipeline_contract(),
         "eval": eval_contract(False),
         "eval_sharded": eval_contract(True),
+        "lm_prefill": lm_prefill_contract(),
+        "lm_decode": lm_decode_contract("lm_decode"),
+        "lm_decode_int8": lm_decode_contract("lm_decode_int8"),
+        "lm_full": lm_full_contract(),
     }

@@ -20,13 +20,37 @@ import bigdl_tpu.nn as nn
 from bigdl_tpu.nn.module import Container, Module, _child_rng
 
 
+class PositionOutOfRange(ValueError):
+    """A position past the sinusoidal table's capacity.  Structured —
+    names the offending position and the limit — because the silent
+    alternatives are worse: a short slice broadcasts into a confusing
+    shape error and ``dynamic_slice`` silently CLAMPS, feeding wrong
+    position signal with no symptom at all."""
+
+    def __init__(self, position: int, max_len: int):
+        self.position = int(position)
+        self.max_len = int(max_len)
+        super().__init__(
+            f"position {self.position} is out of range for a "
+            f"PositionalEncoding table of max_len {self.max_len} — build "
+            f"the model with max_len > {self.position} or truncate the "
+            "sequence")
+
+
 class PositionalEncoding(Module):
     """Sinusoidal position signal added to (B, T, D) embeddings.
 
     Position-dependent, so under sequence parallelism each time shard must
     offset into the table by its chunk start: the trainer wires
     ``set_sequence_parallel`` (duck-typed, like MultiHeadAttention's ring
-    path) and the offset engages only while the seq axis is bound."""
+    path) and the offset engages only while the seq axis is bound.
+
+    ``apply(..., offset=k)`` reads table rows ``k .. k+T`` instead of
+    ``0 .. T`` — the decode path hands a sequence's resume position here.
+    Out-of-range static positions (``T > max_len``, or ``offset + T >
+    max_len``) raise :class:`PositionOutOfRange`; traced offsets (the
+    sequence-parallel shard index) stay the caller's contract, as
+    before."""
 
     def __init__(self, d_model: int, max_len: int = 4096, name=None):
         super().__init__(name)
@@ -49,14 +73,32 @@ class PositionalEncoding(Module):
         self._jit_apply = None
         return self
 
-    def apply(self, params, input, state, training=False, rng=None):
+    def rows(self, positions) -> jnp.ndarray:
+        """Table rows for explicit positions — the decode step's
+        per-sequence position lookup (each decode slot sits at its own
+        offset).  Concrete (host) positions are range-checked; traced
+        positions were validated against :attr:`max_seq_len` by the
+        caller's admission path (``jnp.take`` would silently clip)."""
+        if isinstance(positions, (int, np.integer, list, tuple,
+                                  np.ndarray)):
+            pos = np.asarray(positions)
+            if pos.size and int(pos.max()) >= self.max_seq_len:
+                raise PositionOutOfRange(int(pos.max()), self.max_seq_len)
+        return jnp.take(self.pe, jnp.asarray(positions), axis=0)
+
+    def apply(self, params, input, state, training=False, rng=None,
+              offset: int = 0):
         from bigdl_tpu.nn.attention import _axis_bound
         t = input.shape[1]
         if self.sequence_parallel and _axis_bound(self.sequence_parallel):
             start = jax.lax.axis_index(self.sequence_parallel) * t
+            if offset:
+                start = start + offset
             pe = jax.lax.dynamic_slice_in_dim(self.pe, start, t, 0)
         else:
-            pe = self.pe[:t]
+            if offset + t > self.max_seq_len:
+                raise PositionOutOfRange(offset + t - 1, self.max_seq_len)
+            pe = self.pe[offset:offset + t]
         return input + pe[None].astype(input.dtype), state
 
 

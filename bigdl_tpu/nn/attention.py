@@ -55,6 +55,25 @@ def scaled_dot_product_attention(q: jnp.ndarray, k: jnp.ndarray,
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
+def paged_attention(q: jnp.ndarray, k_ctx: jnp.ndarray, v_ctx: jnp.ndarray,
+                    valid: jnp.ndarray) -> jnp.ndarray:
+    """Decode-step attention over a gathered paged-cache context.
+
+    ``q`` is the current step's query, (B, T, H, Dh) with T=1 on the
+    decode path; ``k_ctx``/``v_ctx`` are the (B, S, H, Dh) context rows
+    gathered from the KV pool via each sequence's block table (S = the
+    table capacity in tokens, mostly padding for short sequences);
+    ``valid`` is the (B, S) mask of real context positions.  Numerics are
+    exactly :func:`scaled_dot_product_attention` with an explicit mask:
+    the finite mask value makes an invalid key's probability underflow to
+    0.0, so a padded context attends identically to the unpadded one —
+    the decode-vs-full-forward parity proof leans on this.  A fully
+    masked row (an inactive decode slot) softmaxes to uniform junk
+    rather than NaN; its output is discarded on the host."""
+    return scaled_dot_product_attention(q, k_ctx, v_ctx, causal=False,
+                                        mask=valid[:, None, None, :])
+
+
 def chunked_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                       causal: bool = False, chunk: int = 1024
                       ) -> jnp.ndarray:
@@ -270,6 +289,32 @@ class MultiHeadAttention(Module):
         # -1 heads: under the explicit Megatron split params hold only
         # the LOCAL heads' columns (head_dim never splits)
         return y.reshape(bsz, t, -1, self.head_dim)
+
+    # -- decode-cache apply path (serving/lm.py) --------------------------
+
+    def project_step(self, params, x):
+        """Project one decode/prefill span into per-head q/k/v, each
+        (B, T, H, Dh) — the serving path's entry into this module's
+        weights: the caller scatters k/v into the paged pool between
+        projection and attention (the current token must be IN the cache
+        before the gather so it attends itself)."""
+        q = self._project(params, x, "wq", "bq")
+        k = self._project(params, x, "wk", "bk")
+        v = self._project(params, x, "wv", "bv")
+        return q, k, v
+
+    def attend_cached(self, params, q, k_ctx, v_ctx, valid):
+        """Single-step attention over the gathered paged context plus
+        this module's output projection: (B, T, H, Dh) q against
+        (B, S, H, Dh) context under the (B, S) validity mask ->
+        (B, T, D).  Same numerics as :meth:`apply`'s standard path —
+        masked keys underflow to exact zero probability."""
+        out = paged_attention(q, k_ctx, v_ctx, valid)
+        bsz, t = out.shape[0], out.shape[1]
+        out = out.reshape(bsz, t, -1) @ params["wo"]
+        if self.with_bias:
+            out = out + params["bo"]
+        return out
 
     def apply(self, params, input, state, training=False, rng=None):
         if isinstance(input, (list, tuple)):

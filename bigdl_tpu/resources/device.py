@@ -58,6 +58,28 @@ def preflight(compiled, label: str) -> Optional[int]:
     return peak
 
 
+def preflight_pool(nbytes: int, label: str) -> int:
+    """Gate a fixed device-resident pool (the paged KV cache) against the
+    same budget the per-step preflight enforces.  Called BEFORE the pool
+    buffers are created, so an over-budget pool is a sizing error
+    answered while device state is still untouched — never a device OOM
+    halfway through serving.  Returns ``nbytes`` (the gate is a
+    pass-through when no budget is configured)."""
+    from bigdl_tpu import telemetry
+    telemetry.gauge("Resources/device_pool_bytes",
+                    labels={"pool": label},
+                    help="requested bytes per fixed device pool"
+                    ).set(nbytes)
+    budget = budget_bytes()
+    if budget > 0 and nbytes > budget:
+        telemetry.counter(
+            "Resources/device_oom",
+            help="device-memory faults (preflight breaches + dispatch "
+                 "RESOURCE_EXHAUSTED)").inc()
+        raise DeviceMemoryError(label, nbytes, budget, phase="preflight")
+    return int(nbytes)
+
+
 def classify_dispatch_error(e: BaseException,
                             label: str) -> Optional[DeviceMemoryError]:
     """Fold a dispatch-time allocation failure into the structured

@@ -21,26 +21,44 @@ instead of falling over:
   a hung-dispatch watchdog aborts a wedged dispatch and cools the
   engine down; SIGTERM drains in-flight work within
   ``bigdl.serving.gracePeriod`` and rejects late arrivals retriably.
+- :class:`~bigdl_tpu.serving.lm.LMServingEngine` — LM TOKEN serving:
+  continuous (iteration-level) batching over a paged block-table KV
+  cache (:class:`~bigdl_tpu.serving.kv_cache.PagedKVCache`, sized once
+  under the HBM preflight budget), one fixed ``(maxBatch, 1)`` decode
+  shape plus a bucketed prefill plan under the strict retrace-sentinel
+  contract, per-request streaming :class:`~bigdl_tpu.serving.lm.
+  TokenStream` output, and an optional int8 decode-weight tier gated by
+  the HLO auditor's precision pass + an fp-vs-int8 logits allclose
+  (``docs/optimization.md`` "LM serving").
 - :mod:`~bigdl_tpu.serving.loadgen` — the Poisson open-loop load
-  generator the bench leg (``bench.py --serving-only``) and the chaos
-  proofs drive the engine with, including the ``bigdl.chaos.
-  burstArrivals`` thundering-herd injector.
+  generator the bench legs (``bench.py --serving-only`` /
+  ``--lm-serving-only``) and the chaos proofs drive the engines with,
+  including the ``bigdl.chaos.burstArrivals`` thundering-herd injector;
+  :func:`~bigdl_tpu.serving.loadgen.run_lm_open_loop` adds client-side
+  TTFT / inter-token-latency percentiles over streamed tokens.
 
 Everything is instrumented through the PR 5 metrics registry
-(``Serving/*``: latency percentiles, queue depth, outcome counters,
-batch-occupancy histogram) with Prometheus export, and chaos-proven by
-the ``bigdl.chaos.slowRequestAt`` / ``poisonRequestAt`` /
-``hangDispatchAt`` / ``burstArrivals`` injectors.
+(``Serving/*`` and ``LM/*``: latency percentiles, queue depth, outcome
+counters, block/slot occupancy) with Prometheus export, and
+chaos-proven by the ``bigdl.chaos.slowRequestAt`` / ``poisonRequestAt``
+/ ``hangDispatchAt`` / ``burstArrivals`` injectors plus the LM trio
+``poisonPromptAt`` / ``hangDecodeAt`` / ``evictBlockAt``.
 """
 
 from bigdl_tpu.serving.engine import (HungDispatchError, Overloaded,
                                       RequestHandle, ServingDataError,
                                       ServingEngine, ServingError,
                                       ServingInfraError)
-from bigdl_tpu.serving.loadgen import run_open_loop
+from bigdl_tpu.serving.kv_cache import PagedKVCache
+from bigdl_tpu.serving.lm import (LMServingEngine, QuantizationGateError,
+                                  TokenStream, UnsupportedModelError)
+from bigdl_tpu.serving.loadgen import (run_lm_open_loop, run_open_loop,
+                                       sample_lm_workload)
 
 __all__ = [
     "ServingEngine", "RequestHandle", "ServingError", "Overloaded",
     "ServingDataError", "ServingInfraError", "HungDispatchError",
-    "run_open_loop",
+    "LMServingEngine", "TokenStream", "PagedKVCache",
+    "QuantizationGateError", "UnsupportedModelError",
+    "run_open_loop", "run_lm_open_loop", "sample_lm_workload",
 ]

@@ -1,0 +1,178 @@
+"""Paged block-table KV cache for autoregressive decode.
+
+vLLM-style paging brought to the tracked-jit world: one fixed
+device-resident pool of key/value blocks shaped ``(layer, block,
+block_size, head, head_dim)``, a HOST-side free-list, and a per-sequence
+block table mapping token positions to pool blocks.  Heterogeneous
+sequence lengths share the same device memory with fragmentation bounded
+by the block granularity — a sequence wastes at most ``block_size - 1``
+slots, never a max-context reservation.
+
+Three invariants the serving tests bit-assert:
+
+- **Block 0 is the dump block.**  It is never allocated: padded prefill
+  positions and inactive decode slots scatter their (junk) k/v there, so
+  the fused step keeps ONE fixed shape regardless of occupancy and a
+  stray write can never land in another sequence's block.
+- **Freed blocks are zero-scrubbed** before they re-enter the free-list:
+  a reused block carries no residue of the previous request's tokens
+  (no cross-request leakage, asserted bit-exactly by reading the pool).
+- **Exhaustion is structured.**  An allocation the free-list cannot
+  satisfy raises the serving taxonomy's retriable
+  :class:`~bigdl_tpu.serving.engine.Overloaded` — the pool is sized ONCE
+  at construction (gated by the HBM preflight budget,
+  :func:`bigdl_tpu.resources.device.preflight_pool`), so running out of
+  blocks is an admission-control answer, never a device OOM.
+
+The pool arrays are functional: the compiled prefill/decode steps take
+them as inputs and return the updated pools, which the engine writes
+back to :attr:`k` / :attr:`v`.  The free-list and tables are plain host
+state under a lock (allocation is scheduler-thread work, microseconds).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List
+
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import telemetry
+from bigdl_tpu.serving.engine import Overloaded
+
+#: block id every padded / inactive-slot scatter targets — reserved at
+#: construction, never handed out by the free-list
+DUMP_BLOCK = 0
+
+#: freed block ids are zero-scrubbed in fixed-size batches (padded with
+#: the dump block) so the eager scatter keeps ONE cached computation
+#: instead of one per distinct free-list length
+_SCRUB_CHUNK = 8
+
+
+class PagedKVCache:
+    """Fixed device pool of (layer, block, block_size, head, head_dim)
+    K/V blocks + host free-list + per-sequence block tables."""
+
+    def __init__(self, n_layers: int, n_head: int, head_dim: int,
+                 n_blocks: int, block_size: int, dtype=jnp.float32,
+                 label: str = "lm_kv_cache"):
+        if n_blocks < 2:
+            raise ValueError(
+                f"paged KV cache needs >= 2 blocks (block {DUMP_BLOCK} is "
+                f"the reserved dump block), got n_blocks={n_blocks}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.n_layers = int(n_layers)
+        self.n_head = int(n_head)
+        self.head_dim = int(head_dim)
+        self.n_blocks = int(n_blocks)
+        self.block_size = int(block_size)
+        self.dtype = jnp.dtype(dtype)
+        self.label = label
+        shape = (self.n_layers, self.n_blocks, self.block_size,
+                 self.n_head, self.head_dim)
+        self.pool_nbytes = 2 * int(np.prod(shape)) * self.dtype.itemsize
+        # gate BEFORE the buffers exist: an over-budget pool is a plan
+        # error answered while device state is still untouched
+        from bigdl_tpu.resources.device import preflight_pool
+        preflight_pool(self.pool_nbytes, label)
+        self.k = jnp.zeros(shape, self.dtype)
+        self.v = jnp.zeros(shape, self.dtype)
+        self._free: List[int] = list(range(self.n_blocks - 1, 0, -1))
+        self._tables: Dict[int, List[int]] = {}
+        self._lock = threading.Lock()
+        self._occupancy = telemetry.gauge(
+            "LM/block_occupancy",
+            help="allocated KV-cache blocks / allocatable blocks")
+        self._occupancy.set(0.0)
+
+    # -- capacity ---------------------------------------------------------
+
+    @property
+    def allocatable_blocks(self) -> int:
+        """Total blocks the free-list can ever hand out (pool minus the
+        dump block)."""
+        return self.n_blocks - 1
+
+    @property
+    def free_blocks(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.allocatable_blocks - self.free_blocks
+
+    def blocks_for(self, n_tokens: int) -> int:
+        """Blocks a sequence of ``n_tokens`` total positions occupies."""
+        return max(1, math.ceil(n_tokens / self.block_size))
+
+    def can_allocate(self, n_tokens: int) -> bool:
+        with self._lock:
+            return self.blocks_for(n_tokens) <= len(self._free)
+
+    # -- allocation -------------------------------------------------------
+
+    def allocate(self, seq_id: int, n_tokens: int) -> List[int]:
+        """Reserve the blocks for a sequence of up to ``n_tokens`` total
+        positions, or raise a structured retriable
+        :class:`Overloaded` — the free-list is the bound; exhaustion is
+        an admission answer, never an allocation attempt on device."""
+        need = self.blocks_for(n_tokens)
+        with self._lock:
+            if seq_id in self._tables:
+                raise ValueError(f"sequence {seq_id} already holds "
+                                 f"{len(self._tables[seq_id])} block(s)")
+            if need > len(self._free):
+                err = Overloaded(
+                    "kv blocks exhausted",
+                    queue_depth=self.allocatable_blocks - len(self._free),
+                    max_depth=self.allocatable_blocks)
+                err.blocks_needed = need
+                err.blocks_free = len(self._free)
+                raise err
+            blocks = [self._free.pop() for _ in range(need)]
+            self._tables[seq_id] = blocks
+        self._publish_occupancy()
+        return list(blocks)
+
+    def table(self, seq_id: int) -> List[int]:
+        with self._lock:
+            return list(self._tables[seq_id])
+
+    def free_seq(self, seq_id: int) -> int:
+        """Release a sequence's blocks back to the free-list, ZEROING
+        them on device first — a later allocation of the same block ids
+        starts bit-clean (the no-cross-request-leakage proof reads the
+        pool and asserts exactly this).  Returns the block count (0 when
+        the sequence holds nothing — idempotent)."""
+        with self._lock:
+            blocks = self._tables.pop(seq_id, None)
+            if not blocks:
+                return 0
+        self._scrub(blocks)
+        with self._lock:
+            self._free.extend(blocks)
+        self._publish_occupancy()
+        return len(blocks)
+
+    def _scrub(self, blocks: List[int]) -> None:
+        """Zero the named blocks across all layers.  Ids are padded to
+        ``_SCRUB_CHUNK`` with the dump block (re-zeroing junk is free),
+        so the eager scatter-set has a fixed shape and XLA caches one
+        computation for every free."""
+        zeros = jnp.zeros((self.n_layers, _SCRUB_CHUNK, self.block_size,
+                           self.n_head, self.head_dim), self.dtype)
+        for at in range(0, len(blocks), _SCRUB_CHUNK):
+            chunk = blocks[at:at + _SCRUB_CHUNK]
+            ids = np.full((_SCRUB_CHUNK,), DUMP_BLOCK, np.int32)
+            ids[:len(chunk)] = chunk
+            self.k = self.k.at[:, ids].set(zeros)
+            self.v = self.v.at[:, ids].set(zeros)
+
+    def _publish_occupancy(self) -> None:
+        denom = max(1, self.allocatable_blocks)
+        self._occupancy.set(self.used_blocks / denom)

@@ -1,0 +1,1514 @@
+"""LM token serving: continuous batching over a paged KV cache.
+
+The PR 9 serving engine batches INDEPENDENT one-shot forwards; an
+autoregressive LM breaks that shape — one request is a prompt prefill
+followed by a variable-length chain of single-token decode steps, and a
+naive server runs each request's chain to completion while everyone
+else queues.  This module serves tokens the Orca/vLLM way instead:
+
+- **Iteration-level (continuous) batching.**  The scheduler owns
+  ``maxBatch`` decode slots.  Every iteration dispatches ONE fused
+  decode step over all occupied slots; sequences that finish (EOS,
+  token budget, deadline) vacate their slot and free their KV blocks
+  *that same iteration*, and waiting prompts prefill into the vacancy —
+  no head-of-line blocking behind the longest generation.
+- **Paged KV cache** (:class:`~bigdl_tpu.serving.kv_cache.PagedKVCache`):
+  one fixed device pool of ``(layer, block, block_size, head,
+  head_dim)`` K/V blocks sized once at construction (gated by the HBM
+  preflight budget), a host free-list, and per-sequence block tables.
+  Exhaustion is a structured retriable ``Overloaded`` at admission —
+  never a device OOM mid-decode.
+- **One decode shape.**  The decode step always runs at ``(maxBatch,
+  1)`` with inactive slots masked (their scatters land in the reserved
+  dump block); prefill pads to a small bucket ladder.  Both compile
+  through ``compile_cache.tracked_jit`` under the strict retrace
+  sentinel — zero post-warmup retraces is test- and bench-asserted,
+  exactly the PR 7 contract extended to decode.
+- **Streaming output.**  ``submit()`` returns a :class:`TokenStream`
+  whose iterator yields tokens as the scheduler emits them; TTFT and
+  inter-token latency land in exact windowed percentile histograms
+  (``LM/ttft_ms``, ``LM/itl_ms``).
+- **int8 weight tier** (``bigdl.lm.quantize=int8``): decode matmuls run
+  against per-output-channel symmetric int8 weights dequantized in the
+  contraction.  The tier only serves after passing a two-part gate at
+  construction — the HLO auditor's precision-drift pass over the
+  quantized program AND an fp-vs-int8 logits ``allclose`` on identical
+  KV-pool inputs (:class:`QuantizationGateError` otherwise).
+
+Failure taxonomy, admission control (queue bound, cooldown, projected
+wait), deadline shedding, poison quarantine, the hung-dispatch
+watchdog, drain-on-preemption, and the accounting identity
+``completed + shed + rejected + quarantined == submitted`` are all the
+PR 9 machinery reused verbatim — a token stream that failed after
+emitting some tokens keeps them and terminates with the structured
+error saying why.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import queue
+import threading
+import time
+from collections import deque
+from contextlib import nullcontext
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import telemetry
+from bigdl_tpu.resources import GOVERNOR as _resource_governor
+from bigdl_tpu.resources import item_nbytes as _item_nbytes
+from bigdl_tpu.serving.engine import (OUTCOMES, DeadlineExceeded,
+                                      HungDispatchError,
+                                      HungDispatchWatchdog, Overloaded,
+                                      ServingDataError, ServingInfraError,
+                                      _service_ema)
+from bigdl_tpu.serving.kv_cache import DUMP_BLOCK, PagedKVCache
+from bigdl_tpu.utils import elastic
+
+logger = logging.getLogger("bigdl_tpu")
+
+
+class UnsupportedModelError(ValueError):
+    """The served model is not the decoder-only transformer shape this
+    engine knows how to dissect (``models.transformer.transformer_lm``).
+    Structured — names the exact structural mismatch — because the
+    silent alternative is a decode path that reads the wrong weights."""
+
+    def __init__(self, what: str):
+        super().__init__(
+            f"LMServingEngine serves transformer_lm-shaped models "
+            f"(LookupTable, PositionalEncoding, n x decoder block, "
+            f"LayerNorm, Linear, LogSoftMax); {what}")
+
+
+class QuantizationGateError(ValueError):
+    """The int8 decode tier failed its admission gate (auditor
+    precision-drift pass, or the fp-vs-int8 logits allclose check) —
+    the engine refuses to serve quantized rather than drift silently."""
+
+
+# ---------------------------------------------------------------------------
+# model dissection
+# ---------------------------------------------------------------------------
+
+
+class _LMGraph:
+    """Static description of a ``transformer_lm`` model: the per-layer
+    modules (weights are read through each module's adopted ``.params``
+    view, never positional index math) plus the dims the decode step
+    closes over."""
+
+    def __init__(self, model):
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.models.transformer import (LayerNorm,
+                                                  PositionalEncoding,
+                                                  _Residual)
+        if not isinstance(model, nn.Sequential):
+            raise UnsupportedModelError(
+                f"got a {type(model).__name__}, not a Sequential")
+        ch = list(model.children)
+        if len(ch) < 6:
+            raise UnsupportedModelError(
+                f"expected >= 6 children, got {len(ch)}")
+        embed, pos = ch[0], ch[1]
+        lnf, head, logsm = ch[-3], ch[-2], ch[-1]
+        if not isinstance(embed, nn.LookupTable):
+            raise UnsupportedModelError(
+                f"child 0 is {type(embed).__name__}, not LookupTable")
+        if embed.max_norm != float("inf"):
+            raise UnsupportedModelError(
+                "LookupTable max-norm renormalisation is not folded into "
+                "the decode path")
+        if not isinstance(pos, PositionalEncoding):
+            raise UnsupportedModelError(
+                f"child 1 is {type(pos).__name__}, not PositionalEncoding")
+        if not isinstance(lnf, LayerNorm):
+            raise UnsupportedModelError(
+                f"child -3 is {type(lnf).__name__}, not the final LayerNorm")
+        if not isinstance(head, nn.Linear):
+            raise UnsupportedModelError(
+                f"child -2 is {type(head).__name__}, not the Linear head")
+        if not isinstance(logsm, nn.LogSoftMax):
+            raise UnsupportedModelError(
+                f"child -1 is {type(logsm).__name__}, not LogSoftMax")
+        self.layers: List[Dict[str, Any]] = []
+        for bi, raw in enumerate(ch[2:-3]):
+            blk = raw.children[0] if isinstance(raw, nn.Remat) else raw
+            if not (isinstance(blk, nn.Sequential) and
+                    len(blk.children) == 2 and
+                    all(isinstance(r, _Residual) for r in blk.children)):
+                raise UnsupportedModelError(
+                    f"block {bi} is not a pair of pre-norm residuals")
+            attn_res, ffn_res = blk.children
+            ln1, attn = attn_res.children
+            ln2, ffn = ffn_res.children
+            if not isinstance(attn, nn.MultiHeadAttention):
+                raise UnsupportedModelError(
+                    f"block {bi} residual 0 wraps {type(attn).__name__}, "
+                    "not MultiHeadAttention")
+            if not attn.causal:
+                raise UnsupportedModelError(
+                    f"block {bi} attention is not causal — an acausal "
+                    "model has no autoregressive decode")
+            if not (isinstance(ffn, nn.Sequential) and
+                    len(ffn.children) == 3 and
+                    isinstance(ffn.children[0], nn.Linear) and
+                    isinstance(ffn.children[1], nn.ReLU) and
+                    isinstance(ffn.children[2], nn.Linear)):
+                raise UnsupportedModelError(
+                    f"block {bi} FFN is not Linear/ReLU/Linear (MoE blocks "
+                    "have no single-token decode path yet)")
+            self.layers.append({"ln1": ln1, "attn": attn, "ln2": ln2,
+                                "up": ffn.children[0],
+                                "down": ffn.children[2]})
+        if not self.layers:
+            raise UnsupportedModelError("model has no decoder blocks")
+        heads = {l["attn"].n_head for l in self.layers}
+        if len(heads) != 1:
+            raise UnsupportedModelError(
+                f"heterogeneous head counts across blocks: {sorted(heads)}")
+        self.model = model
+        self.embed = embed
+        self.pos = pos
+        self.lnf = lnf
+        self.head = head
+        self.vocab = int(head.output_size)
+        self.d_model = int(embed.n_output)
+        self.n_head = int(self.layers[0]["attn"].n_head)
+        self.head_dim = int(self.layers[0]["attn"].head_dim)
+        self.n_layers = len(self.layers)
+        self.max_seq_len = int(pos.max_seq_len)
+
+
+def _linear_entry(weight, bias) -> Dict[str, Any]:
+    return {"w": weight, "b": bias}
+
+
+def _extract_params(graph: _LMGraph) -> Dict[str, Any]:
+    """Snapshot the model's weights into the decode pytree.  Root
+    ``.params`` is touched first so lazy init + child adoption happen
+    once; every leaf is then the module's own adopted view."""
+    _ = graph.model.params
+    layers = []
+    for l in graph.layers:
+        ap, wb = l["attn"].params, l["attn"].with_bias
+        layers.append({
+            "ln1": {"w": l["ln1"].params["weight"],
+                    "b": l["ln1"].params["bias"]},
+            "attn": {k: _linear_entry(ap[f"w{k[-1]}"],
+                                      ap[f"b{k[-1]}"] if wb else None)
+                     for k in ("wq", "wk", "wv", "wo")},
+            "ln2": {"w": l["ln2"].params["weight"],
+                    "b": l["ln2"].params["bias"]},
+            "ffn": {"up": _linear_entry(
+                        l["up"].params["weight"],
+                        l["up"].params["bias"] if l["up"].with_bias
+                        else None),
+                    "down": _linear_entry(
+                        l["down"].params["weight"],
+                        l["down"].params["bias"] if l["down"].with_bias
+                        else None)},
+        })
+    return {"embed": graph.embed.params["weight"],
+            "layers": layers,
+            "lnf": {"w": graph.lnf.params["weight"],
+                    "b": graph.lnf.params["bias"]},
+            "head": _linear_entry(
+                graph.head.params["weight"],
+                graph.head.params["bias"] if graph.head.with_bias
+                else None)}
+
+
+def _quantize_entry(entry: Dict[str, Any]) -> Dict[str, Any]:
+    """Per-output-channel symmetric int8: ``s = max|w| / 127`` over the
+    input axis, ``q = round(w / s)``.  Dequantization happens in the
+    contraction (``(x @ q) * s``), so the auditor's precision pass sees
+    an f32 dot — the tier changes storage, not accumulation dtype."""
+    w = entry["w"]
+    s = jnp.max(jnp.abs(w), axis=0) / 127.0
+    s = jnp.where(s == 0, 1.0, s)
+    q = jnp.clip(jnp.round(w / s), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": s, "b": entry["b"]}
+
+
+def _quantize_params(dp: Dict[str, Any]) -> Dict[str, Any]:
+    """int8-quantize every decode matmul; embeddings (a gather) and the
+    layer norms stay fp."""
+    layers = []
+    for l in dp["layers"]:
+        layers.append({
+            "ln1": l["ln1"],
+            "attn": {k: _quantize_entry(e) for k, e in l["attn"].items()},
+            "ln2": l["ln2"],
+            "ffn": {k: _quantize_entry(e) for k, e in l["ffn"].items()},
+        })
+    return {"embed": dp["embed"], "layers": layers, "lnf": dp["lnf"],
+            "head": _quantize_entry(dp["head"])}
+
+
+def _apply_linear(x, e):
+    """One decode matmul against an fp (``w``) or int8 (``q``/``s``)
+    entry — the branch is on pytree STRUCTURE, resolved at trace time,
+    so fp and int8 programs compile under their own labels."""
+    if "q" in e:
+        y = (x @ e["q"].astype(x.dtype)) * e["s"]
+    else:
+        y = x @ e["w"]
+    if e.get("b") is not None:
+        y = y + e["b"]
+    return y
+
+
+def _layer_norm(x, p, eps):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    return out * p["w"] + p["b"]
+
+
+# ---------------------------------------------------------------------------
+# step builders (pure functions over the decode pytree + KV pools)
+# ---------------------------------------------------------------------------
+
+
+def _build_decode_fn(graph: _LMGraph, block_size: int, max_blocks: int):
+    """One fused decode iteration at the FIXED ``(maxBatch, 1)`` shape:
+    embed + positional row, per layer scatter this step's k/v into the
+    paged pool (BEFORE the gather, so the current token attends itself),
+    gather each sequence's table context, masked paged attention, FFN;
+    returns next-token log-probs and the updated pools.  Inactive slots
+    compute junk that scatters into the dump block and is discarded on
+    the host — occupancy can never mint a new signature."""
+    from bigdl_tpu.nn.attention import paged_attention
+    pe = graph.pos.pe
+    vocab_in = int(graph.embed.n_index)
+    H, Dh = graph.n_head, graph.head_dim
+    eps1 = [l["ln1"].eps for l in graph.layers]
+    eps2 = [l["ln2"].eps for l in graph.layers]
+    eps_f = graph.lnf.eps
+    S = max_blocks * block_size
+
+    def decode(dp, pool_k, pool_v, tokens, positions, tables, active):
+        B = tokens.shape[0]
+        idx = jnp.clip(tokens.astype(jnp.int32) - 1, 0, vocab_in - 1)
+        x = jnp.take(dp["embed"], idx, axis=0)
+        x = x + jnp.take(pe, positions, axis=0)[:, None, :].astype(x.dtype)
+        blk = jnp.where(active, tables[jnp.arange(B),
+                                       positions // block_size],
+                        DUMP_BLOCK)
+        slot = positions % block_size
+        valid = ((jnp.arange(S)[None, :] <= positions[:, None]) &
+                 active[:, None])
+        for li, lyr in enumerate(dp["layers"]):
+            h = _layer_norm(x, lyr["ln1"], eps1[li])
+            q = _apply_linear(h, lyr["attn"]["wq"]).reshape(B, 1, H, Dh)
+            k = _apply_linear(h, lyr["attn"]["wk"]).reshape(B, 1, H, Dh)
+            v = _apply_linear(h, lyr["attn"]["wv"]).reshape(B, 1, H, Dh)
+            pool_k = pool_k.at[li, blk, slot].set(k[:, 0])
+            pool_v = pool_v.at[li, blk, slot].set(v[:, 0])
+            k_ctx = pool_k[li][tables].reshape(B, S, H, Dh)
+            v_ctx = pool_v[li][tables].reshape(B, S, H, Dh)
+            att = paged_attention(q, k_ctx, v_ctx, valid)
+            x = x + _apply_linear(att.reshape(B, 1, H * Dh),
+                                  lyr["attn"]["wo"])
+            h = _layer_norm(x, lyr["ln2"], eps2[li])
+            h = jax.nn.relu(_apply_linear(h, lyr["ffn"]["up"]))
+            x = x + _apply_linear(h, lyr["ffn"]["down"])
+        x = _layer_norm(x, dp["lnf"], eps_f)
+        logits = _apply_linear(x[:, 0], dp["head"])
+        return jax.nn.log_softmax(logits, axis=-1), pool_k, pool_v
+
+    return decode
+
+
+def _build_prefill_fn(graph: _LMGraph, block_size: int):
+    """Bucketed prompt prefill: dense causal attention over the padded
+    span (padding sits AFTER every real query, so the causal mask alone
+    keeps it out of every real row), scattering each real position's
+    k/v into the sequence's blocks (padded rows hit the dump block).
+    Returns the last REAL position's log-probs + the updated pools."""
+    pe = graph.pos.pe
+    vocab_in = int(graph.embed.n_index)
+    H, Dh = graph.n_head, graph.head_dim
+    eps1 = [l["ln1"].eps for l in graph.layers]
+    eps2 = [l["ln2"].eps for l in graph.layers]
+    eps_f = graph.lnf.eps
+    from bigdl_tpu.nn.attention import scaled_dot_product_attention
+
+    def prefill(dp, pool_k, pool_v, tokens, length, table):
+        T = tokens.shape[1]
+        idx = jnp.clip(tokens.astype(jnp.int32) - 1, 0, vocab_in - 1)
+        x = jnp.take(dp["embed"], idx, axis=0)
+        x = x + pe[:T][None].astype(x.dtype)
+        pos = jnp.arange(T)
+        blkrow = jnp.where(pos < length, table[pos // block_size],
+                           DUMP_BLOCK)
+        slotrow = pos % block_size
+        for li, lyr in enumerate(dp["layers"]):
+            h = _layer_norm(x, lyr["ln1"], eps1[li])
+            q = _apply_linear(h, lyr["attn"]["wq"]).reshape(1, T, H, Dh)
+            k = _apply_linear(h, lyr["attn"]["wk"]).reshape(1, T, H, Dh)
+            v = _apply_linear(h, lyr["attn"]["wv"]).reshape(1, T, H, Dh)
+            pool_k = pool_k.at[li, blkrow, slotrow].set(k[0])
+            pool_v = pool_v.at[li, blkrow, slotrow].set(v[0])
+            att = scaled_dot_product_attention(q, k, v, causal=True)
+            x = x + _apply_linear(att.reshape(1, T, H * Dh),
+                                  lyr["attn"]["wo"])
+            h = _layer_norm(x, lyr["ln2"], eps2[li])
+            h = jax.nn.relu(_apply_linear(h, lyr["ffn"]["up"]))
+            x = x + _apply_linear(h, lyr["ffn"]["down"])
+        x = _layer_norm(x, dp["lnf"], eps_f)
+        logits = _apply_linear(x[0], dp["head"])
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return jnp.take(logp, length - 1, axis=0), pool_k, pool_v
+
+    return prefill
+
+
+def _build_full_fn(graph: _LMGraph):
+    """Teacher-forced full forward over a (1, T) span -> (T, vocab)
+    log-probs: the sequential-generation baseline AND the
+    decode-parity reference (same closure math as prefill, no pool)."""
+    pe = graph.pos.pe
+    vocab_in = int(graph.embed.n_index)
+    H, Dh = graph.n_head, graph.head_dim
+    eps1 = [l["ln1"].eps for l in graph.layers]
+    eps2 = [l["ln2"].eps for l in graph.layers]
+    eps_f = graph.lnf.eps
+    from bigdl_tpu.nn.attention import scaled_dot_product_attention
+
+    def full(dp, tokens):
+        T = tokens.shape[1]
+        idx = jnp.clip(tokens.astype(jnp.int32) - 1, 0, vocab_in - 1)
+        x = jnp.take(dp["embed"], idx, axis=0)
+        x = x + pe[:T][None].astype(x.dtype)
+        for li, lyr in enumerate(dp["layers"]):
+            h = _layer_norm(x, lyr["ln1"], eps1[li])
+            q = _apply_linear(h, lyr["attn"]["wq"]).reshape(1, T, H, Dh)
+            k = _apply_linear(h, lyr["attn"]["wk"]).reshape(1, T, H, Dh)
+            v = _apply_linear(h, lyr["attn"]["wv"]).reshape(1, T, H, Dh)
+            att = scaled_dot_product_attention(q, k, v, causal=True)
+            x = x + _apply_linear(att.reshape(1, T, H * Dh),
+                                  lyr["attn"]["wo"])
+            h = _layer_norm(x, lyr["ln2"], eps2[li])
+            h = jax.nn.relu(_apply_linear(h, lyr["ffn"]["up"]))
+            x = x + _apply_linear(h, lyr["ffn"]["down"])
+        x = _layer_norm(x, dp["lnf"], eps_f)
+        logits = _apply_linear(x[0], dp["head"])
+        return jax.nn.log_softmax(logits, axis=-1)
+
+    return full
+
+
+# ---------------------------------------------------------------------------
+# streaming handle
+# ---------------------------------------------------------------------------
+
+
+class TokenStream:
+    """One admitted generation request: a streaming token iterator plus
+    a one-shot terminal state that is exactly one of :data:`OUTCOMES`
+    (first-wins, like the PR 9 ``RequestHandle`` — a stream can never
+    be both shed by the drain and completed by a racing decode).
+
+    Iterating yields tokens AS THE SCHEDULER EMITS THEM; when the
+    stream terminates with an error (deadline, hang, drain), iteration
+    raises it after the already-streamed tokens — a partially-streamed-
+    then-failed request keeps its prefix and learns why it stopped."""
+
+    __slots__ = ("prompt", "index", "seq_id", "max_new_tokens", "eos_id",
+                 "submit_ns", "deadline_ns", "first_token_ns", "finish_ns",
+                 "outcome", "payload_nbytes", "_tokens", "_error",
+                 "_terminal", "_cv")
+
+    def __init__(self, prompt, index: int, submit_ns: int, deadline_ns: int,
+                 max_new_tokens: int, eos_id: Optional[int]):
+        self.prompt = prompt
+        self.index = index          # admission position (chaos plans key on it)
+        self.seq_id = index         # KV-cache sequence id
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.submit_ns = submit_ns
+        self.deadline_ns = deadline_ns
+        self.first_token_ns: Optional[int] = None
+        self.finish_ns: Optional[int] = None
+        self.outcome: Optional[str] = None
+        self.payload_nbytes = 0     # host bytes charged to the governor
+        self._tokens: List[int] = []
+        self._error: Optional[BaseException] = None
+        self._terminal = False
+        self._cv = threading.Condition()
+
+    # -- scheduler side ---------------------------------------------------
+
+    def _emit(self, tok: int) -> None:
+        with self._cv:
+            if self._terminal:
+                return
+            self._tokens.append(int(tok))
+            if self.first_token_ns is None:
+                self.first_token_ns = telemetry.clock_ns()
+            self._cv.notify_all()
+
+    def _finish(self, outcome: str,
+                error: Optional[BaseException] = None) -> bool:
+        with self._cv:
+            if self._terminal:
+                return False
+            self.outcome = outcome
+            self._error = error
+            self.finish_ns = telemetry.clock_ns()
+            self._terminal = True
+            self._cv.notify_all()
+        return True
+
+    # -- client side ------------------------------------------------------
+
+    def __iter__(self):
+        # bounded: at most max_new_tokens yields, then the terminal check
+        for i in range(self.max_new_tokens + 1):
+            with self._cv:
+                while len(self._tokens) <= i and not self._terminal:
+                    self._cv.wait(0.05)
+                if i >= len(self._tokens):
+                    break
+                tok = self._tokens[i]
+            yield tok
+        if self._error is not None:
+            raise self._error
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until terminal; the full token list, or raises the
+        terminal error (tokens streamed before the failure stay
+        readable via :meth:`tokens`)."""
+        deadline = (time.monotonic() + timeout
+                    if timeout is not None else None)
+        with self._cv:
+            while not self._terminal:
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"stream {self.index} still in flight after "
+                        f"{timeout} s")
+                self._cv.wait(0.05)
+        if self._error is not None:
+            raise self._error
+        return list(self._tokens)
+
+    def tokens(self) -> List[int]:
+        """Tokens streamed so far (snapshot; no blocking)."""
+        with self._cv:
+            return list(self._tokens)
+
+    def done(self) -> bool:
+        return self._terminal
+
+    def error(self) -> Optional[BaseException]:
+        return self._error if self._terminal else None
+
+    def ttft_ms(self) -> Optional[float]:
+        if self.first_token_ns is None:
+            return None
+        return (self.first_token_ns - self.submit_ns) / 1e6
+
+    def latency_ms(self) -> Optional[float]:
+        if self.finish_ns is None:
+            return None
+        return (self.finish_ns - self.submit_ns) / 1e6
+
+
+class _Slot:
+    """One occupied decode slot: the stream plus its device-side cursor
+    (``position`` = the pool position the NEXT fed token writes)."""
+
+    __slots__ = ("stream", "position", "generated", "last_token",
+                 "table_row", "last_emit_ns")
+
+    def __init__(self, stream: TokenStream, position: int, last_token: int,
+                 table_row: np.ndarray):
+        self.stream = stream
+        self.position = position
+        self.generated = 1          # prefill emitted the first token
+        self.last_token = last_token
+        self.table_row = table_row
+        self.last_emit_ns = telemetry.clock_ns()
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+
+def _tree_spec(tree):
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree)
+
+
+class LMServingEngine:
+    """Continuous-batching token server over ONE decoder-only LM.
+
+    All knobs default from ``bigdl.lm.*`` (see ``docs/configuration.md``);
+    constructor arguments override per-engine.  ``submit()`` streams;
+    ``generate()`` / ``generate_sequential()`` are the offline
+    paged-vs-teacher-forced pair the parity proof and the bench's
+    baseline lean on."""
+
+    def __init__(self, model, max_batch: Optional[int] = None,
+                 max_context: Optional[int] = None,
+                 block_size: Optional[int] = None,
+                 cache_blocks: Optional[int] = None,
+                 max_new_tokens: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 max_queue_depth: Optional[int] = None,
+                 quantize: Optional[str] = None,
+                 start: bool = False):
+        from bigdl_tpu.utils import config
+        self.graph = _LMGraph(model)
+        self.max_batch = int(max_batch if max_batch is not None else
+                             config.get_int("bigdl.lm.maxBatch", 8))
+        self.max_context = int(
+            max_context if max_context is not None else
+            config.get_int("bigdl.lm.maxContext", 256))
+        self.block_size = int(
+            block_size if block_size is not None else
+            config.get_int("bigdl.lm.blockSize", 16))
+        self.max_new_tokens = int(
+            max_new_tokens if max_new_tokens is not None else
+            config.get_int("bigdl.lm.maxNewTokens", 64))
+        self.deadline_ms = float(
+            deadline_ms if deadline_ms is not None else
+            config.get_float("bigdl.lm.deadlineMs", 5000.0))
+        self.max_queue_depth = int(
+            max_queue_depth if max_queue_depth is not None else
+            config.get_int("bigdl.lm.maxQueueDepth", 128))
+        self.admission_factor = config.get_float(
+            "bigdl.lm.admissionDeadlineFactor", 0.0)
+        self.stall_factor = config.get_float("bigdl.lm.stallFactor", 0.0)
+        self.warmup_steps = config.get_int("bigdl.lm.warmupSteps", 3)
+        self.cooldown_steps = config.get_int("bigdl.lm.cooldownSteps", 8)
+        self.grace_period = config.get_float("bigdl.lm.gracePeriod", 5.0)
+        self.poll_interval = config.get_float("bigdl.lm.pollInterval", 0.01)
+        self.quantize = str(
+            quantize if quantize is not None else
+            config.get_property("bigdl.lm.quantize", "off") or "off").lower()
+        if self.quantize not in ("off", "int8"):
+            raise ValueError(
+                f"bigdl.lm.quantize must be 'off' or 'int8', got "
+                f"{self.quantize!r}")
+        self.quantize_rtol = config.get_float("bigdl.lm.quantizeRtol", 0.05)
+        self.quantize_atol = config.get_float("bigdl.lm.quantizeAtol", 0.05)
+        if self.max_context > self.graph.max_seq_len:
+            raise ValueError(
+                f"bigdl.lm.maxContext {self.max_context} exceeds the "
+                f"model's PositionalEncoding max_len "
+                f"{self.graph.max_seq_len} — build the model with a "
+                "larger max_len or lower maxContext")
+        if self.max_batch < 1 or self.max_new_tokens < 1:
+            raise ValueError("maxBatch and maxNewTokens must be >= 1")
+
+        # -- KV pool: sized ONCE, preflighted against the HBM budget ------
+        self._max_blocks = max(1, math.ceil(self.max_context /
+                                            self.block_size))
+        n_blocks = int(cache_blocks if cache_blocks is not None else
+                       config.get_int("bigdl.lm.cacheBlocks", 0))
+        if n_blocks <= 0:
+            n_blocks = self.max_batch * self._max_blocks + 1
+        self.cache = PagedKVCache(self.graph.n_layers, self.graph.n_head,
+                                  self.graph.head_dim, n_blocks,
+                                  self.block_size)
+        self._buckets = self._bucket_plan(
+            config.get_property("bigdl.lm.prefillBuckets", None))
+
+        # -- compiled steps + retrace sentinels ---------------------------
+        self._dp = _extract_params(self.graph)
+        self._dp_q = (_quantize_params(self._dp)
+                      if self.quantize == "int8" else None)
+        self._build_steps()
+
+        # -- scheduler state (PR 9 idioms) --------------------------------
+        self._q: "queue.Queue[TokenStream]" = queue.Queue(
+            maxsize=self.max_queue_depth)
+        self._pending: "deque[TokenStream]" = deque(
+            maxlen=self.max_queue_depth)
+        self._slots: List[Optional[_Slot]] = [None] * self.max_batch
+        # the stream currently mid-admission: the watchdog's async abort
+        # (PyThreadState_SetAsyncExc) can surface anywhere in the
+        # scheduler thread, so a stream popped from the queue must never
+        # live only in a local — _shed_active covers this field
+        self._admitting: Optional[TokenStream] = None
+        self._lock = threading.Lock()
+        self._payload_acct = _resource_governor.account("lm_admission")
+        self._counts: Dict[str, int] = dict.fromkeys(OUTCOMES, 0)
+        self._counts["submitted"] = 0
+        self._next_index = 0
+        self._offline_id = 0
+        self._cooldown = 0
+        self._draining = False
+        self._drain_deadline: Optional[float] = None
+        self._drain_reason = ""
+        self._closed = False
+        self._started = False
+        self._stop_event = threading.Event()
+        self._ema = _service_ema(self.warmup_steps)
+        self.decode_steps = 0
+        self.prefills = 0
+        self.tokens_out = 0
+        self.watchdog: Optional[HungDispatchWatchdog] = None
+        self._thread: Optional[threading.Thread] = None
+        window = config.get_int("bigdl.telemetry.percentileWindow", 512)
+        self._ttft = telemetry.histogram(
+            "LM/ttft_ms", window=window,
+            help="submit-to-first-token latency")
+        self._itl = telemetry.histogram(
+            "LM/itl_ms", window=window,
+            help="inter-token gap during streaming decode")
+        self._latency = telemetry.histogram(
+            "LM/latency_ms", window=window,
+            help="per-request submit-to-terminal latency")
+
+        self.quantization_report: Optional[Dict[str, Any]] = None
+        if self._dp_q is not None:
+            self._quantization_gate()
+        if start:
+            self.start()
+
+    # -- compile plan -----------------------------------------------------
+
+    def _bucket_plan(self, spec) -> List[int]:
+        """Prefill shape ladder: configured ``bigdl.lm.prefillBuckets``
+        or a power-of-two ladder from blockSize up; maxContext is
+        always IN the plan so the longest admissible prompt has a
+        warmed signature."""
+        if spec:
+            buckets = sorted({int(b) for b in str(spec).split(",") if
+                              str(b).strip()})
+            if not buckets or buckets[0] < 1:
+                raise ValueError(
+                    f"bigdl.lm.prefillBuckets must be positive ints, got "
+                    f"{spec!r}")
+            if buckets[-1] > self.max_context:
+                raise ValueError(
+                    f"bigdl.lm.prefillBuckets {buckets[-1]} exceeds "
+                    f"bigdl.lm.maxContext {self.max_context}")
+        else:
+            buckets, b = [], max(1, self.block_size)
+            for _ in range(64):
+                if b >= self.max_context:
+                    break
+                buckets.append(b)
+                b *= 2
+        return sorted(set(buckets + [self.max_context]))
+
+    def _prefill_bucket(self, n: int) -> int:
+        for b in self._buckets:
+            if n <= b:
+                return b
+        return self._buckets[-1]
+
+    def _build_steps(self) -> None:
+        from bigdl_tpu.analysis.program_contracts import (
+            lm_decode_contract, lm_full_contract, lm_prefill_contract)
+        from bigdl_tpu.analysis.retrace import RetraceSentinel
+        from bigdl_tpu.utils.compile_cache import tracked_jit
+        B, MB = self.max_batch, self._max_blocks
+        pool = jax.ShapeDtypeStruct(self.cache.k.shape, self.cache.k.dtype)
+        dec_tail = (pool, pool,
+                    jax.ShapeDtypeStruct((B, 1), jnp.int32),
+                    jax.ShapeDtypeStruct((B,), jnp.int32),
+                    jax.ShapeDtypeStruct((B, MB), jnp.int32),
+                    jax.ShapeDtypeStruct((B,), jnp.bool_))
+        decode = _build_decode_fn(self.graph, self.block_size, MB)
+        prefill = _build_prefill_fn(self.graph, self.block_size)
+        full = _build_full_fn(self.graph)
+
+        def wire(fn, label, contract, specs_list):
+            cached = tracked_jit(fn, label, contract=contract)
+            sentinel = RetraceSentinel.from_config(label)
+            if sentinel is not None:
+                cached.register_sentinel(sentinel)
+                for specs in specs_list:
+                    sentinel.register_warmup(specs)
+                return sentinel.wrap(cached), cached, sentinel
+            return cached, cached, None
+
+        self._decode_specs = (_tree_spec(self._dp),) + dec_tail
+        self._decode, self._decode_cached, self._decode_sentinel = wire(
+            decode, "lm_decode", lm_decode_contract(),
+            [self._decode_specs])
+        self._prefill_specs = {
+            b: (_tree_spec(self._dp), pool, pool,
+                jax.ShapeDtypeStruct((1, b), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.int32),
+                jax.ShapeDtypeStruct((MB,), jnp.int32))
+            for b in self._buckets}
+        self._prefill, self._prefill_cached, self._prefill_sentinel = wire(
+            prefill, "lm_prefill", lm_prefill_contract(),
+            list(self._prefill_specs.values()))
+        self._full_specs = {
+            b: (_tree_spec(self._dp),
+                jax.ShapeDtypeStruct((1, b), jnp.int32))
+            for b in self._buckets}
+        self._full, self._full_cached, self._full_sentinel = wire(
+            full, "lm_full", lm_full_contract(),
+            list(self._full_specs.values()))
+        if self._dp_q is not None:
+            self._decode_q_specs = (_tree_spec(self._dp_q),) + dec_tail
+            (self._decode_q, self._decode_q_cached,
+             self._decode_q_sentinel) = wire(
+                decode, "lm_decode_int8",
+                lm_decode_contract("lm_decode_int8"),
+                [self._decode_q_specs])
+        else:
+            self._decode_q = self._decode_q_cached = None
+            self._decode_q_sentinel = None
+
+    @property
+    def sentinels(self) -> Dict[str, Any]:
+        """Label -> retrace sentinel for every compiled LM step (absent
+        labels ran without a sentinel) — the zero-post-warmup-retrace
+        proof reads ``.retraces`` off each."""
+        out = {}
+        for label, s in (("lm_decode", self._decode_sentinel),
+                         ("lm_prefill", self._prefill_sentinel),
+                         ("lm_full", self._full_sentinel),
+                         ("lm_decode_int8", self._decode_q_sentinel)):
+            if s is not None:
+                out[label] = s
+        return out
+
+    def warmup(self) -> None:
+        """AOT-compile every planned signature (decode at its one fixed
+        shape, each prefill/full bucket, the int8 tier when enabled) so
+        no request ever pays a compile against its deadline."""
+        self._decode_cached.warmup(*self._decode_specs)
+        for specs in self._prefill_specs.values():
+            self._prefill_cached.warmup(*specs)
+        for specs in self._full_specs.values():
+            self._full_cached.warmup(*specs)
+        if self._decode_q_cached is not None:
+            self._decode_q_cached.warmup(*self._decode_q_specs)
+
+    # -- int8 gate --------------------------------------------------------
+
+    def _quantization_gate(self) -> None:
+        """Admission gate for the int8 decode tier: (1) the HLO
+        auditor's precision-drift pass over the quantized program, (2)
+        fp-vs-int8 next-token log-probs allclose on IDENTICAL KV-pool
+        inputs.  Either failing raises :class:`QuantizationGateError` —
+        the engine never silently serves drifted logits."""
+        from bigdl_tpu.analysis import hlo_audit
+        from bigdl_tpu.analysis.hostsync import host_pull
+        from bigdl_tpu.analysis.program_contracts import lm_decode_contract
+        # audit-only lowering — the gate inspects HLO text; serving
+        # dispatch still goes through the tracked CachedStep
+        lowered = self._decode_q_cached.lower(  # lint: allow(untracked-jit)
+            *self._decode_q_specs)
+        report = hlo_audit.audit_step(
+            "lm_decode_int8", lowered.as_text(),
+            contract=lm_decode_contract("lm_decode_int8"))
+        B, MB = self.max_batch, self._max_blocks
+        P = max(1, min(8, self.max_context - 1))
+        prompt = (np.arange(P, dtype=np.int32) % self.graph.vocab) + 1
+        seq_id = -1
+        self.cache.allocate(seq_id, P + 1)
+        try:
+            tok, table_row = self._prefill_step_raw(seq_id, prompt)
+            tokens = np.full((B, 1), 1, np.int32)
+            positions = np.zeros((B,), np.int32)
+            tables = np.full((B, MB), DUMP_BLOCK, np.int32)
+            active = np.zeros((B,), bool)
+            tokens[0, 0], positions[0] = tok, P
+            tables[0], active[0] = table_row, True
+            args = (self.cache.k, self.cache.v, tokens, positions, tables,
+                    active)
+            lp_fp = self._decode(self._dp, *args)[0]
+            lp_q = self._decode_q(self._dp_q, *args)[0]
+            a = np.asarray(host_pull(lp_fp, what="lm gate fp logits"))[0]
+            b = np.asarray(host_pull(lp_q, what="lm gate int8 logits"))[0]
+        finally:
+            self.cache.free_seq(seq_id)
+        close = bool(np.allclose(b, a, rtol=self.quantize_rtol,
+                                 atol=self.quantize_atol))
+        diff = float(np.max(np.abs(b - a)))
+        self.quantization_report = {
+            "audit_ok": bool(report.ok),
+            "violations": [str(v) for v in report.violations],
+            "allclose": close, "max_abs_diff": diff,
+            "rtol": self.quantize_rtol, "atol": self.quantize_atol}
+        if not report.ok:
+            raise QuantizationGateError(
+                "int8 decode tier failed the auditor precision gate: "
+                + "; ".join(str(v) for v in report.violations))
+        if not close:
+            raise QuantizationGateError(
+                f"int8 decode logits drifted past the gate: max |diff| "
+                f"{diff:.4g} vs rtol={self.quantize_rtol} "
+                f"atol={self.quantize_atol} — raise the thresholds "
+                "explicitly or serve fp")
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "LMServingEngine":
+        if self._closed:
+            raise ServingInfraError(
+                "engine is terminal: stop() is one-way — build a new "
+                "engine instead of restarting this one")
+        if self._started:
+            return self
+        self._started = True
+        self._thread = threading.Thread(target=self._scheduler_loop,
+                                        daemon=True, name="lm-scheduler")
+        self._thread.start()
+        return self
+
+    def stop(self, grace: Optional[float] = None) -> None:
+        """Graceful shutdown (idempotent + terminal, the PR 9
+        contract): admission closes, queued prompts and in-flight
+        sequences drain within ``grace``, leftovers are shed
+        retriably."""
+        if not self._started or self._closed:
+            self._closed = True
+            self._drain_leftovers()
+            return
+        with self._lock:
+            if not self._draining:
+                self._begin_drain_locked("stop", time.monotonic(), grace)
+            elif grace is not None:
+                self._drain_deadline = time.monotonic() + grace
+        self._stop_event.set()
+        t = self._thread
+        if t is not None:
+            budget = grace if grace is not None else self.grace_period
+            t.join(timeout=budget + 10.0)
+        self._drain_leftovers()
+        self._closed = True
+
+    def close(self) -> None:
+        self.stop()
+
+    def __enter__(self) -> "LMServingEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    @property
+    def terminal(self) -> bool:
+        return self._closed
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def queue_depth(self) -> int:
+        return self._q.qsize() + len(self._pending)
+
+    def scheduler_alive(self) -> bool:
+        t = self._thread
+        return bool(t is not None and t.is_alive())
+
+    # -- admission --------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               deadline_ms: Optional[float] = None,
+               eos_id: Optional[int] = None) -> TokenStream:
+        """Admit one prompt or raise :class:`Overloaded` — fast, at the
+        door.  Returns the streaming :class:`TokenStream` handle."""
+        now = telemetry.clock_ns()
+        deadline = float(deadline_ms if deadline_ms is not None
+                         else self.deadline_ms)
+        max_new = int(max_new_tokens if max_new_tokens is not None
+                      else self.max_new_tokens)
+        payload_nbytes = _item_nbytes(prompt)
+        _resource_governor.check_item("lm_admission", payload_nbytes)
+        telemetry.counter("LM/submitted").inc()
+        with self._lock:
+            self._counts["submitted"] += 1
+            if self._closed or (self._stop_event.is_set() and
+                                not self._draining):
+                raise self._reject_locked("closed")
+            if self._draining:
+                raise self._reject_locked("draining")
+            if self._cooldown > 0:
+                raise self._reject_locked("cooldown")
+            depth = self._q.qsize() + len(self._pending)
+            if depth >= self.max_queue_depth:
+                raise self._reject_locked("queue full", depth)
+            n = getattr(prompt, "shape", None)
+            n = (int(np.prod(n)) if n is not None
+                 else len(prompt) if hasattr(prompt, "__len__") else None)
+            if (n is not None and self.cache.blocks_for(n + max_new) >
+                    self.cache.allocatable_blocks):
+                # can NEVER be scheduled: larger than the entire pool
+                raise self._reject_locked("kv blocks exhausted", depth)
+            if self.admission_factor > 0:
+                ema = self._ema.ema
+                if ema is not None:
+                    waves = math.ceil((depth + 1) / self.max_batch)
+                    projected = waves * ema * max_new
+                    if projected > self.admission_factor * deadline:
+                        raise self._reject_locked(
+                            "projected wait", depth,
+                            projected_wait_ms=projected,
+                            deadline_ms=deadline)
+            stream = TokenStream(prompt, self._next_index, now,
+                                 now + int(deadline * 1e6), max_new,
+                                 eos_id)
+            self._next_index += 1
+        try:
+            self._q.put_nowait(stream)
+            stream.payload_nbytes = payload_nbytes
+            self._payload_acct.add(payload_nbytes)
+        except queue.Full:
+            with self._lock:
+                raise self._reject_locked("queue full",
+                                          self.max_queue_depth)
+        if self._closed:
+            # scheduler exited between the admission check and the
+            # enqueue (it marks _closed BEFORE its final sweep) — shed
+            # it NOW rather than strand it unaccounted
+            self._drain_leftovers()
+        telemetry.gauge("LM/queue_depth").set(self.queue_depth())
+        return stream
+
+    def _reject_locked(self, reason: str, depth: Optional[int] = None,
+                       **kw) -> Overloaded:
+        self._counts["rejected"] += 1
+        telemetry.counter("LM/rejected").inc()
+        telemetry.counter("LM/rejected",
+                          labels={"reason": reason.replace(" ", "_")}).inc()
+        return Overloaded(reason,
+                          queue_depth=(depth if depth is not None
+                                       else self.queue_depth()),
+                          max_depth=self.max_queue_depth, **kw)
+
+    def _validate(self, stream: TokenStream, chaos) -> np.ndarray:
+        """Per-request prompt validation — the taxonomy choke point:
+        anything wrong with the PAYLOAD raises :class:`ServingDataError`
+        here, quarantining one stream instead of killing a batch."""
+        if chaos.poison_prompt(stream.index):
+            raise ServingDataError(
+                f"chaos: poison prompt at admission position "
+                f"{stream.index}")
+        try:
+            row = np.asarray(stream.prompt)
+        except Exception as e:
+            raise ServingDataError(
+                f"undecodable prompt payload: {e!r}") from e
+        if row.ndim != 1 or row.size == 0:
+            raise ServingDataError(
+                f"prompt must be a non-empty 1-D token-id sequence, got "
+                f"shape {row.shape}")
+        if not np.issubdtype(row.dtype, np.integer):
+            raise ServingDataError(
+                f"prompt token ids must be integers, got dtype "
+                f"{row.dtype}")
+        if row.size + stream.max_new_tokens > self.max_context:
+            raise ServingDataError(
+                f"prompt of {row.size} token(s) + max_new_tokens "
+                f"{stream.max_new_tokens} exceeds bigdl.lm.maxContext "
+                f"{self.max_context}")
+        return row.astype(np.int32)
+
+    # -- accounting -------------------------------------------------------
+
+    def _finish_stream(self, stream: TokenStream, outcome: str,
+                       error: Optional[BaseException] = None,
+                       reason: Optional[str] = None) -> bool:
+        if not stream._finish(outcome, error=error):
+            return False
+        if stream.payload_nbytes:
+            self._payload_acct.sub(stream.payload_nbytes)
+            stream.payload_nbytes = 0
+        with self._lock:
+            self._counts[outcome] += 1
+        telemetry.counter(f"LM/{outcome}").inc()
+        if reason:
+            telemetry.counter(f"LM/{outcome}",
+                              labels={"reason": reason}).inc()
+        if outcome == "completed":
+            self._latency.observe(stream.latency_ms())
+        return True
+
+    def stats(self) -> Dict[str, Any]:
+        """Outcome counters + the accounting identity residual
+        (``unaccounted`` includes streams still in flight — quiesce
+        first for the exact identity)."""
+        with self._lock:
+            out: Dict[str, Any] = dict(self._counts)
+        out["unaccounted"] = out["submitted"] - sum(out[o]
+                                                    for o in OUTCOMES)
+        out["decode_steps"] = self.decode_steps
+        out["prefills"] = self.prefills
+        out["tokens_out"] = self.tokens_out
+        out["queue_depth"] = self.queue_depth()
+        out["decode_ema_ms"] = self._ema.ema
+        out["cooldown"] = self._cooldown
+        out["draining"] = self._draining
+        out["active_slots"] = sum(s is not None for s in self._slots)
+        out["free_blocks"] = self.cache.free_blocks
+        out["used_blocks"] = self.cache.used_blocks
+        return out
+
+    # -- the scheduler thread --------------------------------------------
+
+    def _any_active(self) -> bool:
+        return any(s is not None for s in self._slots)
+
+    def _scheduler_loop(self) -> None:
+        telemetry.name_thread("lm-scheduler")
+        wd = None
+        if self.stall_factor > 0:
+            wd = HungDispatchWatchdog(
+                self.stall_factor, warmup=self.warmup_steps,
+                cooldown=self.cooldown_steps,
+                poll_interval=min(self.poll_interval, 0.05))
+            wd.start()                    # driver tid = this thread
+            self.watchdog = wd
+        try:
+            drained = False
+            while not drained:
+                if not self._draining:
+                    if elastic.preemption_requested():
+                        with self._lock:
+                            self._begin_drain_locked(
+                                "preemption",
+                                elastic.preemption_requested_at() or
+                                time.monotonic())
+                    elif self._stop_event.is_set():
+                        with self._lock:
+                            self._begin_drain_locked("stop",
+                                                     time.monotonic())
+                if self._draining:
+                    if time.monotonic() > self._drain_deadline:
+                        self._drain_leftovers()
+                        self._shed_active(ServingInfraError(
+                            "engine draining: decode did not finish "
+                            "within the grace period — retriable"),
+                            "drained")
+                        drained = True
+                        continue
+                    if (self._q.empty() and not self._pending and
+                            not self._any_active()):
+                        drained = True
+                        continue
+                active = True
+                try:
+                    # the watchdog abort can surface during admission
+                    # (validate/prefill) just as during decode — both run
+                    # on the step clock, so both sit under one handler
+                    self._admit_waiting(wd)
+                    active = self._any_active()
+                    if active:
+                        self._decode_iteration(wd)
+                except HungDispatchError:
+                    ema = self._ema.ema
+                    baseline = (f"{ema:.1f} ms EMA" if ema is not None
+                                else "unseeded EMA")
+                    diag = HungDispatchError(
+                        f"decode step wedged past "
+                        f"{self.stall_factor:.1f}x the iteration "
+                        f"baseline ({baseline}) — the hung-dispatch "
+                        "watchdog aborted it")
+                    self._shed_active(diag, "hung_decode", cool=True)
+                    if wd is not None:
+                        wd.heartbeat()
+                except Exception as e:  # noqa: BLE001 — must outlive
+                    self._shed_active(ServingInfraError(
+                        f"decode failed: {e!r}"), "infra")
+                if not active:
+                    try:
+                        with (wd.paused() if wd is not None
+                              else nullcontext()):
+                            stream = self._q.get(
+                                timeout=self.poll_interval)
+                        self._pending.append(stream)
+                    except queue.Empty:
+                        with self._lock:
+                            if self._cooldown:
+                                # backlog clear: a cooldown with no
+                                # traffic would never end
+                                self._cooldown = 0
+        finally:
+            if wd is not None:
+                wd.stop()
+            # _closed BEFORE the sweep: a racing submit that enqueued
+            # past the drain either observes _closed (and sheds its own
+            # stream) or enqueued before this sweep — exactly one
+            self._closed = True
+            self._drain_leftovers()
+            self._shed_active(ServingInfraError(
+                "scheduler exited with the sequence in flight — "
+                "retriable"), "infra")
+
+    def _begin_drain_locked(self, reason: str, started_at: float,
+                            grace: Optional[float] = None) -> None:
+        budget = grace if grace is not None else self.grace_period
+        # deadline published BEFORE the flag (lock-free readers)
+        self._drain_deadline = started_at + budget
+        self._drain_reason = reason
+        self._draining = True
+        logger.info("LM engine draining (%s): grace %.1f s, %d queued, "
+                    "%d active", reason, budget, self.queue_depth(),
+                    sum(s is not None for s in self._slots))
+
+    def _drain_leftovers(self) -> None:
+        """Shed everything still waiting (queue + block-starved pending
+        holdover) — retriable by construction.  Bounded sweeps: both
+        containers are capped at ``maxQueueDepth``."""
+        shed = 0
+        for src in ("queue", "pending"):
+            for _ in range(self.max_queue_depth + 1):
+                if src == "queue":
+                    try:
+                        stream = self._q.get_nowait()
+                    except queue.Empty:
+                        break
+                else:
+                    try:
+                        stream = self._pending.popleft()
+                    except IndexError:
+                        break
+                err = ServingInfraError(
+                    "engine draining: prompt was not scheduled within "
+                    "the grace period — retriable")
+                shed += self._finish_stream(stream, "shed", error=err,
+                                            reason="drained")
+        if shed:
+            logger.warning("LM drain shed %d queued stream(s)", shed)
+        telemetry.gauge("LM/queue_depth").set(self.queue_depth())
+
+    def _shed_active(self, error: Exception, reason: str,
+                     cool: bool = False) -> None:
+        """Fail every in-flight sequence with the diagnosis and free
+        its blocks.  Each victim gets its OWN exception instance —
+        concurrent ``result()`` raises on a shared object would
+        interleave tracebacks across client threads."""
+        failed = 0
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            self._slots[i] = None
+            self.cache.free_seq(slot.stream.seq_id)
+            failed += self._finish_stream(
+                slot.stream, "shed", error=type(error)(*error.args),
+                reason=reason)
+        stream = self._admitting
+        if stream is not None:
+            # an abort landed mid-admission: the stream was popped from
+            # the queue but never reached a slot.  free_seq and
+            # _finish_stream are both idempotent, so overlap with the
+            # slot sweep above is harmless.
+            self._admitting = None
+            self.cache.free_seq(stream.seq_id)
+            failed += self._finish_stream(
+                stream, "shed", error=type(error)(*error.args),
+                reason=reason)
+        if cool:
+            with self._lock:
+                self._cooldown = max(self._cooldown, self.cooldown_steps)
+        if failed:
+            logger.error(
+                "LM decode aborted (%s): %d in-flight stream(s) failed "
+                "with %s%s", reason, failed, type(error).__name__,
+                f"; cooling down for {self.cooldown_steps} steps"
+                if cool else "")
+
+    def _admit_waiting(self, wd) -> None:
+        """Fill vacant decode slots from the pending holdover then the
+        queue: expired prompts are shed, poison ones quarantined
+        (neither consumes a slot); a block-starved prompt goes back to
+        the FRONT of the holdover (FIFO) and admission stops until a
+        finishing sequence frees blocks."""
+        from bigdl_tpu.utils import chaos
+        for _ in range(self.max_batch):
+            slot_idx = next((i for i, s in enumerate(self._slots)
+                             if s is None), None)
+            if slot_idx is None:
+                return
+            if self._pending:
+                stream = self._pending.popleft()
+            else:
+                try:
+                    stream = self._q.get_nowait()
+                except queue.Empty:
+                    return
+            # published so the watchdog's async abort cannot strand a
+            # stream that lives only in this local (cleared at every
+            # resting point; double-finish below is a guarded no-op)
+            self._admitting = stream
+            now = telemetry.clock_ns()
+            if now > stream.deadline_ns:
+                waited = (now - stream.submit_ns) / 1e6
+                deadline = (stream.deadline_ns - stream.submit_ns) / 1e6
+                self._finish_stream(
+                    stream, "shed",
+                    error=DeadlineExceeded(waited, deadline),
+                    reason="expired")
+                self._admitting = None
+                continue
+            try:
+                prompt = self._validate(stream, chaos)
+            except ServingDataError as e:
+                self._finish_stream(stream, "quarantined", error=e)
+                self._admitting = None
+                continue
+            if not self.cache.can_allocate(prompt.size +
+                                           stream.max_new_tokens):
+                self._pending.appendleft(stream)
+                self._admitting = None
+                return
+            self.cache.allocate(stream.seq_id,
+                                prompt.size + stream.max_new_tokens)
+            try:
+                tok, table_row = self._prefill_step_raw(stream.seq_id,
+                                                        prompt)
+            except Exception as e:  # noqa: BLE001 — fail one stream
+                self.cache.free_seq(stream.seq_id)
+                self._finish_stream(stream, "shed", error=ServingInfraError(
+                    f"prefill failed: {e!r}"), reason="infra")
+                self._admitting = None
+                continue
+            if wd is not None:
+                wd.heartbeat()
+            stream._emit(tok)
+            self._ttft.observe(stream.ttft_ms())
+            telemetry.counter("LM/tokens").inc()
+            self.tokens_out += 1
+            if ((stream.eos_id is not None and tok == stream.eos_id) or
+                    stream.max_new_tokens <= 1):
+                self.cache.free_seq(stream.seq_id)
+                self._finish_stream(stream, "completed")
+                self._admitting = None
+                continue
+            self._slots[slot_idx] = _Slot(stream, int(prompt.size), tok,
+                                          table_row)
+            self._admitting = None
+        telemetry.gauge("LM/queue_depth").set(self.queue_depth())
+
+    def _prefill_step_raw(self, seq_id: int, prompt: np.ndarray
+                          ) -> Tuple[int, np.ndarray]:
+        """Run the bucketed prefill for an ALLOCATED sequence: scatter
+        the prompt's k/v into its blocks, return the first greedy token
+        (1-based) and the dump-padded table row the decode step
+        gathers through."""
+        from bigdl_tpu.analysis.hostsync import host_pull
+        t0 = telemetry.clock_ns()
+        P = int(prompt.size)
+        bucket = self._prefill_bucket(P)
+        padded = np.ones((1, bucket), np.int32)
+        padded[0, :P] = prompt
+        blocks = self.cache.table(seq_id)
+        table_row = np.full((self._max_blocks,), DUMP_BLOCK, np.int32)
+        table_row[:len(blocks)] = blocks
+        lp, new_k, new_v = self._prefill(self._dp, self.cache.k,
+                                         self.cache.v, padded,
+                                         np.int32(P), table_row)
+        self.cache.k, self.cache.v = new_k, new_v
+        lp = np.asarray(host_pull(lp, what="lm prefill logits"))
+        self.prefills += 1
+        telemetry.counter("LM/prefills").inc()
+        telemetry.gauge("LM/prefill_ms").set(
+            (telemetry.clock_ns() - t0) / 1e6)
+        return int(np.argmax(lp)) + 1, table_row
+
+    def _decode_iteration(self, wd) -> None:
+        """ONE fused decode step over every occupied slot — the
+        continuous-batching heartbeat.  Finished sequences vacate their
+        slot and free their blocks before the next admission pass."""
+        from bigdl_tpu.analysis.hostsync import host_pull
+        from bigdl_tpu.utils import chaos
+        self.decode_steps += 1
+        step = self.decode_steps
+        telemetry.counter("LM/decode_steps").inc()
+        chaos.on_decode_step(step)
+        if chaos.evict_block(step):
+            victim = next((i for i, s in enumerate(self._slots)
+                           if s is not None), None)
+            if victim is not None:
+                slot = self._slots[victim]
+                self._slots[victim] = None
+                self.cache.free_seq(slot.stream.seq_id)
+                self._finish_stream(slot.stream, "shed",
+                                    error=ServingInfraError(
+                                        "chaos: kv blocks evicted under "
+                                        "an active sequence — retriable"),
+                                    reason="evicted")
+            if not self._any_active():
+                return
+        t0 = telemetry.clock_ns()
+        B, MB = self.max_batch, self._max_blocks
+        tokens = np.ones((B, 1), np.int32)
+        positions = np.zeros((B,), np.int32)
+        tables = np.full((B, MB), DUMP_BLOCK, np.int32)
+        active = np.zeros((B,), bool)
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            tokens[i, 0] = slot.last_token
+            positions[i] = slot.position
+            tables[i] = slot.table_row
+            active[i] = True
+        dp, fn = ((self._dp_q, self._decode_q)
+                  if self._dp_q is not None else (self._dp, self._decode))
+        lp, new_k, new_v = fn(dp, self.cache.k, self.cache.v, tokens,
+                              positions, tables, active)
+        self.cache.k, self.cache.v = new_k, new_v
+        lp = np.asarray(host_pull(lp, what="lm decode logits"))
+        now = telemetry.clock_ns()
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            stream = slot.stream
+            tok = int(np.argmax(lp[i])) + 1
+            slot.position += 1
+            slot.generated += 1
+            slot.last_token = tok
+            self._itl.observe((now - slot.last_emit_ns) / 1e6)
+            slot.last_emit_ns = now
+            stream._emit(tok)
+            telemetry.counter("LM/tokens").inc()
+            self.tokens_out += 1
+            if ((stream.eos_id is not None and tok == stream.eos_id) or
+                    slot.generated >= stream.max_new_tokens):
+                self._slots[i] = None
+                self.cache.free_seq(stream.seq_id)
+                self._finish_stream(stream, "completed")
+            elif now > stream.deadline_ns:
+                # mid-stream expiry AFTER emitting: the streamed prefix
+                # stays with the client, the terminal error says why it
+                # stopped — the partially-streamed-then-failed shape
+                self._slots[i] = None
+                self.cache.free_seq(stream.seq_id)
+                waited = (now - stream.submit_ns) / 1e6
+                deadline = (stream.deadline_ns - stream.submit_ns) / 1e6
+                self._finish_stream(
+                    stream, "shed",
+                    error=DeadlineExceeded(waited, deadline),
+                    reason="expired")
+        ms = (telemetry.clock_ns() - t0) / 1e6
+        self._ema.observe(ms)
+        if wd is not None:
+            wd.heartbeat()
+        with self._lock:
+            if self._cooldown > 0:
+                self._cooldown -= 1
+        telemetry.gauge("LM/decode_ms").set(ms)
+        telemetry.gauge("LM/slot_occupancy").set(
+            sum(s is not None for s in self._slots) / max(1, B))
+
+    # -- offline generation (parity + baseline) ---------------------------
+
+    def _offline_seq_id(self) -> int:
+        # negative ids so offline allocations can never collide with a
+        # stream's admission-index seq_id
+        self._offline_id -= 1
+        return self._offline_id
+
+    def generate(self, prompt, max_new_tokens: Optional[int] = None,
+                 eos_id: Optional[int] = None,
+                 return_logps: bool = False):
+        """Offline greedy generation through the PAGED path (prefill +
+        single-token decode over the block table) — the exact compiled
+        steps the scheduler dispatches, minus the scheduler.  Refused
+        while the scheduler runs (it owns the slots and pools)."""
+        from bigdl_tpu.analysis.hostsync import host_pull
+        if self._started:
+            raise ServingInfraError(
+                "generate() is the offline path — the scheduler owns the "
+                "decode slots once start() has run; use submit()")
+        prompt = np.asarray(prompt)
+        if prompt.ndim != 1 or prompt.size == 0:
+            raise ServingDataError(
+                f"prompt must be a non-empty 1-D token-id sequence, got "
+                f"shape {prompt.shape}")
+        prompt = prompt.astype(np.int32)
+        max_new = int(max_new_tokens if max_new_tokens is not None
+                      else self.max_new_tokens)
+        if prompt.size + max_new > self.max_context:
+            raise ServingDataError(
+                f"prompt of {prompt.size} token(s) + max_new_tokens "
+                f"{max_new} exceeds bigdl.lm.maxContext "
+                f"{self.max_context}")
+        B, MB = self.max_batch, self._max_blocks
+        seq_id = self._offline_seq_id()
+        self.cache.allocate(seq_id, int(prompt.size) + max_new)
+        try:
+            tok, table_row = self._prefill_step_raw(seq_id, prompt)
+            out_tokens = [tok]
+            logps: List[np.ndarray] = []
+            dp, fn = ((self._dp_q, self._decode_q)
+                      if self._dp_q is not None
+                      else (self._dp, self._decode))
+            position = int(prompt.size)
+            for _ in range(max_new - 1):
+                if eos_id is not None and out_tokens[-1] == eos_id:
+                    break
+                tokens = np.ones((B, 1), np.int32)
+                positions = np.zeros((B,), np.int32)
+                tables = np.full((B, MB), DUMP_BLOCK, np.int32)
+                active = np.zeros((B,), bool)
+                tokens[0, 0], positions[0] = out_tokens[-1], position
+                tables[0], active[0] = table_row, True
+                lp, new_k, new_v = fn(dp, self.cache.k, self.cache.v,
+                                      tokens, positions, tables, active)
+                self.cache.k, self.cache.v = new_k, new_v
+                row = np.asarray(host_pull(
+                    lp, what="lm offline decode logits"))[0]
+                out_tokens.append(int(np.argmax(row)) + 1)
+                logps.append(row)
+                position += 1
+        finally:
+            self.cache.free_seq(seq_id)
+        return (out_tokens, logps) if return_logps else out_tokens
+
+    def generate_sequential(self, prompt,
+                            max_new_tokens: Optional[int] = None,
+                            eos_id: Optional[int] = None,
+                            return_logps: bool = False):
+        """The KV-cache-free baseline the bench's speedup claim is
+        measured against: one TEACHER-FORCED full forward over the
+        whole growing sequence per emitted token (what serving without
+        a decode cache actually costs).  Greedy tokens are bit-identical
+        to :meth:`generate`; per-position log-probs agree to allclose
+        (the reductions are shaped differently)."""
+        from bigdl_tpu.analysis.hostsync import host_pull
+        prompt = np.asarray(prompt)
+        if prompt.ndim != 1 or prompt.size == 0:
+            raise ServingDataError(
+                f"prompt must be a non-empty 1-D token-id sequence, got "
+                f"shape {prompt.shape}")
+        max_new = int(max_new_tokens if max_new_tokens is not None
+                      else self.max_new_tokens)
+        if prompt.size + max_new > self.max_context:
+            raise ServingDataError(
+                f"prompt of {prompt.size} token(s) + max_new_tokens "
+                f"{max_new} exceeds bigdl.lm.maxContext "
+                f"{self.max_context}")
+        seq = [int(t) for t in prompt]
+        out_tokens: List[int] = []
+        logps: List[np.ndarray] = []
+        for _ in range(max_new):
+            if (eos_id is not None and out_tokens and
+                    out_tokens[-1] == eos_id):
+                break
+            t = len(seq)
+            bucket = self._prefill_bucket(t)
+            padded = np.ones((1, bucket), np.int32)
+            padded[0, :t] = seq
+            lp = self._full(self._dp, padded)
+            row = np.asarray(host_pull(
+                lp, what="lm sequential logits"))[t - 1]
+            tok = int(np.argmax(row)) + 1
+            seq.append(tok)
+            out_tokens.append(tok)
+            logps.append(row)
+        return (out_tokens, logps) if return_logps else out_tokens
+
+
+__all__ = ["LMServingEngine", "TokenStream", "PagedKVCache",
+           "QuantizationGateError", "UnsupportedModelError"]

@@ -116,6 +116,25 @@ identically under pytest, a soak script, or a real cluster rehearsal:
                                 thundering herd the admission control must
                                 reject fast instead of collapsing tail
                                 latency (once per position).
+``bigdl.chaos.poisonPromptAt``  "k" or "k:m": LM serving prompts with
+                                admission position k..m (0-based) read as
+                                poison — the LM engine's per-request
+                                quarantine must fail exactly those with
+                                ``ServingDataError`` while the decode
+                                batch keeps streaming (once per
+                                position).
+``bigdl.chaos.hangDecodeAt``    "k" or "k:seconds": the k-th LM decode
+                                iteration wedges for ``seconds`` (default
+                                5.0), sleeping in short slices — the
+                                hung-decode watchdog must abort it, shed
+                                the in-flight streams with diagnosis, and
+                                cool the engine down (once per plan).
+``bigdl.chaos.evictBlockAt``    k: at LM decode iteration k one active
+                                sequence's KV blocks "evict" — the engine
+                                must shed exactly that stream with a
+                                retriable infra error, free its blocks,
+                                and keep every other stream intact (once
+                                per plan).
 ``bigdl.chaos.bitflipParamAt``  "k" or "k:leaf": at iteration k ONE
                                 mid-mantissa bit of the first element of
                                 float parameter leaf ``leaf`` (default 0)
@@ -253,6 +272,11 @@ class _ChaosState:
             config.get_property("bigdl.chaos.hangDispatchAt"))
         self.burst_arrivals_at, self.burst_arrivals_n = _parse_burst(
             config.get_property("bigdl.chaos.burstArrivals"))
+        self.poison_prompt_at = _parse_span(
+            config.get_property("bigdl.chaos.poisonPromptAt"))
+        self.hang_decode_at, self.hang_decode_seconds = _parse_stall(
+            config.get_property("bigdl.chaos.hangDecodeAt"))
+        self.evict_block_at = config.get_int("bigdl.chaos.evictBlockAt", 0)
         self.bitflip_at, self.bitflip_leaf = _parse_indexed(
             config.get_property("bigdl.chaos.bitflipParamAt"), 0)
         self.desync_at, self.desync_replica = _parse_indexed(
@@ -293,6 +317,9 @@ class _ChaosState:
         self.dispatches = 0
         self.dispatch_hangs = 0
         self.bursts_fired: set = set()
+        self.prompt_poison_fired: set = set()
+        self.decode_hangs = 0
+        self.block_evictions = 0
         self.bitflip_due: Optional[int] = None  # leaf index, consume-once
         self.bitflips = 0
         self.state_corruptions = 0
@@ -512,6 +539,53 @@ class _ChaosState:
             end = time.monotonic() + self.hang_dispatch_seconds
             while time.monotonic() < end:
                 time.sleep(0.02)
+
+    def poison_prompt(self, index: int) -> bool:
+        """True when the LM prompt at admission position ``index``
+        (0-based) should read as poison — the LM engine must quarantine
+        exactly that stream with ``ServingDataError`` while the decode
+        batch keeps streaming.  Once per position per plan."""
+        lo, hi = self.poison_prompt_at
+        if bool(hi >= 0) and lo <= index <= hi:
+            with self._lock:
+                fire = index not in self.prompt_poison_fired
+                self.prompt_poison_fired.add(index)
+            return fire
+        return False
+
+    def on_decode_step(self, step: int) -> None:
+        """Called by the LM scheduler before each decode iteration
+        (``step`` is 1-based): the ``hangDecodeAt``-th iteration wedges
+        for ``seconds`` (default 5.0), sleeping in short slices so the
+        hung-decode watchdog's injected ``HungDispatchError`` lands
+        within one slice — the interruptible stand-in for a wedged
+        decode dispatch.  One wedge per plan."""
+        if not self.hang_decode_at:
+            return
+        with self._lock:
+            fire = (step >= self.hang_decode_at and
+                    self.decode_hangs == 0)
+            if fire:
+                self.decode_hangs = 1
+        if fire:
+            import time
+            end = time.monotonic() + self.hang_decode_seconds
+            while time.monotonic() < end:
+                time.sleep(0.02)
+
+    def evict_block(self, step: int) -> bool:
+        """True when one active sequence's KV blocks should "evict" at
+        LM decode iteration ``step`` (1-based) — the engine sheds that
+        stream retriably, frees the blocks, and keeps every other stream
+        intact.  Once per plan."""
+        if not self.evict_block_at:
+            return False
+        with self._lock:
+            fire = (step >= self.evict_block_at and
+                    self.block_evictions == 0)
+            if fire:
+                self.block_evictions = 1
+        return fire
 
     def burst_arrivals(self, index: int) -> int:
         """Extra back-to-back arrivals the open-loop load generator
@@ -987,6 +1061,30 @@ def on_dispatch(label: str = "") -> None:
     ``hangDispatchAt``-th dispatch wedges interruptibly."""
     if _state is not None:
         _state.on_dispatch(label)
+
+
+def poison_prompt(index: int) -> bool:
+    """LM-serving per-prompt poison test (False when disarmed): True
+    means "this admission position's prompt reads as poison NOW" (once
+    per position)."""
+    if _state is None:
+        return False
+    return _state.poison_prompt(index)
+
+
+def on_decode_step(step: int) -> None:
+    """LM decode-iteration hook (no-op when disarmed): the
+    ``hangDecodeAt``-th decode iteration wedges interruptibly."""
+    if _state is not None:
+        _state.on_decode_step(step)
+
+
+def evict_block(step: int) -> bool:
+    """LM decode-iteration eviction hook (False when disarmed): True
+    means "one active sequence's KV blocks evict NOW" (once per plan)."""
+    if _state is None:
+        return False
+    return _state.evict_block(step)
 
 
 def burst_arrivals(index: int) -> int:

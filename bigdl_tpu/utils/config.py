@@ -53,6 +53,10 @@ _DEFAULTS: Dict[str, Any] = {
     "bigdl.chaos.poisonRequestAt": None,  # "k" / "k:m": admission positions k..m poison
     "bigdl.chaos.hangDispatchAt": None,   # "k" / "k:seconds": k-th batch dispatch wedges
     "bigdl.chaos.burstArrivals": None,    # "k" / "k:n": n extra arrivals at position k
+    # LM-serving fault injection (bigdl_tpu/serving/lm.py)
+    "bigdl.chaos.poisonPromptAt": None,   # "k" / "k:m": admission positions k..m poison prompts
+    "bigdl.chaos.hangDecodeAt": None,     # "k" / "k:seconds": k-th decode iteration wedges
+    "bigdl.chaos.evictBlockAt": 0,        # k: a KV block "evicts" at decode iteration k
     # fleet-control-plane faults (bigdl_tpu/fleet)
     "bigdl.chaos.killReplicaAt": None,    # "k" / "k:replica": async-kill a replica's
     # batcher thread at the fleet's k-th submitted request
@@ -104,6 +108,30 @@ _DEFAULTS: Dict[str, Any] = {
     "bigdl.serving.warmupBatches": 3,      # dispatch-EMA warmup (compile exemption)
     "bigdl.serving.cooldownSteps": 8,      # batches after a watchdog fire before re-admission
     "bigdl.serving.gracePeriod": 5.0,      # drain window for SIGTERM / stop, seconds
+    # LM token serving (bigdl_tpu/serving/lm.py): continuous batching over
+    # a paged KV cache — ONE fixed (maxBatch, 1) decode shape, bucketed
+    # prefill plan, streaming per-request token output
+    "bigdl.lm.maxBatch": 8,                # concurrent decode slots (the fixed decode batch)
+    "bigdl.lm.maxContext": 256,            # prompt + generated tokens ceiling per sequence
+    "bigdl.lm.blockSize": 16,              # KV-cache tokens per block
+    "bigdl.lm.cacheBlocks": 0,             # KV pool blocks incl. dump block; 0 = derive
+    # maxBatch x blocks_per_seq(maxContext) + 1
+    "bigdl.lm.prefillBuckets": None,       # "16,32,64": prompt pad-up plan; None = pow2
+    # ladder from blockSize to maxContext
+    "bigdl.lm.maxNewTokens": 64,           # default generation cap per request
+    "bigdl.lm.deadlineMs": 5000.0,         # default end-to-end per-request deadline
+    "bigdl.lm.maxQueueDepth": 128,         # admission queue bound (reject past it)
+    "bigdl.lm.admissionDeadlineFactor": 0,  # reject when projected wait > f x deadline; 0 off
+    "bigdl.lm.stallFactor": 0,             # hung-decode watchdog: abort > k x EMA; 0 off
+    "bigdl.lm.warmupSteps": 3,             # decode-EMA warmup (compile exemption)
+    "bigdl.lm.cooldownSteps": 8,           # decode iterations after a watchdog fire
+    # before re-admission
+    "bigdl.lm.gracePeriod": 5.0,           # drain window for SIGTERM / stop, seconds
+    "bigdl.lm.pollInterval": 0.01,         # scheduler idle wake period, seconds
+    "bigdl.lm.quantize": "off",            # "int8": decode matmuls on int8 weights,
+    # gated by the auditor precision pass + an allclose logits check
+    "bigdl.lm.quantizeRtol": 0.05,         # int8-gate allclose rtol vs full precision
+    "bigdl.lm.quantizeAtol": 0.05,         # int8-gate allclose atol vs full precision
     # fleet control plane (bigdl_tpu/fleet): N models x N replicas under one
     # supervisor — zero-downtime hot swap, blue/green rollout gated on the
     # semantic checkpoint fingerprint + shadow-traffic parity, crash restarts,

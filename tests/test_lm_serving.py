@@ -1,0 +1,523 @@
+"""LM token serving: continuous batching over a paged KV cache.
+
+The claims under test (ISSUE 18 acceptance criteria): the paged pool's
+three invariants (structured exhaustion — never OOM; dump block never
+allocated; freed blocks zero-scrubbed, bit-asserted); the paged decode
+path's parity with a teacher-forced full forward (greedy tokens
+bit-identical, per-position log-probs allclose); iteration-level
+batching legible in the decode-step/token ratio with zero post-warmup
+retraces across prefill AND decode; per-request streaming with
+partially-streamed-then-failed as a first-class outcome; the chaos trio
+(``poisonPromptAt`` / ``hangDecodeAt`` / ``evictBlockAt``) and the
+combined-chaos accounting identity ``completed + shed + rejected +
+quarantined == submitted``, exact; and the int8 decode tier's admission
+gate (auditor precision pass + fp-vs-int8 logits allclose — either
+failing refuses to serve quantized).
+"""
+
+import os
+import re
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.models.transformer import transformer_lm
+from bigdl_tpu.serving import (LMServingEngine, Overloaded, PagedKVCache,
+                               QuantizationGateError, UnsupportedModelError,
+                               run_lm_open_loop, sample_lm_workload)
+from bigdl_tpu.serving.engine import (DeadlineExceeded, HungDispatchError,
+                                      OUTCOMES, ServingDataError,
+                                      ServingInfraError)
+from bigdl_tpu.serving.kv_cache import DUMP_BLOCK
+from bigdl_tpu.utils import chaos, config, elastic
+
+VOCAB = 32
+
+_LM_KEYS = (
+    "bigdl.analysis.retrace", "bigdl.lm.stallFactor",
+    "bigdl.lm.warmupSteps", "bigdl.lm.quantizeRtol",
+    "bigdl.lm.quantizeAtol", "bigdl.lm.prefillBuckets",
+    "bigdl.chaos.poisonPromptAt", "bigdl.chaos.hangDecodeAt",
+    "bigdl.chaos.evictBlockAt", "bigdl.chaos.burstArrivals",
+)
+
+
+@pytest.fixture(autouse=True)
+def _lm_env():
+    """Disarmed chaos, cleared preemption, clean knobs around every
+    test."""
+    elastic.clear_preemption()
+    yield
+    chaos.uninstall()
+    elastic.clear_preemption()
+    for k in _LM_KEYS:
+        config.clear_property(k)
+
+
+def _model(seed=3, vocab=VOCAB, **kw):
+    kw.setdefault("d_model", 16)
+    kw.setdefault("n_head", 2)
+    kw.setdefault("n_layers", 2)
+    kw.setdefault("max_len", 64)
+    m = transformer_lm(vocab, **kw)
+    m.reset(jax.random.PRNGKey(seed))
+    return m
+
+
+def _engine(model=None, warm=True, **kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_context", 32)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("deadline_ms", 30000.0)
+    eng = LMServingEngine(model if model is not None else _model(), **kw)
+    if warm:
+        eng.warmup()
+    return eng
+
+
+def _prompt(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(1, VOCAB + 1, size=n).astype(np.int32)
+
+
+def _assert_identity(stats_or_rec):
+    assert stats_or_rec["unaccounted"] == 0, stats_or_rec
+    total = sum(stats_or_rec[o] for o in OUTCOMES)
+    assert total == stats_or_rec["submitted"], stats_or_rec
+
+
+def _zero_retraces(eng):
+    retr = {label: s.retraces for label, s in eng.sentinels.items()}
+    assert retr and all(v == 0 for v in retr.values()), \
+        f"post-warmup retraces: {retr}"
+
+
+# ---------------------------------------------------------------------------
+# paged KV cache invariants
+# ---------------------------------------------------------------------------
+
+class TestPagedKVCache:
+    def test_exhaustion_is_structured_overloaded_never_oom(self):
+        cache = PagedKVCache(2, 2, 8, n_blocks=4, block_size=4)
+        cache.allocate(1, 12)                     # 3 blocks = the pool
+        with pytest.raises(Overloaded) as ei:
+            cache.allocate(2, 8)                  # needs 2, 0 free
+        assert ei.value.retriable
+        assert ei.value.blocks_needed == 2 and ei.value.blocks_free == 0
+        cache.free_seq(1)
+        assert cache.can_allocate(8)              # retriable for real
+
+    def test_dump_block_never_allocated(self):
+        cache = PagedKVCache(2, 2, 8, n_blocks=5, block_size=4)
+        blocks = cache.allocate(1, 16)            # the whole free-list
+        assert DUMP_BLOCK not in blocks
+        assert sorted(blocks) == [1, 2, 3, 4]
+
+    def test_block_reuse_is_zero_initialized_bitwise(self):
+        cache = PagedKVCache(2, 2, 8, n_blocks=5, block_size=4)
+        blocks = cache.allocate(7, 10)
+        # simulate a decode having written k/v into the blocks
+        cache.k = cache.k.at[:, np.array(blocks)].set(1.5)
+        cache.v = cache.v.at[:, np.array(blocks)].set(-2.25)
+        assert float(np.abs(np.asarray(cache.k[:, blocks])).max()) > 0
+        cache.free_seq(7)
+        # the scrub is the no-cross-request-leakage proof: bit-exact zero
+        assert (np.asarray(cache.k[:, blocks]) == 0).all()
+        assert (np.asarray(cache.v[:, blocks]) == 0).all()
+        again = cache.allocate(8, 10)
+        assert sorted(again) == sorted(blocks)    # same ids, clean bits
+
+    def test_double_allocate_and_idempotent_free(self):
+        cache = PagedKVCache(1, 1, 4, n_blocks=3, block_size=2)
+        cache.allocate(1, 2)
+        with pytest.raises(ValueError, match="already holds"):
+            cache.allocate(1, 2)
+        assert cache.free_seq(1) == 1
+        assert cache.free_seq(1) == 0             # idempotent
+
+    def test_pool_needs_room_beyond_the_dump_block(self):
+        with pytest.raises(ValueError, match="dump block"):
+            PagedKVCache(1, 1, 4, n_blocks=1, block_size=2)
+
+
+# ---------------------------------------------------------------------------
+# engine construction / validation
+# ---------------------------------------------------------------------------
+
+class TestEngineValidation:
+    def test_non_lm_model_is_refused_structurally(self):
+        m = (nn.Sequential().add(nn.Linear(4, 8)).add(nn.Tanh())
+             .add(nn.Linear(8, 3)))
+        m.reset(jax.random.PRNGKey(0))
+        with pytest.raises(UnsupportedModelError,
+                           match="transformer_lm-shaped"):
+            LMServingEngine(m)
+
+    def test_max_context_beyond_position_table_is_refused(self):
+        with pytest.raises(ValueError, match="PositionalEncoding"):
+            LMServingEngine(_model(max_len=64), max_context=128)
+
+    def test_never_fits_prompt_rejected_at_the_door(self):
+        # pool of 3 allocatable blocks x 4 slots = 12 tokens max
+        eng = _engine(warm=False, cache_blocks=4)
+        with pytest.raises(Overloaded, match="kv blocks exhausted"):
+            eng.submit(_prompt(8), max_new_tokens=8)     # 16 > 12
+        eng.close()
+        _assert_identity(eng.stats())
+
+    def test_over_context_prompt_is_quarantined(self):
+        with _engine() as eng:
+            eng.start()
+            s = eng.submit(_prompt(30), max_new_tokens=8)   # 38 > 32
+            with pytest.raises(ServingDataError, match="maxContext"):
+                s.result(timeout=10)
+            assert s.outcome == "quarantined"
+            stats = eng.stats()
+        _assert_identity(stats)
+
+
+# ---------------------------------------------------------------------------
+# decode-vs-full-forward parity (the paged-path correctness proof)
+# ---------------------------------------------------------------------------
+
+class TestDecodeParity:
+    def test_greedy_tokens_bit_identical_logps_allclose(self):
+        eng = _engine()
+        toks_paged, lp_paged = eng.generate(
+            _prompt(9, seed=5), max_new_tokens=12, return_logps=True)
+        toks_full, lp_full = eng.generate_sequential(
+            _prompt(9, seed=5), max_new_tokens=12, return_logps=True)
+        assert toks_paged == toks_full          # greedy: bit-identical
+        # paged logps cover generated tokens 2..N (the prefill's first
+        # token has no decode row); sequential covers 1..N
+        assert len(lp_paged) == len(lp_full) - 1
+        for a, b in zip(lp_paged, lp_full[1:]):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+        eng.close()
+
+    def test_mixed_prompt_lengths_share_one_decode_shape(self):
+        eng = _engine()
+        for n in (1, 3, 8, 17):
+            assert eng.generate(_prompt(n, seed=n), max_new_tokens=4) == \
+                eng.generate_sequential(_prompt(n, seed=n),
+                                        max_new_tokens=4)
+        _zero_retraces(eng)
+        eng.close()
+
+    def test_generate_refused_while_scheduler_runs(self):
+        with _engine() as eng:
+            eng.start()
+            with pytest.raises(ServingInfraError, match="offline"):
+                eng.generate(_prompt(4))
+
+
+# ---------------------------------------------------------------------------
+# streaming + continuous batching
+# ---------------------------------------------------------------------------
+
+class TestStreamingScheduler:
+    def test_stream_iterates_tokens_and_completes(self):
+        with _engine() as eng:
+            eng.start()
+            s = eng.submit(_prompt(6), max_new_tokens=6)
+            got = list(s)
+            assert got == s.result(timeout=10) and len(got) == 6
+            assert s.outcome == "completed"
+            assert s.ttft_ms() > 0 and s.latency_ms() >= s.ttft_ms()
+            stats = eng.stats()
+        _assert_identity(stats)
+
+    def test_eos_finishes_early(self):
+        with _engine() as eng:
+            eng.start()
+            probe = eng.submit(_prompt(6, seed=2), max_new_tokens=8)
+            toks = probe.result(timeout=10)
+            s = eng.submit(_prompt(6, seed=2), max_new_tokens=8,
+                           eos_id=toks[2])
+            assert s.result(timeout=10) == toks[:3]
+            assert s.outcome == "completed"
+
+    def test_iteration_level_batching_shares_decode_steps(self):
+        config.set_property("bigdl.analysis.retrace", "strict")
+        with _engine() as eng:
+            eng.start()
+            streams = [eng.submit(_prompt(5, seed=i), max_new_tokens=8)
+                       for i in range(8)]
+            outs = [s.result(timeout=30) for s in streams]
+            assert all(len(o) == 8 for o in outs)
+            stats = eng.stats()
+            # offline per-sequence decode would pay tokens - prefills
+            # steps; continuous batching must share iterations
+            decode_token_steps = stats["tokens_out"] - stats["prefills"]
+            assert stats["decode_steps"] < decode_token_steps, stats
+            # completions match the offline paged path bit-exactly
+            _zero_retraces(eng)
+        _assert_identity(stats)
+        ref = _engine()
+        for i, o in enumerate(outs):
+            assert o == ref.generate(_prompt(5, seed=i), max_new_tokens=8)
+        ref.close()
+
+    def test_blocks_free_after_drain(self):
+        with _engine() as eng:
+            eng.start()
+            for i in range(6):
+                eng.submit(_prompt(4, seed=i), max_new_tokens=4)
+            eng.stop()
+            assert eng.cache.used_blocks == 0
+            _assert_identity(eng.stats())
+
+    def test_deadline_sheds_after_streamed_prefix(self):
+        """Partially-streamed-then-failed is a first-class outcome: the
+        deadline check runs AFTER the iteration's emit, so the client
+        keeps the prefix and the terminal error is structured."""
+        config.set_property("bigdl.chaos.hangDecodeAt", "2:0.6")
+        chaos.install()
+        with _engine() as eng:
+            eng.start()
+            s = eng.submit(_prompt(5), max_new_tokens=10, deadline_ms=250.0)
+            got = []
+            with pytest.raises(DeadlineExceeded):
+                for tok in s:
+                    got.append(tok)
+            assert s.outcome == "shed"
+            assert len(got) >= 1                 # the streamed prefix
+            assert got == s.tokens()             # still readable
+            stats = eng.stats()
+        _assert_identity(stats)
+
+
+# ---------------------------------------------------------------------------
+# chaos trio + combined-plan identity
+# ---------------------------------------------------------------------------
+
+class TestLMChaos:
+    def test_poison_prompt_quarantined_alone(self):
+        config.set_property("bigdl.chaos.poisonPromptAt", "1")
+        chaos.install()
+        with _engine() as eng:
+            eng.start()
+            streams = [eng.submit(_prompt(4, seed=i), max_new_tokens=4)
+                       for i in range(3)]
+            assert len(streams[0].result(timeout=10)) == 4
+            assert len(streams[2].result(timeout=10)) == 4
+            with pytest.raises(ServingDataError, match="poison prompt"):
+                streams[1].result(timeout=10)
+            assert streams[1].outcome == "quarantined"
+            stats = eng.stats()
+        _assert_identity(stats)
+        assert stats["completed"] == 2 and stats["quarantined"] == 1
+
+    def test_evicted_block_sheds_one_sequence_retriably(self):
+        config.set_property("bigdl.chaos.evictBlockAt", 2)
+        chaos.install()
+        with _engine() as eng:
+            eng.start()
+            a = eng.submit(_prompt(4, seed=0), max_new_tokens=6)
+            b = eng.submit(_prompt(4, seed=1), max_new_tokens=6)
+            outcomes = {}
+            for s in (a, b):
+                try:
+                    s.result(timeout=10)
+                except ServingInfraError as e:
+                    assert "evicted" in str(e) and "retriable" in str(e)
+                outcomes[s.index] = s.outcome
+            assert sorted(outcomes.values()) == ["completed", "shed"]
+            victim = a if a.outcome == "shed" else b
+            assert len(victim.tokens()) >= 1     # prefix intact
+            stats = eng.stats()
+        _assert_identity(stats)
+
+    def test_hung_decode_watchdog_aborts_and_cools_down(self):
+        # 20x the ~1 ms decode EMA ≈ a 25 ms threshold: far above CI
+        # scheduling jitter, still 100x under the injected 3 s wedge
+        config.set_property("bigdl.lm.stallFactor", 20.0)
+        config.set_property("bigdl.lm.warmupSteps", 2)
+        config.set_property("bigdl.chaos.hangDecodeAt", "8:3.0")
+        chaos.install()
+        with _engine() as eng:
+            eng.start()
+            # decode steps 1..7 complete a clean stream and seed the EMA
+            assert len(eng.submit(_prompt(4), max_new_tokens=8)
+                       .result(timeout=30)) == 8
+            t0 = time.monotonic()
+            victim = eng.submit(_prompt(4, seed=1), max_new_tokens=8)
+            with pytest.raises(HungDispatchError, match="wedged past"):
+                victim.result(timeout=30)
+            assert victim.outcome == "shed"
+            assert time.monotonic() - t0 < 3.0, \
+                "the abort must land well before the 3 s wedge expires"
+            # cooldown clears once the backlog is empty; it re-serves
+            deadline = time.monotonic() + 10
+            while True:
+                try:
+                    h = eng.submit(_prompt(4, seed=2), max_new_tokens=4)
+                    break
+                except Overloaded as e:
+                    assert e.reason == "cooldown", e
+                    assert time.monotonic() < deadline
+                    time.sleep(0.02)
+            assert len(h.result(timeout=30)) == 4
+            stats = eng.stats()
+        _assert_identity(stats)
+
+    def test_abort_mid_admission_cannot_strand_a_stream(self):
+        """The watchdog abort is delivered asynchronously and can land
+        while a stream sits in ``_admitting`` — popped from the queue,
+        not yet slotted.  The shed sweep must account it (regression:
+        a stranded stream held the accounting identity open forever)."""
+        eng = _engine(warm=False)
+        s = eng.submit(_prompt(4), max_new_tokens=4)
+        eng._admitting = eng._q.get_nowait()
+        assert eng._admitting is s
+        eng._shed_active(HungDispatchError("injected mid-admission"),
+                         "hung_decode")
+        assert eng._admitting is None
+        assert s.outcome == "shed"
+        with pytest.raises(HungDispatchError):
+            s.result(timeout=1)
+        eng.close()
+        _assert_identity(eng.stats())
+
+    def test_combined_chaos_identity_exact(self):
+        """The ISSUE-18 combined plan: poison prompt + hung decode +
+        block eviction in ONE open-loop load.  Every submitted stream
+        lands in exactly one outcome bucket — including sequences that
+        streamed a prefix and then failed."""
+        config.set_property("bigdl.lm.stallFactor", 20.0)
+        config.set_property("bigdl.lm.warmupSteps", 2)
+        config.set_property("bigdl.chaos.poisonPromptAt", "2")
+        config.set_property("bigdl.chaos.evictBlockAt", 6)
+        config.set_property("bigdl.chaos.hangDecodeAt", "20:3.0")
+        chaos.install()
+        reqs = sample_lm_workload(12, VOCAB, seed=9,
+                                  prompt_lens=(4, 6, 8),
+                                  output_lens=(4, 6, 8))
+        with _engine() as eng:
+            eng.start()
+            rec = run_lm_open_loop(eng, reqs, rate_hz=200.0, seed=4)
+            stats = eng.stats()
+        _assert_identity(rec)
+        _assert_identity(stats)
+        assert rec["quarantined"] >= 1, rec
+        assert rec["shed"] >= 1, rec
+        # partially-streamed-then-failed: a shed stream keeps its prefix
+        shed = [s for _, s in rec["streams"]
+                if s is not None and s.outcome == "shed"]
+        assert any(len(s.tokens()) >= 1 for s in shed), \
+            "no shed stream retained a streamed prefix"
+        _zero_retraces(eng)
+
+
+# ---------------------------------------------------------------------------
+# int8 decode tier
+# ---------------------------------------------------------------------------
+
+class TestInt8Tier:
+    def test_gate_passes_and_serves(self):
+        eng = _engine(quantize="int8")
+        rep = eng.quantization_report
+        assert rep["audit_ok"] and rep["allclose"], rep
+        assert rep["max_abs_diff"] <= rep["atol"] + 1.0  # recorded, sane
+        eng.start()
+        s = eng.submit(_prompt(6), max_new_tokens=6)
+        assert len(s.result(timeout=30)) == 6
+        eng.close()
+        _assert_identity(eng.stats())
+        assert "lm_decode_int8" in eng.sentinels
+        _zero_retraces(eng)
+
+    def test_gate_refuses_on_drift(self):
+        config.set_property("bigdl.lm.quantizeRtol", 0.0)
+        config.set_property("bigdl.lm.quantizeAtol", 1e-9)
+        with pytest.raises(QuantizationGateError, match="drifted past"):
+            _engine(warm=False, quantize="int8")
+
+    def test_unknown_tier_is_refused(self):
+        with pytest.raises(ValueError, match="int8"):
+            _engine(warm=False, quantize="int4")
+
+
+# ---------------------------------------------------------------------------
+# lint rule: unbounded-decode-loop
+# ---------------------------------------------------------------------------
+
+class TestUnboundedDecodeLoopRule:
+    def _lint(self, tmp_path, body):
+        from bigdl_tpu.analysis.lint import lint_paths
+        d = tmp_path / "serving"
+        d.mkdir(exist_ok=True)
+        (d / "lm.py").write_text(body, encoding="utf-8")
+        return [f for f in lint_paths([str(tmp_path)])
+                if f.rule == "unbounded-decode-loop"]
+
+    def test_flags_while_true_on_the_decode_path(self, tmp_path):
+        found = self._lint(tmp_path,
+                           "def decode():\n"
+                           "    while True:\n"
+                           "        step()\n")
+        assert len(found) == 1 and found[0].line == 2
+
+    def test_flags_unbounded_condition_name(self, tmp_path):
+        found = self._lint(tmp_path,
+                           "def decode(running):\n"
+                           "    while running:\n"
+                           "        step()\n")
+        assert len(found) == 1
+
+    def test_accepts_deadline_and_terminal_bounds(self, tmp_path):
+        assert self._lint(tmp_path,
+                          "def decode(self, deadline):\n"
+                          "    while now() < deadline:\n"
+                          "        step()\n"
+                          "    while not self._terminal:\n"
+                          "        step()\n"
+                          "    for _ in range(max_new):\n"
+                          "        step()\n") == []
+
+    def test_production_lm_file_is_clean(self):
+        from bigdl_tpu.analysis.lint import lint_paths
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        target = os.path.join(repo, "bigdl_tpu", "serving", "lm.py")
+        assert [f for f in lint_paths([target])
+                if f.rule == "unbounded-decode-loop"] == []
+
+
+# ---------------------------------------------------------------------------
+# docs drift guard: bigdl.lm.* keys
+# ---------------------------------------------------------------------------
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestLMDocDrift:
+    """Every ``bigdl.lm.*`` key the code registers must have a row in
+    docs/configuration.md — and vice versa (same guard as the fleet,
+    chaos, and ingest key families)."""
+
+    _KEY = re.compile(r"bigdl\.lm\.[A-Za-z0-9]+(?:\.[A-Za-z0-9]+)*")
+
+    def _keys_in(self, *parts):
+        with open(os.path.join(_REPO, *parts), encoding="utf-8") as f:
+            return set(self._KEY.findall(f.read()))
+
+    def test_config_defaults_match_docs_both_ways(self):
+        code = self._keys_in("bigdl_tpu", "utils", "config.py")
+        docs = self._keys_in("docs", "configuration.md")
+        assert code - docs == set(), \
+            f"lm keys missing a docs row: {sorted(code - docs)}"
+        assert docs - code == set(), \
+            f"documented lm keys unknown to config.py: " \
+            f"{sorted(docs - code)}"
+
+    def test_lm_module_reads_registered_keys_only(self):
+        registered = self._keys_in("bigdl_tpu", "utils", "config.py")
+        used = (self._keys_in("bigdl_tpu", "serving", "lm.py") |
+                self._keys_in("bigdl_tpu", "serving", "kv_cache.py"))
+        assert used - registered == set(), \
+            f"lm serving reads unregistered keys: " \
+            f"{sorted(used - registered)}"

@@ -11,6 +11,7 @@ from bigdl_tpu.dataset import SampleToMiniBatch
 from bigdl_tpu.dataset.dataset import LocalDataSet, ShardedDataSet
 from bigdl_tpu.engine import Engine
 from bigdl_tpu.models.transformer import (LayerNorm, PositionalEncoding,
+                                          PositionOutOfRange,
                                           transformer_lm,
                                           transformer_lm_pipeline)
 from bigdl_tpu.models.transformer.train import VOCAB, _synthetic
@@ -278,6 +279,48 @@ def test_odd_d_model_positional_encoding():
     pe._ensure_init()
     out = np.asarray(pe.forward(np.zeros((1, 5, 7), np.float32)))
     assert out.shape == (1, 5, 7) and np.isfinite(out).all()
+
+
+class TestPositionalEncodingOffset:
+    """The decode path's position-offset contract: ``apply(offset=k)``
+    reads table rows ``k .. k+T``, out-of-range STATIC positions raise
+    the structured :class:`PositionOutOfRange` (dynamic_slice would
+    silently clamp — wrong position signal with no symptom), and
+    ``rows()`` is the per-slot decode lookup."""
+
+    def test_offset_reads_shifted_table_rows(self):
+        pe = PositionalEncoding(8, max_len=16)
+        pe._ensure_init()
+        x = np.zeros((1, 4, 8), np.float32)
+        full, _ = pe.apply(pe.params, np.zeros((1, 16, 8), np.float32),
+                           None)
+        shifted, _ = pe.apply(pe.params, x, None, offset=5)
+        np.testing.assert_array_equal(np.asarray(shifted)[0],
+                                      np.asarray(full)[0, 5:9])
+
+    def test_offset_past_capacity_raises_structured(self):
+        pe = PositionalEncoding(8, max_len=16)
+        pe._ensure_init()
+        x = np.zeros((1, 4, 8), np.float32)
+        with pytest.raises(PositionOutOfRange) as ei:
+            pe.apply(pe.params, x, None, offset=13)   # rows 13..16
+        assert ei.value.position == 16 and ei.value.max_len == 16
+        assert "max_len 16" in str(ei.value)
+
+    def test_sequence_past_capacity_raises_even_at_offset_zero(self):
+        pe = PositionalEncoding(8, max_len=8)
+        pe._ensure_init()
+        with pytest.raises(PositionOutOfRange):
+            pe.apply(pe.params, np.zeros((1, 9, 8), np.float32), None)
+
+    def test_rows_lookup_matches_table_and_range_checks(self):
+        pe = PositionalEncoding(8, max_len=16)
+        pe._ensure_init()
+        got = np.asarray(pe.rows(np.array([0, 7, 15])))
+        np.testing.assert_array_equal(got, np.asarray(pe.pe)[[0, 7, 15]])
+        with pytest.raises(PositionOutOfRange) as ei:
+            pe.rows([3, 16])
+        assert ei.value.position == 16 and ei.value.max_len == 16
 
 
 def test_sp_rejects_sequence_beyond_position_capacity():
