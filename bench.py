@@ -2734,12 +2734,158 @@ def bench_audit(out_path: str = None):
     return record
 
 
+def bench_concurrency(out_path: str = None, write: bool = True):
+    """``--concurrency-only``: the lock-witness cost leg →
+    bench_concurrency.json.
+
+    - **per-acquire microbench** — plain ``threading.Lock`` vs a factory
+      lock disarmed vs armed (strict), ns/acquire each.  Disarmed the
+      wrapper is one mode check + delegate; armed it also bumps the
+      acquisition-order bookkeeping.
+    - **mini serving leg** — a small warmed ServingEngine under the
+      armed witness; measures request p50 and reads the witness acquire
+      counter to get locks-acquired-per-request.  ASSERTS the armed
+      per-request overhead (armed-vs-plain per-acquire delta x acquires
+      per request) stays under 1%% of the serving p50, and the disarmed
+      delta under 0.1%% (within noise).
+    - **static pass wall time** — one full
+      ``analysis.concurrency.analyze`` run over the package (the
+      preflight cost a CI run pays).
+    """
+    import threading
+    import jax
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.analysis import concurrency as conc, lockwitness
+    from bigdl_tpu.serving import ServingEngine
+    from bigdl_tpu.utils import config
+
+    here = os.path.dirname(os.path.abspath(__file__))
+
+    # -- per-acquire microbench -----------------------------------------
+    reps = 200_000
+
+    def per_acquire_ns(lock) -> float:
+        t0 = time.perf_counter_ns()
+        for _ in range(reps):
+            with lock:
+                pass
+        return (time.perf_counter_ns() - t0) / reps
+
+    lockwitness.disarm()
+    lockwitness.reset()
+    factory = lockwitness.make_lock("bench.probe")
+    per_acquire_ns(threading.Lock())                   # warm the loop
+    plain_ns = per_acquire_ns(threading.Lock())
+    disarmed_ns = per_acquire_ns(factory)
+    lockwitness.arm("strict")
+    try:
+        armed_ns = per_acquire_ns(factory)
+    finally:
+        lockwitness.disarm()
+        lockwitness.reset()
+    _log(f"per-acquire: plain {plain_ns:.0f} ns, disarmed "
+         f"{disarmed_ns:.0f} ns, armed {armed_ns:.0f} ns")
+
+    # -- mini serving leg under the armed witness ------------------------
+    din, dout = 16, 8
+    config.set_property("bigdl.compile.buckets", "1,4")
+    try:
+        model = (nn.Sequential().add(nn.Linear(din, 64)).add(nn.Tanh())
+                 .add(nn.Linear(64, dout)))
+        model.reset(jax.random.PRNGKey(0))
+        eng = ServingEngine(model)
+        eng.warmup(np.zeros((din,), np.float32))
+        payload = np.zeros((din,), np.float32)
+        for _ in range(10):                            # warm the path
+            eng.submit(payload).result(timeout=10.0)
+        lockwitness.arm("strict")
+        try:
+            base = lockwitness.snapshot()["acquires"]
+            lat_ms = []
+            n_req = 200
+            for _ in range(n_req):
+                t0 = time.perf_counter_ns()
+                eng.submit(payload).result(timeout=10.0)
+                lat_ms.append((time.perf_counter_ns() - t0) / 1e6)
+            acquires_per_req = (lockwitness.snapshot()["acquires"] -
+                                base) / n_req
+            violations = lockwitness.snapshot()["violations"]
+        finally:
+            lockwitness.disarm()
+            lockwitness.reset()
+        eng.stop()
+    finally:
+        config.clear_property("bigdl.compile.buckets")
+    p50_ms = float(np.percentile(lat_ms, 50))
+    # the robust overhead estimate: measured per-acquire delta x the
+    # measured acquire count, against the measured p50 — two back-to-back
+    # p50 measurements differ by more than 1% on a loaded CI box, the
+    # microbench delta does not
+    armed_pct = (armed_ns - plain_ns) * acquires_per_req / (p50_ms * 1e6) \
+        * 100
+    disarmed_pct = max(0.0, disarmed_ns - plain_ns) * acquires_per_req / \
+        (p50_ms * 1e6) * 100
+    _log(f"serving p50 {p50_ms:.3f} ms, {acquires_per_req:.1f} witnessed "
+         f"acquires/request: armed overhead {armed_pct:.4f}% of p50, "
+         f"disarmed {disarmed_pct:.4f}%")
+
+    # -- static pass wall time -------------------------------------------
+    pkg = os.path.join(here, "bigdl_tpu")
+    t0 = time.perf_counter()
+    static_findings = conc.analyze([pkg])
+    static_s = time.perf_counter() - t0
+    _log(f"static concurrency pass: {static_s:.2f} s, "
+         f"{len(static_findings)} finding(s)")
+
+    record = {
+        "per_acquire_ns": {
+            "plain": round(plain_ns, 1),
+            "disarmed": round(disarmed_ns, 1),
+            "armed": round(armed_ns, 1),
+        },
+        "serving": {
+            "p50_ms": round(p50_ms, 4),
+            "acquires_per_request": round(acquires_per_req, 1),
+            "armed_overhead_pct_of_p50": round(armed_pct, 4),
+            "disarmed_overhead_pct_of_p50": round(disarmed_pct, 4),
+            "violations": violations,
+        },
+        "static_pass": {
+            "wall_s": round(static_s, 3),
+            "findings": len(static_findings),
+        },
+        "note": "armed overhead = (armed-plain per-acquire delta) x "
+                "measured acquires/request vs measured serving p50; the "
+                "witness must ride along every tier-1 test for <1% of "
+                "request latency",
+    }
+    if write:
+        out_path = out_path or os.path.join(here, "bench_concurrency.json")
+        with open(out_path, "w") as f:
+            json.dump(record, f, indent=1)
+        _log(f"concurrency record -> {out_path}")
+    assert violations == 0, \
+        f"lock witness saw {violations} order violation(s) in the bench leg"
+    assert armed_pct < 1.0, \
+        f"armed lock-witness overhead {armed_pct:.3f}% of serving p50 " \
+        f"breaches the 1% rideshare budget"
+    assert disarmed_pct < 0.25, \
+        f"disarmed factory-lock overhead {disarmed_pct:.3f}% of serving " \
+        f"p50 — the disarmed wrapper must be free within noise"
+    assert not static_findings, \
+        "static concurrency pass found unsuppressed findings:\n" + \
+        "\n".join(str(f) for f in static_findings)
+    return record
+
+
 def preflight() -> int:
     """Static preflight: lint the package (host-sync/dtype/exception/lock
-    rules), verify the native pipeline build, and run the offline HLO
-    audit over a freshly-populated probe compile cache — a broken tree,
-    a missing native symbol, or a fused step breaking its program
-    contract fails here, before any real device time is spent."""
+    rules), verify the native pipeline build, run the whole-package
+    static concurrency pass (lock-order graph + guarded-by contract),
+    and run the offline HLO audit over a freshly-populated probe compile
+    cache — a broken tree, a missing native symbol, or a fused step
+    breaking its program contract fails here, before any real device
+    time is spent."""
     from bigdl_tpu.analysis.lint import DEFAULT_ALLOWLIST, lint_paths, \
         load_allowlist
     pkg = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -2750,6 +2896,16 @@ def preflight() -> int:
     rc = 1 if findings else 0
     _log(f"preflight: lint {'FAILED' if findings else 'OK'} "
          f"({len(findings)} finding(s))")
+    # whole-package static concurrency pass: lock-order inversions,
+    # guarded-by contract, async-abort safety
+    from bigdl_tpu.analysis import concurrency as _conc
+    conc_findings = _conc.analyze([pkg])
+    for f in conc_findings:
+        _log(str(f))
+    _log(f"preflight: concurrency {'FAILED' if conc_findings else 'OK'} "
+         f"({len(conc_findings)} finding(s))")
+    if conc_findings:
+        rc = 1
     try:
         from bigdl_tpu.dataset import native
         native.check_build()
@@ -2875,6 +3031,12 @@ def main():
                          "latency for one injected bit flip -> "
                          "bench_integrity.json (virtual 8-device CPU "
                          "mesh)")
+    ap.add_argument("--concurrency-only", action="store_true",
+                    help="lock-witness cost leg: per-acquire ns "
+                         "plain/disarmed/armed, mini serving p50 under "
+                         "the armed witness (<1%% overhead asserted, "
+                         "disarmed within noise), static concurrency-"
+                         "pass wall time -> bench_concurrency.json")
     ap.add_argument("--resources-only", action="store_true",
                     help="resource-exhaustion resilience leg: HBM "
                          "preflight cost (<1%% of step p50 asserted), "
@@ -2997,6 +3159,11 @@ def main():
     if args.telemetry_only:
         rec = bench_telemetry(steps=max(args.steps, 25))
         print(json.dumps({k: rec[k] for k in ("metric", "value", "unit")}))
+        return
+
+    if args.concurrency_only:
+        rec = bench_concurrency()
+        print(json.dumps(rec["serving"]))
         return
 
     if args.resources_only:

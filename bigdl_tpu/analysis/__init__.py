@@ -34,6 +34,16 @@ post-mortem archaeology:
    passes over every fused step's lowered StableHLO at compile/cache-
    load time: collective contract checker, precision-drift pass, and
    memory/layout budgets.  Modes under ``bigdl.audit.*``.
+6. **Concurrency pass** (:mod:`~bigdl_tpu.analysis.concurrency` +
+   :mod:`~bigdl_tpu.analysis.lockwitness`,
+   ``python -m bigdl_tpu.analysis.concurrency bigdl_tpu``) — the
+   static leg inventories thread roots and locks, builds the package-
+   wide lock-acquisition-order graph, and enforces the
+   ``# guarded-by:`` and async-abort disciplines; the runtime leg is
+   the lock factory (:func:`make_lock` / :func:`make_rlock` /
+   :func:`make_condition`) whose witness raises a structured
+   :class:`LockOrderViolation` on any acquisition-order cycle —
+   armed strict for every tier-1 test (``bigdl.analysis.lockWitness``).
 
 Modes per pass (``bigdl.analysis.*`` in ``utils/config.py``): ``strict``
 (raise), ``warn`` (log + count), ``off``.
@@ -70,6 +80,9 @@ from bigdl_tpu.analysis.program_contracts import (CollectiveBound,  # noqa: E402
                                                   ProgramContractError,
                                                   ProgramContractViolation,
                                                   StepContract)
+from bigdl_tpu.analysis.lockwitness import (LockOrderViolation,  # noqa: E402
+                                            make_condition, make_lock,
+                                            make_rlock)
 
 __all__ = [
     "pass_mode",
@@ -78,4 +91,5 @@ __all__ = [
     "ContractError", "ContractReport", "ModuleContract", "check_model",
     "CollectiveBound", "ProgramContractError", "ProgramContractViolation",
     "StepContract",
+    "LockOrderViolation", "make_lock", "make_rlock", "make_condition",
 ]

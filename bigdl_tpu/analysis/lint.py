@@ -260,6 +260,13 @@ HOST_AUGMENT_CV2 = {"resize", "flip", "warpAffine", "warpPerspective",
 HOST_AUGMENT_NP = {"flip", "fliplr", "flipud", "rot90"}
 HOST_AUGMENT_METHODS = {"crop"}         # PIL Image.crop
 
+#: directories whose locks stay raw by design: the witness itself and the
+#: telemetry it reports through must not route their own locks back into
+#: the witness (self-observation cycle / import cycle with analysis)
+RAW_LOCK_EXEMPT_DIRS = (os.path.join("analysis", ""),
+                        os.path.join("telemetry", ""))
+LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
 _ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([a-z0-9_,\- ]+)\)")
 
 #: every rule the linter can emit — the CLI validates --rule against it
@@ -270,7 +277,7 @@ KNOWN_RULES = frozenset({
     "unbounded-queue-in-serving", "unbounded-decode-loop",
     "unaccounted-buffer-in-stage",
     "host-augment-in-hot-path", "unsupervised-thread-in-fleet",
-    "bare-except", "swallowed-exception",
+    "bare-except", "swallowed-exception", "raw-lock-in-threaded-module",
     "blocking-under-lock", "lock-order", "syntax",
 })
 
@@ -801,6 +808,34 @@ def _rule_fleet_thread(path: str, rel: str, tree: ast.AST) -> List[Finding]:
     return out
 
 
+def _rule_raw_lock(path: str, rel: str, tree: ast.AST) -> List[Finding]:
+    """Direct ``threading.Lock()``/``RLock()``/``Condition()`` construction
+    anywhere in the package: every lock must come from
+    ``analysis.make_lock``/``make_rlock``/``make_condition`` so the runtime
+    lock witness sees a stable name for it.  A raw lock is invisible to the
+    acquisition-order graph — a deadlock through it is a deadlock the
+    witness can never report.  ``analysis/`` and ``telemetry/`` are exempt
+    by design (the witness's own bookkeeping locks, and the telemetry it
+    reports through, must not feed back into the witness)."""
+    if any(d in rel for d in RAW_LOCK_EXEMPT_DIRS):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if name not in LOCK_CTORS:
+            continue
+        if _qualifier(node) != "threading":
+            continue
+        out.append(Finding(
+            rel, node.lineno, "raw-lock-in-threaded-module",
+            f"raw threading.{name}() — route it through analysis.make_"
+            f"{name.lower()}(name) so the runtime lock witness can track "
+            "its acquisition order"))
+    return out
+
+
 def _rule_exceptions(path: str, rel: str, tree: ast.AST) -> List[Finding]:
     out: List[Finding] = []
     threaded = any(rel.endswith(t) for t in THREADED_FILES)
@@ -1015,6 +1050,7 @@ def lint_paths(targets: Sequence[str],
                          _rule_unaccounted_buffer(path, rel, tree) +
                          _rule_host_augment(path, rel, tree) +
                          _rule_fleet_thread(path, rel, tree) +
+                         _rule_raw_lock(path, rel, tree) +
                          _rule_exceptions(path, rel, tree))
         if any(rel.endswith(t) for t in THREADED_FILES):
             lv = _LockVisitor(rel)

@@ -45,14 +45,13 @@ from __future__ import annotations
 
 import json
 import struct
-import threading
 import weakref
 import zlib
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from bigdl_tpu import telemetry
+from bigdl_tpu import analysis, telemetry
 from bigdl_tpu.resources import GOVERNOR as _governor
 from bigdl_tpu.utils import file_io
 
@@ -105,7 +104,7 @@ class DecodedEpochCache:
         self.cache_dir = cache_dir
         self.budget_bytes = max(0, int(budget_mb)) * (1 << 20)
         self.segment_records = max(1, int(segment_records))
-        self._lock = threading.Lock()
+        self._lock = analysis.make_lock("epoch_cache")
         #: key -> (segment_id, slot); dropped entries mean "not cached"
         self._index: Dict[str, Tuple[int, int]] = {}
         #: sealed RAM segments + the open one, oldest-first insertion

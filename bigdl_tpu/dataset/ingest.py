@@ -97,6 +97,7 @@ from typing import Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 
 from bigdl_tpu import telemetry
+from bigdl_tpu import analysis
 from bigdl_tpu.dataset.transformer import Transformer
 from bigdl_tpu.resources import GOVERNOR as _governor
 from bigdl_tpu.resources import item_nbytes as _item_nbytes
@@ -109,7 +110,7 @@ _LIVE: "weakref.WeakSet" = weakref.WeakSet()
 _END = object()          # upstream exhausted
 _NO_ITEM = object()      # try_get on an empty ring
 
-_NAME_LOCK = threading.Lock()
+_NAME_LOCK = analysis.make_lock("ingest.name")
 _NAME_SEQ = [0]          # per-process engine naming (ingest0, ingest1, …)
 
 
@@ -187,7 +188,7 @@ class RecordQuarantine:
         self.count = 0
         self.by_stage: dict = {}
         self.samples: List[dict] = []
-        self._lock = threading.Lock()
+        self._lock = analysis.make_lock("ingest.quarantine")
 
     def admit(self, stage: str, index: Optional[int], name: Optional[str],
               error: BaseException) -> None:
@@ -266,13 +267,13 @@ class _StageSupervisor:
         self._run_stats = run_stats or {}
         self._rings = list(rings)
         self._stages: dict = {}
-        self.failure: Optional[BaseException] = None
+        self.failure: Optional[BaseException] = None   # guarded-by: _lock
         self.failed = threading.Event()
         self.consumer_waiting_since: Optional[float] = None
         self._last_items = -1
         self._last_items_at: Optional[float] = None
-        self.restarts = 0
-        self._lock = threading.Lock()
+        self.restarts = 0                              # guarded-by: _lock
+        self._lock = analysis.make_lock("ingest.supervisor")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -417,7 +418,7 @@ class StageStats:
 
     def __init__(self, name: str):
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = analysis.make_lock("ingest.stage_stats")
         self.items = 0
         self.busy_s = 0.0
         self.starve_s = 0.0
@@ -489,7 +490,8 @@ class _Ring:
         #: progress heartbeat: monotonic time of the last successful
         #: put/get — the stage supervisor's wedged-handoff signal and
         #: the watchdog's stall diagnostic (ring age)
-        self.last_progress = time.monotonic()
+        self._hb_lock = analysis.make_lock("ingest.ring")
+        self.last_progress = time.monotonic()    # guarded-by: _hb_lock
 
     def shrink(self) -> int:
         """Halve the dynamic depth (floor 1); returns the new limit."""
@@ -525,10 +527,12 @@ class _Ring:
                 if t0 is None:
                     t0 = time.monotonic()
                 continue
-            self.last_progress = time.monotonic()
+            with self._hb_lock:
+                self.last_progress = time.monotonic()
             self._charge(item, +1)
             if t0 is not None and self._producer is not None:
-                self._producer.add(backpressure_s=time.monotonic() - t0)
+                # StageStats is internally locked: .add() is thread-safe
+                self._producer.add(backpressure_s=time.monotonic() - t0)  # lint: allow(missing-guarded-by)
             if self._producer is not None:
                 self._producer.sample_occupancy(self.q.qsize())
             return True
@@ -541,10 +545,12 @@ class _Ring:
         while stop is None or not stop.is_set():
             try:
                 item = self.q.get(timeout=0.05)
-                self.last_progress = time.monotonic()
+                with self._hb_lock:
+                    self.last_progress = time.monotonic()
                 self._charge(item, -1)
                 if t0 is not None and self._consumer is not None:
-                    self._consumer.add(starve_s=time.monotonic() - t0)
+                    # StageStats is internally locked: .add() is thread-safe
+                    self._consumer.add(starve_s=time.monotonic() - t0)  # lint: allow(missing-guarded-by)
                 return item
             except queue.Empty:
                 if t0 is None:
@@ -594,11 +600,11 @@ class _DecodePool:
     def __init__(self, workers: int, thread_name_prefix: str = "decode"):
         self._tickets: "queue.Queue" = queue.Queue()
         self._prefix = thread_name_prefix
-        self._lock = threading.Lock()
-        self._target = max(1, int(workers))
-        self._alive = 0
-        self._seq = 0
-        self._shutdown = False
+        self._lock = analysis.make_lock("ingest.decode_pool")
+        self._target = max(1, int(workers))     # guarded-by: _lock
+        self._alive = 0                         # guarded-by: _lock
+        self._seq = 0                           # guarded-by: _lock
+        self._shutdown = False                  # guarded-by: _lock
         for _ in range(self._target):
             self._spawn()
 

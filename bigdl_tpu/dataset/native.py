@@ -14,14 +14,15 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
 from typing import Optional
+
+from bigdl_tpu import analysis
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libbigdl_native.so")
 
-_lock = threading.Lock()
+_lock = analysis.make_lock("native.build")
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 

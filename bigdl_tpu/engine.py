@@ -23,7 +23,7 @@ from typing import Optional, Sequence
 import jax
 import numpy as np
 
-from bigdl_tpu import telemetry
+from bigdl_tpu import analysis, telemetry
 from bigdl_tpu.resources import GOVERNOR as _resource_governor
 
 
@@ -140,12 +140,15 @@ class BatchPrefetcher:
             else config.get_int("bigdl.ingest.batchesInFlight", 2))
         self._fetch = fetch
         self._on_batch = on_batch
-        # transfer-stage counters (ns, GIL-atomic adds): how long the
-        # pipeline spent blocking uploads device-resident vs fetching —
-        # surfaced by bench.py and the driver's end-of-run metrics
-        self.fetch_ns = 0
-        self.block_ns = 0
-        self.batches = 0
+        # transfer-stage counters: how long the pipeline spent blocking
+        # uploads device-resident vs fetching — surfaced by bench.py and
+        # the driver's end-of-run metrics.  Written from the fetch AND
+        # transfer producers AND the passthrough (depth 0) caller, so
+        # they share a stats lock
+        self._stats_lock = analysis.make_lock("engine.prefetch")
+        self.fetch_ns = 0            # guarded-by: _stats_lock
+        self.block_ns = 0            # guarded-by: _stats_lock
+        self.batches = 0             # guarded-by: _stats_lock
         # transfer-ahead slot accounting: every batch sitting in the
         # prefetch rings (fetched but not yet consumed) charges its host
         # bytes to the governor — the read-ahead depth is exactly the
@@ -161,7 +164,7 @@ class BatchPrefetcher:
         #: abandoned mid-stream (never raised at a call site) — the
         #: original error must survive the teardown, not vanish with
         #: the drained queues
-        self.error: Optional[BaseException] = None
+        self.error: Optional[BaseException] = None   # guarded-by: _stats_lock
         if self.depth <= 0:
             return
         self._q: "queue.Queue" = queue.Queue(maxsize=self.depth)
@@ -202,7 +205,8 @@ class BatchPrefetcher:
                 if hasattr(leaf, "block_until_ready"):
                     leaf.block_until_ready()
             t1 = telemetry.clock_ns()
-            self.block_ns += t1 - t0
+            with self._stats_lock:
+                self.block_ns += t1 - t0
             telemetry.add_span("prefetch/transfer", t0, t1,
                                {"bytes": total})
         return batch
@@ -217,8 +221,9 @@ class BatchPrefetcher:
         if self._on_batch is not None:
             self._on_batch(batch)
         t1 = telemetry.clock_ns()
-        self.fetch_ns += t1 - t0
-        self.batches += 1
+        with self._stats_lock:
+            self.fetch_ns += t1 - t0
+            self.batches += 1
         telemetry.add_span("prefetch/fetch", t0, t1)
         if block:
             self._block_ready(batch)
@@ -291,9 +296,11 @@ class BatchPrefetcher:
         downstream: an ERROR item dropped here would vanish — the one
         window stop()'s post-join queue drain cannot see — so park it on
         ``self.error`` directly (threads are joined before the drain
-        reads it)."""
-        if item[0] is not None and self.error is None:
-            self.error = item[0]
+        reads it).  First error wins — atomically, since both producer
+        threads and a stopping consumer can race here."""
+        with self._stats_lock:
+            if item[0] is not None and self.error is None:
+                self.error = item[0]
 
     def _discard(self, item) -> None:
         """An item dropped without ever reaching the consumer: release
@@ -336,8 +343,7 @@ class BatchPrefetcher:
                     break
                 if batch is not None:
                     self._slot_acct.sub(self._slot_nbytes(batch))
-                if err is not None and self.error is None:
-                    self.error = err
+                self._stash_error((err, None))
 
 
 class _EngineState:
@@ -348,7 +354,7 @@ class _EngineState:
         self.inited: bool = False
         self.seed: int = 0
         self._mesh = None
-        self._lock = threading.RLock()
+        self._lock = analysis.make_rlock("engine.state")
 
 
 _STATE = _EngineState()

@@ -37,6 +37,7 @@ from bigdl_tpu.fleet.autoscale import FleetAutoscalePolicy
 from bigdl_tpu.fleet.replica import Replica
 from bigdl_tpu.fleet.rollout import RolloutReport, run_rollout
 from bigdl_tpu.fleet.supervisor import FleetSupervisor
+from bigdl_tpu import analysis
 from bigdl_tpu.resources import GOVERNOR
 from bigdl_tpu.serving.engine import (OUTCOMES, Overloaded, RequestHandle,
                                       ServingInfraError)
@@ -57,36 +58,36 @@ class _Service:
         self.model = model
         self.warm_row = warm_row
         self.engine_kw = dict(engine_kw or {})
-        self._version_seq = 1
-        self.version = "v1"
-        self._lock = threading.Lock()
-        self._rollout_lock = threading.Lock()
-        self._slot_seq = 0
-        self._active: List[Replica] = []
+        self._version_seq = 1        # guarded-by: _lock
+        self.version = "v1"          # guarded-by: _lock
+        self._lock = analysis.make_lock("fleet.service")
+        self._rollout_lock = analysis.make_lock("fleet.rollout")
+        self._slot_seq = 0           # guarded-by: _lock
+        self._active: List[Replica] = []      # guarded-by: _lock
         #: (handle, replica) for every admitted request not yet tallied
-        self._pending: List[Tuple[RequestHandle, Replica]] = []
-        self._counts: Dict[str, int] = dict.fromkeys(OUTCOMES, 0)
+        self._pending: List[Tuple[RequestHandle, Replica]] = []  # guarded-by: _lock
+        self._counts: Dict[str, int] = dict.fromkeys(OUTCOMES, 0)  # guarded-by: _lock
         self._counts["submitted"] = 0
-        self._rr = 0
-        self._restarts: Dict[int, int] = {}
-        self.draining = False
+        self._rr = 0                 # guarded-by: _lock
+        self._restarts: Dict[int, int] = {}   # guarded-by: _lock
+        self.draining = False        # guarded-by: _lock
         shadow_n = max(1, config.get_int("bigdl.fleet.shadowSample", 8))
         #: recently COMPLETED (decoded payload, output) pairs — the
         #: rollout's shadow-traffic source.  Bounded: parity needs a
         #: sample, not a replay log.
-        self.shadow: "deque[Tuple[Any, Any]]" = deque(maxlen=shadow_n)
-        self._cut_ns: Optional[int] = None
-        self._cut_version: Optional[str] = None
+        self.shadow: "deque[Tuple[Any, Any]]" = deque(maxlen=shadow_n)  # guarded-by: _lock
+        self._cut_ns: Optional[int] = None            # guarded-by: _lock
+        self._cut_version: Optional[str] = None       # guarded-by: _lock
         #: cutover -> first completed request on the new replica set
-        self.last_swap_to_serve_ms: Optional[float] = None
-        self.last_promotion: Optional[RolloutReport] = None
+        self.last_swap_to_serve_ms: Optional[float] = None  # guarded-by: _lock
+        self.last_promotion: Optional[RolloutReport] = None  # guarded-by: _lock
         self._watch_mgr = None
-        self._promo_tick = 0
+        self._promo_tick = 0         # guarded-by: _lock
         self._promo_interval = config.get_float(
             "bigdl.fleet.promotionPollSec", 0.2)
-        self._last_promoted = -1
-        self._promo_attempted = -1
-        self._as_tick = 0
+        self._last_promoted = -1     # guarded-by: _lock
+        self._promo_attempted = -1   # guarded-by: _lock
+        self._as_tick = 0            # guarded-by: _lock
         self._as_interval = config.get_float(
             "bigdl.fleet.autoscale.intervalSec", 0.25)
         self._policy = FleetAutoscalePolicy(
@@ -249,14 +250,15 @@ class _Service:
         for rep in self.active_replicas():
             if not rep.crashed():
                 continue
-            rep.retired = True          # out of the router either way
-            rep.engine.stop(0.0)        # finalize: sweep engine leftovers
+            # zero-grace retire: out of the router either way, and the
+            # engine's own stop path sweeps its leftovers
+            rep.retire(0.0)
             with self._lock:
                 try:
                     self._active.remove(rep)
                 except ValueError:
                     continue            # a rollout already swapped it out
-            used = self._restarts.get(rep.slot, 0)
+                used = self._restarts.get(rep.slot, 0)
             if used >= max_restarts:
                 telemetry.counter("Fleet/replica_abandoned",
                                   labels={"service": self.name}).inc()
@@ -266,7 +268,8 @@ class _Service:
                     max_restarts)
                 self._publish_replica_gauge()
                 continue
-            self._restarts[rep.slot] = used + 1
+            with self._lock:
+                self._restarts[rep.slot] = used + 1
             telemetry.counter("Fleet/replica_restarts",
                               labels={"service": self.name}).inc()
             logger.warning(
@@ -297,9 +300,11 @@ class _Service:
     def autoscale_tick(self, poll_interval: float) -> None:
         if not config.get_bool("bigdl.fleet.autoscale.enabled", False):
             return
-        self._as_tick += 1
+        with self._lock:
+            self._as_tick += 1
+            tick = self._as_tick
         every = max(1, int(round(self._as_interval / poll_interval)))
-        if self._as_tick % every:
+        if tick % every:
             return
         reps = [r for r in self.active_replicas() if r.healthy()]
         if not reps:
@@ -346,9 +351,11 @@ class _Service:
         incumbent keeps serving throughout."""
         if self._watch_mgr is None:
             return
-        self._promo_tick += 1
+        with self._lock:
+            self._promo_tick += 1
+            tick = self._promo_tick
         every = max(1, int(round(self._promo_interval / poll_interval)))
-        if self._promo_tick % every:
+        if tick % every:
             return
         try:
             newest = self._watch_mgr.watch_latest()
@@ -359,7 +366,8 @@ class _Service:
         if (newest is None or newest <= self._last_promoted or
                 newest == self._promo_attempted):
             return
-        self._promo_attempted = newest
+        with self._lock:
+            self._promo_attempted = newest
         loaded = None
         try:
             loaded = self._watch_mgr.load_latest()
@@ -372,9 +380,11 @@ class _Service:
             return
         model, _optim, n = loaded
         report = run_rollout(self, model)
-        self.last_promotion = report
+        with self._lock:
+            self.last_promotion = report
         if report.promoted:
-            self._last_promoted = max(n, newest)
+            with self._lock:
+                self._last_promoted = max(n, newest)
             telemetry.counter("Fleet/promotions",
                               labels={"service": self.name}).inc()
             logger.info("fleet %s: snapshot %d promoted to %s",
@@ -386,7 +396,8 @@ class _Service:
     # -- teardown / introspection -----------------------------------------
 
     def drain_all(self, grace: float) -> None:
-        self.draining = True
+        with self._lock:
+            self.draining = True
         for rep in self.active_replicas():
             rep.retire(grace)
 
@@ -395,14 +406,18 @@ class _Service:
             out: Dict[str, Any] = dict(self._counts)
             pending = len(self._pending)
             replicas = len(self._active)
+            version = self.version
+            draining = self.draining
+            restarts = sum(self._restarts.values())
+            swap_ms = self.last_swap_to_serve_ms
         out["unaccounted"] = out["submitted"] - sum(
             out[o] for o in OUTCOMES)
         out["pending"] = pending
         out["replicas"] = replicas
-        out["version"] = self.version
-        out["draining"] = self.draining
-        out["restarts"] = sum(self._restarts.values())
-        out["last_swap_to_serve_ms"] = self.last_swap_to_serve_ms
+        out["version"] = version
+        out["draining"] = draining
+        out["restarts"] = restarts
+        out["last_swap_to_serve_ms"] = swap_ms
         return out
 
 
@@ -431,7 +446,7 @@ class Fleet:
             grace_period if grace_period is not None else
             config.get_float("bigdl.fleet.gracePeriod", 5.0))
         self._services: Dict[str, _Service] = {}
-        self._seq_lock = threading.Lock()
+        self._seq_lock = analysis.make_lock("fleet.seq")
         self._submit_seq = 0
         self._closed = False
         self._preempt_seen = False
@@ -519,7 +534,7 @@ class Fleet:
 
     # -- supervision tick --------------------------------------------------
 
-    def _tick(self) -> None:
+    def _tick(self) -> None:    # thread-root: fleet-supervisor monitor
         preempted = elastic.preemption_requested()
         if preempted and not self._preempt_seen:
             self._preempt_seen = True
@@ -527,7 +542,8 @@ class Fleet:
                            "draining (replicas self-drain, rollouts "
                            "abort)")
             for svc in list(self._services.values()):
-                svc.draining = True
+                with svc._lock:
+                    svc.draining = True
         for svc in list(self._services.values()):
             svc.sweep()
             if not preempted and not svc.draining:

@@ -23,7 +23,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
-from bigdl_tpu import telemetry
+from bigdl_tpu import analysis, telemetry
 from bigdl_tpu.serving.engine import ServingEngine
 from bigdl_tpu.utils import elastic
 
@@ -48,7 +48,8 @@ class Replica:
         self.service = service
         self.slot = slot
         self.version = version
-        self.retired = False
+        self._lock = analysis.make_lock("fleet.replica")
+        self.retired = False         # guarded-by: _lock
         self.engine = ServingEngine(model, **(engine_kw or {}))
         if warm_row is not None:
             # AOT-warm every configured bucket BEFORE the replica takes
@@ -69,11 +70,16 @@ class Replica:
         """Died WITHOUT an orderly drain — the restart signal."""
         return not self.retired and self.engine.crashed()
 
-    def retire(self, grace: Optional[float] = None) -> None:
+    def retire(self, grace: Optional[float] = None  # thread-root: also entered from the fleet supervisor (check_restarts / autoscale down / drain_all)
+               ) -> None:
         """Deliberate end of life: out of rotation first (the flag), then
         the engine's graceful drain.  Idempotent, like the stop contract
-        it rides on."""
-        self.retired = True
+        it rides on.  Entered from BOTH the user thread (fleet stop,
+        rollout drain) and the supervisor (crash replacement, autoscale
+        down) — the lifecycle lock makes the flag flip a clean
+        happens-before edge for ``healthy()`` routers."""
+        with self._lock:
+            self.retired = True
         self.engine.stop(grace)
 
     def kill(self) -> bool:
@@ -87,7 +93,11 @@ class Replica:
         tid = self.engine.batcher_ident()
         if tid is None or not self.engine.batcher_alive():
             return False
-        delivered = elastic._async_raise(tid, ReplicaKilled)
+        # deliberately NO completion re-check under the engine lock: this
+        # injection MODELS the stray abort the async-abort-unguarded rule
+        # exists to prevent — the supervisor's sweep is the system under
+        # test
+        delivered = elastic._async_raise(tid, ReplicaKilled)  # lint: allow(async-abort-unguarded)
         if delivered:
             telemetry.counter("Fleet/replica_kills",
                               labels={"service": self.service}).inc()

@@ -24,7 +24,7 @@ import logging
 import threading
 from typing import Callable, List, Optional
 
-from bigdl_tpu import telemetry
+from bigdl_tpu import analysis, telemetry
 from bigdl_tpu.utils import config
 
 logger = logging.getLogger("bigdl_tpu")
@@ -41,8 +41,8 @@ class FleetSupervisor:
             config.get_float("bigdl.fleet.pollInterval", 0.05))
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._spawned: List[threading.Thread] = []
-        self._lock = threading.Lock()
+        self._spawned: List[threading.Thread] = []   # guarded-by: _lock
+        self._lock = analysis.make_lock("fleet.supervisor")
         self.tick_errors = 0
         self.ticks = 0
 

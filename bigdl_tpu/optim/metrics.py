@@ -15,8 +15,9 @@ needs host numbers.
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, List, Tuple, Union
+
+from bigdl_tpu import analysis
 
 
 def _is_device_value(v) -> bool:
@@ -30,13 +31,13 @@ class Metrics:
         self._scalar: Dict[str, Tuple[float, int]] = {}   # value, parallelism
         self._lists: Dict[str, List[float]] = {}
         self._pending: Dict[str, list] = {}   # device scalars, not yet pulled
-        self._lock = threading.Lock()
+        self._lock = analysis.make_lock("metrics.optim")
         # serializes flushes and resets: the blocking device pull happens
         # outside _lock (a reader must not stall hot-loop adds for a device
         # round-trip), so without this a set() could slip between a flush's
         # swap-out and fold-in and have pre-reset values folded on top of
         # it, and a second reader could observe the transient gap
-        self._flush_lock = threading.Lock()
+        self._flush_lock = analysis.make_lock("metrics.flush")
 
     def set(self, name: str, value: Union[float, List[float]],
             parallelism: int = 1) -> None:

@@ -65,7 +65,8 @@ class HostMemoryGovernor:
     reaction (shrinkers) and the hard escalation (HostMemoryError)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        from bigdl_tpu import analysis
+        self._lock = analysis.make_lock("governor.host")
         self._accounts: Dict[str, Account] = {}
         self._shrinkers: Dict[str, Callable[[], None]] = {}
         self._polls = 0
@@ -80,8 +81,12 @@ class HostMemoryGovernor:
         with self._lock:
             acct = self._accounts.get(name)
             if acct is None:
+                from bigdl_tpu import analysis
+                # every Account shares one witness name: account locks are
+                # leaves (never nested), so collapsing them keeps the
+                # order graph small without losing real edges
                 acct = self._accounts[name] = Account(
-                    name, threading.Lock())
+                    name, analysis.make_lock("governor.account"))
         return acct
 
     def total_bytes(self) -> int:

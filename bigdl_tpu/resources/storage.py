@@ -26,15 +26,15 @@ from __future__ import annotations
 
 import logging
 import os
-import threading
 from typing import Callable, Dict, List, Optional
 
+from bigdl_tpu import analysis as _analysis
 from bigdl_tpu.resources.errors import (StorageExhaustedError,
                                         is_storage_exhausted)
 
 logger = logging.getLogger("bigdl_tpu")
 
-_lock = threading.Lock()
+_lock = _analysis.make_lock("storage.degraded")
 _degraded: Dict[str, str] = {}          # component -> first error message
 _timeline_dumps: List[str] = []         # dump paths, oldest first
 

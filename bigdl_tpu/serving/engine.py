@@ -47,7 +47,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from bigdl_tpu import telemetry
+from bigdl_tpu import analysis, telemetry
 from bigdl_tpu.resources import GOVERNOR as _resource_governor
 from bigdl_tpu.resources import item_nbytes as _item_nbytes
 from bigdl_tpu.utils import elastic
@@ -146,29 +146,35 @@ class RequestHandle:
     racing dispatch)."""
 
     __slots__ = ("raw", "index", "submit_ns", "deadline_ns", "finish_ns",
-                 "outcome", "_result", "_error", "_done", "payload_nbytes")
+                 "outcome", "_result", "_error", "_done", "payload_nbytes",
+                 "_lock")
 
     def __init__(self, raw, index: int, submit_ns: int, deadline_ns: int):
         self.raw = raw
         self.index = index            # admission position (chaos plans key on it)
         self.submit_ns = submit_ns
         self.deadline_ns = deadline_ns
-        self.payload_nbytes = 0       # host bytes charged to the governor
-        self.finish_ns: Optional[int] = None
-        self.outcome: Optional[str] = None
-        self._result = None
-        self._error: Optional[BaseException] = None
+        self._lock = analysis.make_lock("serving.handle")
+        self.payload_nbytes = 0       # guarded-by: _lock — host bytes charged to the governor
+        self.finish_ns: Optional[int] = None            # guarded-by: _lock
+        self.outcome: Optional[str] = None              # guarded-by: _lock
+        self._result = None                             # guarded-by: _lock
+        self._error: Optional[BaseException] = None     # guarded-by: _lock
         self._done = threading.Event()
 
     def _finish(self, outcome: str, result=None,
                 error: Optional[BaseException] = None) -> bool:
-        if self._done.is_set():
-            return False
-        self.outcome = outcome
-        self._result = result
-        self._error = error
-        self.finish_ns = telemetry.clock_ns()
-        self._done.set()
+        # first-wins must be ATOMIC: the engine's dispatch completion and
+        # a supervisor's abandon() race here from different threads, and
+        # a bare Event check would let both pass the gate and double-count
+        with self._lock:
+            if self._done.is_set():
+                return False
+            self.outcome = outcome
+            self._result = result
+            self._error = error
+            self.finish_ns = telemetry.clock_ns()
+            self._done.set()
         return True
 
     def latency_ms(self) -> Optional[float]:
@@ -207,10 +213,11 @@ class RequestHandle:
             "request abandoned by its supervisor — retriable")
         if not self._finish("shed", error=err):
             return False
-        if self.payload_nbytes:
-            _resource_governor.account("serving_admission").sub(
-                self.payload_nbytes)
+        with self._lock:
+            nbytes = self.payload_nbytes
             self.payload_nbytes = 0
+        if nbytes:
+            _resource_governor.account("serving_admission").sub(nbytes)
         telemetry.counter("Serving/shed").inc()
         telemetry.counter("Serving/shed", labels={"reason": reason}).inc()
         return True
@@ -287,20 +294,20 @@ class ServingEngine:
         # the admission queue IS the bound: put_nowait + Full -> Overloaded
         self._q: "queue.Queue[RequestHandle]" = queue.Queue(
             maxsize=self.max_queue_depth)
-        self._lock = threading.Lock()
+        self._lock = analysis.make_lock("serving.engine")
         # queued + in-flight payload bytes, rolled into Resources/host_bytes
         self._payload_acct = _resource_governor.account("serving_admission")
-        self._counts: Dict[str, int] = dict.fromkeys(OUTCOMES, 0)
+        self._counts: Dict[str, int] = dict.fromkeys(OUTCOMES, 0)  # guarded-by: _lock
         self._counts["submitted"] = 0
         self._next_index = 0
         self._cooldown = 0
-        self._draining = False
-        self._drain_deadline: Optional[float] = None
-        self._drain_reason = ""
-        self._closed = False
-        self._started = False
+        self._draining = False                          # guarded-by: _lock
+        self._drain_deadline: Optional[float] = None    # guarded-by: _lock
+        self._drain_reason = ""                         # guarded-by: _lock
+        self._closed = False                            # guarded-by: _lock
+        self._started = False                           # guarded-by: _lock
         self._stop_event = threading.Event()
-        self._template: Optional[Tuple[Tuple[int, ...], str]] = None
+        self._template: Optional[Tuple[Tuple[int, ...], str]] = None  # guarded-by: _lock
         self._ema = _service_ema(self.warmup_batches)
         self.batches = 0
         self.watchdog: Optional[HungDispatchWatchdog] = None
@@ -329,7 +336,8 @@ class ServingEngine:
                 "engine instead of restarting this one")
         if self._started:
             return self
-        self._started = True
+        with self._lock:
+            self._started = True
         self._thread = threading.Thread(target=self._batcher_loop,
                                         daemon=True,
                                         name="serving-batcher")
@@ -342,7 +350,8 @@ class ServingEngine:
         ``example_row`` is one request payload; it also pins the row
         template (shape+dtype) later requests are validated against."""
         row = np.asarray(example_row)
-        self._template = (row.shape, str(row.dtype))
+        with self._lock:
+            self._template = (row.shape, str(row.dtype))
         biggest = max(self._buckets)
         batch = np.broadcast_to(row, (biggest,) + row.shape).copy()
         # one call per bucket: with configured buckets the first call's
@@ -368,7 +377,8 @@ class ServingEngine:
         revived from a half-torn state; build a new one (the compile
         cache makes that a warm load, not a recompile)."""
         if not self._started or self._closed:
-            self._closed = True     # before the sweep — see _batcher_loop
+            with self._lock:
+                self._closed = True  # before the sweep — see _batcher_loop
             self._drain_leftovers()
             return
         with self._lock:
@@ -386,7 +396,8 @@ class ServingEngine:
             budget = (grace if grace is not None else self.grace_period)
             t.join(timeout=budget + 10.0)
         self._drain_leftovers()
-        self._closed = True
+        with self._lock:
+            self._closed = True
 
     def close(self) -> None:
         self.stop()
@@ -476,17 +487,24 @@ class ServingEngine:
             req = RequestHandle(inputs, self._next_index, now,
                                 now + int(deadline * 1e6))
             self._next_index += 1
+        # admission-queue bytes: charged while the payload is queued or
+        # in flight, released at the terminal state.  Charged BEFORE the
+        # enqueue — once the handle is in the queue the batcher owns it,
+        # and a completion that raced a post-enqueue charge would read
+        # payload_nbytes == 0 and leak the governor accounting
+        with req._lock:
+            req.payload_nbytes = payload_nbytes
+        self._payload_acct.add(payload_nbytes)
         try:
             self._q.put_nowait(req)
-            # admission-queue bytes: charged while the payload is queued
-            # or in flight, released by _account at the terminal state
-            req.payload_nbytes = payload_nbytes
-            self._payload_acct.add(payload_nbytes)
         except queue.Full:
             # a racing submit filled the last slot between the depth
             # check and here — same answer, same speed (the request's
             # admission index is abandoned; positions may skip, never
-            # repeat)
+            # repeat).  Refund the never-queued payload first.
+            with req._lock:
+                req.payload_nbytes = 0
+            self._payload_acct.sub(payload_nbytes)
             with self._lock:
                 raise self._reject_locked("queue full",
                                           self.max_queue_depth)
@@ -543,9 +561,11 @@ class ServingEngine:
                  result=None, reason: Optional[str] = None) -> bool:
         if not req._finish(outcome, result=result, error=error):
             return False
-        if req.payload_nbytes:
-            self._payload_acct.sub(req.payload_nbytes)
+        with req._lock:
+            nbytes = req.payload_nbytes
             req.payload_nbytes = 0
+        if nbytes:
+            self._payload_acct.sub(nbytes)
         with self._lock:
             self._counts[outcome] += 1
         telemetry.counter(f"Serving/{outcome}").inc()
@@ -633,7 +653,8 @@ class ServingEngine:
             # past the drain either observes _closed (and sheds its own
             # request) or enqueued before this sweep (which sheds it) —
             # exactly one of the two, never neither
-            self._closed = True
+            with self._lock:
+                self._closed = True
             self._drain_leftovers()
 
     def _begin_drain_locked(self, reason: str, started_at: float,
@@ -734,8 +755,10 @@ class ServingEngine:
             raise ServingDataError(
                 f"non-numeric request payload (dtype {row.dtype})")
         if self._template is None:
-            self._template = (row.shape, str(row.dtype))
-        elif (row.shape, str(row.dtype)) != self._template:
+            with self._lock:
+                if self._template is None:
+                    self._template = (row.shape, str(row.dtype))
+        if (row.shape, str(row.dtype)) != self._template:
             raise ServingDataError(
                 f"ill-shaped request: got {row.shape} {row.dtype}, this "
                 f"engine serves {self._template[0]} {self._template[1]} "

@@ -33,13 +33,12 @@ state under a lock (allocation is scheduler-thread work, microseconds).
 from __future__ import annotations
 
 import math
-import threading
 from typing import Dict, List
 
 import jax.numpy as jnp
 import numpy as np
 
-from bigdl_tpu import telemetry
+from bigdl_tpu import analysis, telemetry
 from bigdl_tpu.serving.engine import Overloaded
 
 #: block id every padded / inactive-slot scatter targets — reserved at
@@ -83,7 +82,7 @@ class PagedKVCache:
         self.v = jnp.zeros(shape, self.dtype)
         self._free: List[int] = list(range(self.n_blocks - 1, 0, -1))
         self._tables: Dict[int, List[int]] = {}
-        self._lock = threading.Lock()
+        self._lock = analysis.make_lock("lm.kv_cache")
         self._occupancy = telemetry.gauge(
             "LM/block_occupancy",
             help="allocated KV-cache blocks / allocatable blocks")

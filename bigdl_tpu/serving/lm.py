@@ -59,7 +59,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from bigdl_tpu import telemetry
+from bigdl_tpu import analysis, telemetry
 from bigdl_tpu.resources import GOVERNOR as _resource_governor
 from bigdl_tpu.resources import item_nbytes as _item_nbytes
 from bigdl_tpu.serving.engine import (OUTCOMES, DeadlineExceeded,
@@ -435,14 +435,14 @@ class TokenStream:
         self.eos_id = eos_id
         self.submit_ns = submit_ns
         self.deadline_ns = deadline_ns
-        self.first_token_ns: Optional[int] = None
-        self.finish_ns: Optional[int] = None
-        self.outcome: Optional[str] = None
-        self.payload_nbytes = 0     # host bytes charged to the governor
-        self._tokens: List[int] = []
-        self._error: Optional[BaseException] = None
-        self._terminal = False
-        self._cv = threading.Condition()
+        self.first_token_ns: Optional[int] = None       # guarded-by: _cv
+        self.finish_ns: Optional[int] = None            # guarded-by: _cv
+        self.outcome: Optional[str] = None              # guarded-by: _cv
+        self.payload_nbytes = 0     # guarded-by: _cv — host bytes charged to the governor
+        self._tokens: List[int] = []                    # guarded-by: _cv
+        self._error: Optional[BaseException] = None     # guarded-by: _cv
+        self._terminal = False                          # guarded-by: _cv
+        self._cv = analysis.make_condition("lm.stream")
 
     # -- scheduler side ---------------------------------------------------
 
@@ -617,7 +617,7 @@ class LMServingEngine:
                        config.get_int("bigdl.lm.cacheBlocks", 0))
         if n_blocks <= 0:
             n_blocks = self.max_batch * self._max_blocks + 1
-        self.cache = PagedKVCache(self.graph.n_layers, self.graph.n_head,
+        self.cache = PagedKVCache(self.graph.n_layers, self.graph.n_head,  # guarded-by: _lock
                                   self.graph.head_dim, n_blocks,
                                   self.block_size)
         self._buckets = self._bucket_plan(
@@ -632,7 +632,7 @@ class LMServingEngine:
         # -- scheduler state (PR 9 idioms) --------------------------------
         self._q: "queue.Queue[TokenStream]" = queue.Queue(
             maxsize=self.max_queue_depth)
-        self._pending: "deque[TokenStream]" = deque(
+        self._pending: "deque[TokenStream]" = deque(   # guarded-by: _lock
             maxlen=self.max_queue_depth)
         self._slots: List[Optional[_Slot]] = [None] * self.max_batch
         # the stream currently mid-admission: the watchdog's async abort
@@ -640,22 +640,22 @@ class LMServingEngine:
         # scheduler thread, so a stream popped from the queue must never
         # live only in a local — _shed_active covers this field
         self._admitting: Optional[TokenStream] = None
-        self._lock = threading.Lock()
+        self._lock = analysis.make_lock("lm.engine")
         self._payload_acct = _resource_governor.account("lm_admission")
-        self._counts: Dict[str, int] = dict.fromkeys(OUTCOMES, 0)
+        self._counts: Dict[str, int] = dict.fromkeys(OUTCOMES, 0)  # guarded-by: _lock
         self._counts["submitted"] = 0
         self._next_index = 0
         self._offline_id = 0
         self._cooldown = 0
-        self._draining = False
-        self._drain_deadline: Optional[float] = None
-        self._drain_reason = ""
-        self._closed = False
-        self._started = False
+        self._draining = False                          # guarded-by: _lock
+        self._drain_deadline: Optional[float] = None    # guarded-by: _lock
+        self._drain_reason = ""                         # guarded-by: _lock
+        self._closed = False                            # guarded-by: _lock
+        self._started = False                           # guarded-by: _lock
         self._stop_event = threading.Event()
         self._ema = _service_ema(self.warmup_steps)
         self.decode_steps = 0
-        self.prefills = 0
+        self.prefills = 0                               # guarded-by: _lock
         self.tokens_out = 0
         self.watchdog: Optional[HungDispatchWatchdog] = None
         self._thread: Optional[threading.Thread] = None
@@ -859,7 +859,8 @@ class LMServingEngine:
                 "engine instead of restarting this one")
         if self._started:
             return self
-        self._started = True
+        with self._lock:
+            self._started = True
         self._thread = threading.Thread(target=self._scheduler_loop,
                                         daemon=True, name="lm-scheduler")
         self._thread.start()
@@ -871,7 +872,8 @@ class LMServingEngine:
         sequences drain within ``grace``, leftovers are shed
         retriably."""
         if not self._started or self._closed:
-            self._closed = True
+            with self._lock:
+                self._closed = True
             self._drain_leftovers()
             return
         with self._lock:
@@ -885,7 +887,8 @@ class LMServingEngine:
             budget = grace if grace is not None else self.grace_period
             t.join(timeout=budget + 10.0)
         self._drain_leftovers()
-        self._closed = True
+        with self._lock:
+            self._closed = True
 
     def close(self) -> None:
         self.stop()
@@ -959,11 +962,18 @@ class LMServingEngine:
                                  now + int(deadline * 1e6), max_new,
                                  eos_id)
             self._next_index += 1
+        # charged BEFORE the enqueue — once the stream is in the queue
+        # the scheduler owns it, and a completion racing a post-enqueue
+        # charge would read payload_nbytes == 0 and leak the accounting
+        with stream._cv:
+            stream.payload_nbytes = payload_nbytes
+        self._payload_acct.add(payload_nbytes)
         try:
             self._q.put_nowait(stream)
-            stream.payload_nbytes = payload_nbytes
-            self._payload_acct.add(payload_nbytes)
         except queue.Full:
+            with stream._cv:
+                stream.payload_nbytes = 0
+            self._payload_acct.sub(payload_nbytes)
             with self._lock:
                 raise self._reject_locked("queue full",
                                           self.max_queue_depth)
@@ -1021,9 +1031,11 @@ class LMServingEngine:
                        reason: Optional[str] = None) -> bool:
         if not stream._finish(outcome, error=error):
             return False
-        if stream.payload_nbytes:
-            self._payload_acct.sub(stream.payload_nbytes)
+        with stream._cv:
+            nbytes = stream.payload_nbytes
             stream.payload_nbytes = 0
+        if nbytes:
+            self._payload_acct.sub(nbytes)
         with self._lock:
             self._counts[outcome] += 1
         telemetry.counter(f"LM/{outcome}").inc()
@@ -1126,7 +1138,8 @@ class LMServingEngine:
                               else nullcontext()):
                             stream = self._q.get(
                                 timeout=self.poll_interval)
-                        self._pending.append(stream)
+                        with self._lock:
+                            self._pending.append(stream)
                     except queue.Empty:
                         with self._lock:
                             if self._cooldown:
@@ -1139,7 +1152,8 @@ class LMServingEngine:
             # _closed BEFORE the sweep: a racing submit that enqueued
             # past the drain either observes _closed (and sheds its own
             # stream) or enqueued before this sweep — exactly one
-            self._closed = True
+            with self._lock:
+                self._closed = True
             self._drain_leftovers()
             self._shed_active(ServingInfraError(
                 "scheduler exited with the sequence in flight — "
@@ -1170,7 +1184,8 @@ class LMServingEngine:
                         break
                 else:
                     try:
-                        stream = self._pending.popleft()
+                        with self._lock:
+                            stream = self._pending.popleft()
                     except IndexError:
                         break
                 err = ServingInfraError(
@@ -1230,9 +1245,11 @@ class LMServingEngine:
                              if s is None), None)
             if slot_idx is None:
                 return
-            if self._pending:
-                stream = self._pending.popleft()
-            else:
+            stream = None
+            with self._lock:
+                if self._pending:
+                    stream = self._pending.popleft()
+            if stream is None:
                 try:
                     stream = self._q.get_nowait()
                 except queue.Empty:
@@ -1259,7 +1276,8 @@ class LMServingEngine:
                 continue
             if not self.cache.can_allocate(prompt.size +
                                            stream.max_new_tokens):
-                self._pending.appendleft(stream)
+                with self._lock:
+                    self._pending.appendleft(stream)
                 self._admitting = None
                 return
             self.cache.allocate(stream.seq_id,
@@ -1308,9 +1326,11 @@ class LMServingEngine:
         lp, new_k, new_v = self._prefill(self._dp, self.cache.k,
                                          self.cache.v, padded,
                                          np.int32(P), table_row)
-        self.cache.k, self.cache.v = new_k, new_v
+        with self._lock:
+            self.cache.k, self.cache.v = new_k, new_v
         lp = np.asarray(host_pull(lp, what="lm prefill logits"))
-        self.prefills += 1
+        with self._lock:
+            self.prefills += 1
         telemetry.counter("LM/prefills").inc()
         telemetry.gauge("LM/prefill_ms").set(
             (telemetry.clock_ns() - t0) / 1e6)
@@ -1357,7 +1377,8 @@ class LMServingEngine:
                   if self._dp_q is not None else (self._dp, self._decode))
         lp, new_k, new_v = fn(dp, self.cache.k, self.cache.v, tokens,
                               positions, tables, active)
-        self.cache.k, self.cache.v = new_k, new_v
+        with self._lock:
+            self.cache.k, self.cache.v = new_k, new_v
         lp = np.asarray(host_pull(lp, what="lm decode logits"))
         now = telemetry.clock_ns()
         for i, slot in enumerate(self._slots):
@@ -1456,7 +1477,8 @@ class LMServingEngine:
                 tables[0], active[0] = table_row, True
                 lp, new_k, new_v = fn(dp, self.cache.k, self.cache.v,
                                       tokens, positions, tables, active)
-                self.cache.k, self.cache.v = new_k, new_v
+                with self._lock:
+                    self.cache.k, self.cache.v = new_k, new_v
                 row = np.asarray(host_pull(
                     lp, what="lm offline decode logits"))[0]
                 out_tokens.append(int(np.argmax(row)) + 1)

@@ -209,6 +209,15 @@ identically under pytest, a soak script, or a real cluster rehearsal:
                                 self-drains, in-flight rollouts abort with
                                 rollback, and the fleet's accounting
                                 identity must still balance exactly.
+``bigdl.chaos.lockDelayAt``     "<lockname>:k[:seconds]": the k-th
+                                acquisition of the named lock-witness
+                                lock (``analysis.make_lock`` names)
+                                stalls for ``seconds`` (default 0.05)
+                                just after the acquisition-order check —
+                                deterministically widening a racy window
+                                so an ordering race that needs a lost
+                                quantum can be reproduced on demand.
+                                Once per position per plan.
 ==============================  =============================================
 
 Counters are process-local and monotonically increasing from
@@ -294,6 +303,9 @@ class _ChaosState:
             "bigdl.chaos.corruptCandidateAt", 0)
         self.sigterm_fleet_at = config.get_int(
             "bigdl.chaos.sigtermFleetAt", 0)
+        (self.lock_delay_name, self.lock_delay_at,
+         self.lock_delay_seconds) = _parse_lock_delay(
+            config.get_property("bigdl.chaos.lockDelayAt"))
         self.writes = 0
         self.steps_failed = 0
         self.steps_seen = 0
@@ -332,7 +344,11 @@ class _ChaosState:
         self.candidates_prepared = 0
         self.candidate_corruptions = 0
         self.fleet_sigterms = 0
-        self._lock = threading.Lock()
+        self.lock_delays_fired: set = set()
+        self.lock_delays = 0
+        # raw by design: the injection-plan bookkeeping lock must not
+        # feed the witness it injects into
+        self._lock = threading.Lock()  # lint: allow(raw-lock-in-threaded-module)
 
     # ---- storage-layer hooks -------------------------------------------
 
@@ -356,6 +372,22 @@ class _ChaosState:
         if k == self.fail_write_at:
             raise _TornWrite(path, data[:max(1, len(data) // 2)])
         return data
+
+    # ---- lock-witness hooks --------------------------------------------
+
+    def lock_delay(self, name: str, n: int) -> float:
+        """Seconds the ``n``-th acquisition of witness lock ``name``
+        should stall (0.0 almost always).  Once per position per plan."""
+        if not self.lock_delay_name or name != self.lock_delay_name:
+            return 0.0
+        if n != self.lock_delay_at:
+            return 0.0
+        with self._lock:
+            if n in self.lock_delays_fired:
+                return 0.0
+            self.lock_delays_fired.add(n)
+            self.lock_delays += 1
+        return self.lock_delay_seconds
 
     # ---- driver-loop hooks ---------------------------------------------
 
@@ -979,6 +1011,18 @@ def _parse_starve(value) -> Tuple[Optional[str], int, float]:
     return (stage, k, secs)
 
 
+def _parse_lock_delay(value) -> Tuple[Optional[str], int, float]:
+    """``"lockname:k"`` -> (lockname, k, 0.05); ``"lockname:k:seconds"``
+    -> (lockname, k, seconds); falsy -> (None, 0, 0.0)."""
+    if not value:
+        return (None, 0, 0.0)
+    parts = str(value).split(":")
+    name = parts[0].strip()
+    k = int(parts[1]) if len(parts) > 1 else 1
+    secs = float(parts[2]) if len(parts) > 2 else 0.05
+    return (name, k, secs)
+
+
 def _parse_kill(value) -> Tuple[Optional[str], int]:
     """``"stage"`` -> (stage, 1); ``"stage:k"`` -> (stage, k); falsy ->
     (None, 0)."""
@@ -1000,11 +1044,18 @@ def install() -> None:
     injection plan)."""
     global _state
     _state = _ChaosState()
+    # push the lockDelayAt target into the witness: its armed acquire
+    # path pays one attribute compare instead of probing chaos per
+    # acquisition
+    from bigdl_tpu.analysis import lockwitness
+    lockwitness.set_chaos_delay_target(_state.lock_delay_name or None)
 
 
 def uninstall() -> None:
     global _state
     _state = None
+    from bigdl_tpu.analysis import lockwitness
+    lockwitness.set_chaos_delay_target(None)
 
 
 def active() -> bool:
@@ -1039,6 +1090,22 @@ def on_compile(label: str) -> None:
     compile wedges for the configured seconds."""
     if _state is not None:
         _state.on_compile(label)
+
+
+def lock_delay_target() -> Optional[str]:
+    """Name of the witness lock an armed ``lockDelayAt`` plan targets,
+    or None — the lock witness's fast-path probe, so the un-chaosed
+    acquire path pays one call + compare instead of per-name counting."""
+    return _state.lock_delay_name if _state is not None else None
+
+
+def lock_delay(name: str, n: int) -> float:
+    """Lock-witness acquire hook: seconds the ``n``-th acquisition of
+    the named witness lock should stall (0.0 when disarmed; once per
+    position per plan)."""
+    if _state is None:
+        return 0.0
+    return _state.lock_delay(name, n)
 
 
 def on_serving_request(index: int) -> None:

@@ -182,8 +182,10 @@ class _AsyncWriter:
     detached snapshots is bounded to one."""
 
     def __init__(self):
+        from bigdl_tpu import analysis
         self._thread: Optional[threading.Thread] = None
-        self._error: Optional[BaseException] = None
+        self._lock = analysis.make_lock("checkpoint.writer")
+        self._error: Optional[BaseException] = None    # guarded-by: _lock
 
     def submit(self, job) -> None:
         self.join()
@@ -192,7 +194,8 @@ class _AsyncWriter:
             try:
                 job()
             except BaseException as e:  # noqa: BLE001 — re-raised at join
-                self._error = e
+                with self._lock:
+                    self._error = e
 
         self._thread = threading.Thread(target=run, daemon=True,
                                         name="bigdl-ckpt-writer")
@@ -212,8 +215,10 @@ class _AsyncWriter:
                     "timeout — abandoning the wait", timeout)
                 return
             self._thread = None
-        if self._error is not None:
-            err, self._error = self._error, None
+        with self._lock:
+            err = self._error
+            self._error = None
+        if err is not None:
             if raise_errors:
                 raise SnapshotWriteError(
                     "background checkpoint write failed") from err
@@ -275,9 +280,13 @@ class CheckpointManager:
         #: disk-full degradation: once storage is exhausted (and an
         #: emergency oldest-first GC could not free enough), snapshots
         #: are kept in host memory only — newest one, restorable — and
-        #: no further disk writes are attempted
-        self._storage_degraded = False
-        self._memory_snapshot: Optional[Dict[str, Any]] = None
+        #: no further disk writes are attempted.  Both fields are
+        #: written by the async writer thread AND read/written from the
+        #: submitting thread, so they share a state lock.
+        from bigdl_tpu import analysis
+        self._state_lock = analysis.make_lock("checkpoint.state")
+        self._storage_degraded = False                            # guarded-by: _state_lock
+        self._memory_snapshot: Optional[Dict[str, Any]] = None    # guarded-by: _state_lock
         #: watch_latest() poll cache: directory mtime at the last scan,
         #: the answer it produced, and the snapshots already
         #: shallow-verified (so an unstable-mtime window re-lists names
@@ -355,7 +364,8 @@ class CheckpointManager:
             # no space to be found: degrade to in-memory-only snapshots
             # (one warning + Resources/storage_degraded) — training NEVER
             # crashes on a full disk
-            self._storage_degraded = True
+            with self._state_lock:
+                self._storage_degraded = True
             from bigdl_tpu.resources import storage as _rstorage
             _rstorage.note_degraded("checkpoints", e)
             self._keep_memory_snapshot(blobs, neval, topology, fps)
@@ -366,10 +376,11 @@ class CheckpointManager:
         """Degraded mode: retain the newest snapshot as detached bytes in
         host RAM (bounded to ONE — the blobs were already captured, so
         this costs no extra serialization work)."""
-        self._memory_snapshot = {
-            "blobs": blobs, "neval": int(neval), "topology": topology,
-            "fps": dict(fps or {}),
-        }
+        with self._state_lock:
+            self._memory_snapshot = {
+                "blobs": blobs, "neval": int(neval), "topology": topology,
+                "fps": dict(fps or {}),
+            }
         telemetry.counter(
             "Resources/memory_snapshots",
             help="snapshots retained in RAM only (disk full)").inc()

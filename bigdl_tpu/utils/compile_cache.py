@@ -141,7 +141,8 @@ class compile_watchdog:
         #: async exception after leaving the supervised block, the same
         #: re-validate-under-the-lock discipline the PR 6 hung-step
         #: watchdog uses
-        self._lock = threading.Lock()
+        from bigdl_tpu import analysis
+        self._lock = analysis.make_lock("compile_cache.watchdog")
         self._thread: Optional[threading.Thread] = None
 
     def __enter__(self) -> "_WatchState":

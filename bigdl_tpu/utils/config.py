@@ -199,6 +199,13 @@ _DEFAULTS: Dict[str, Any] = {
     "bigdl.analysis.hostSync": "warn",     # implicit device→host pulls in hot loop
     "bigdl.analysis.hotLoopScope": "iteration",  # sanitize fetch+step, or "step"
     "bigdl.analysis.contracts": "warn",    # module contract checker strictness
+    "bigdl.analysis.lockWitness": "off",   # runtime lock-order witness
+    # (analysis/lockwitness): strict raises LockOrderViolation on any
+    # acquisition-order cycle, warn logs once per edge pair; armed
+    # strict for every tier-1 test by the conftest fixture
+    "bigdl.chaos.lockDelayAt": None,   # "<lockname>:k[:seconds]": the k-th
+    # acquisition of the named witness lock stalls (default 0.05 s),
+    # deterministically widening a racy window; once per position
     # HLO program auditor (bigdl_tpu/analysis/hlo_audit): static passes
     # over every fused step's lowered StableHLO, same strict/warn/off
     # vocabulary as bigdl.analysis.*

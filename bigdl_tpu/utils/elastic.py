@@ -459,10 +459,11 @@ class HungStepWatchdog:
         self.timeline_dir = timeline_dir
         self.on_fire = on_fire
         self.fired = 0
-        self._lock = threading.Lock()
+        from bigdl_tpu import analysis
+        self._lock = analysis.make_lock("elastic.watchdog")
         self._last_beat_ns: Optional[int] = None
         self._beats = 0
-        self._fired_this_stall = False
+        self._fired_this_stall = False       # guarded-by: _lock
         self._cool_left = 0
         self._paused = 0
         #: the start()->first-beat interval covers setup, not a step, and

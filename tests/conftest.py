@@ -61,6 +61,51 @@ def _sanitizers_armed():
 
 
 @pytest.fixture(autouse=True)
+def _lock_witness_armed():
+    """Arm the runtime lock witness STRICT for every tier-1 test: any
+    lock acquisition that closes a cycle in the process-wide
+    acquisition-order graph raises LockOrderViolation (both sites, both
+    stacks) BEFORE the blocking acquire — the suite fails on a deadlock
+    that never had to happen this run.  Graph and counters are dropped
+    after each test so one test's acquisition order can never poison
+    another's."""
+    from bigdl_tpu.analysis import lockwitness
+    from bigdl_tpu.utils import config
+
+    config.set_property("bigdl.analysis.lockWitness", "strict")
+    lockwitness.arm()
+    yield
+    lockwitness.disarm()
+    lockwitness.reset()
+    config.clear_property("bigdl.analysis.lockWitness")
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _no_thread_leaks():
+    """End-of-suite leak check: every framework thread spawned during the
+    run must be gone (joined or daemonized-and-idle) by session end.  A
+    non-daemon thread still alive here means some stop()/close() path
+    forgot a join — exactly the class of bug the concurrency pass exists
+    to keep out — and it would hang the interpreter at exit."""
+    import threading
+
+    baseline = {t.ident for t in threading.enumerate()}
+    yield
+    # stragglers get one grace join: a worker mid-teardown on a loaded
+    # CI box is latency, not a leak
+    leaked = [t for t in threading.enumerate()
+              if t.ident not in baseline and not t.daemon and t.is_alive()]
+    for t in leaked:
+        t.join(timeout=5.0)
+    leaked = [t for t in leaked if t.is_alive()]
+    assert not leaked, (
+        "non-daemon threads leaked past session end (missing join in a "
+        "stop()/close() path):\n" + "\n".join(
+            f"  - {t.name} (ident={t.ident}, daemon={t.daemon})"
+            for t in leaked))
+
+
+@pytest.fixture(autouse=True)
 def _telemetry_armed():
     """Arm the span tracer for EVERY tier-1 test: telemetry must be able
     to ride along any training run without changing its behaviour — in

@@ -1,0 +1,573 @@
+"""Concurrency soundness: the whole-package static pass + the runtime
+lock witness (tentpole) and the chaos ``lockDelayAt`` injector.
+
+Three legs:
+
+- **Static pass units** — synthetic modules prove each rule fires
+  (missing guarded-by, mutation outside its guard, package lock-order
+  inversion, unguarded async abort) and that inline
+  ``# lint: allow(...)`` silences exactly the annotated line.
+- **Gate** — the real package analyzes clean, through the same CLI the
+  acceptance criterion names.
+- **Runtime witness** — strict raises a structured
+  :class:`LockOrderViolation` (both sites, both stacks) BEFORE the
+  blocking acquire; a chaos-seeded two-thread A→B/B→A inversion is
+  caught deterministically with the violation in hand, not a wedged
+  suite.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from bigdl_tpu.analysis import concurrency as conc
+from bigdl_tpu.analysis import lockwitness
+from bigdl_tpu.utils import chaos, config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "bigdl_tpu")
+
+
+def _write(tmp_path, name, text):
+    p = tmp_path / name
+    p.write_text(text)
+    return str(p)
+
+
+_THREADED_HEADER = """\
+import threading
+
+from bigdl_tpu import analysis
+
+
+class Worker:
+    def __init__(self):
+        self._lock = analysis.make_lock("synth.worker")
+        self.count = 0{annotation}
+        self._t = None
+
+    def start(self):
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+"""
+
+
+class TestStaticGuardedBy:
+    def test_two_root_mutation_without_annotation_is_flagged(self, tmp_path):
+        src = _THREADED_HEADER.format(annotation="") + """
+    def _run(self):
+        while True:
+            self.count += 1
+
+    def bump(self):
+        self.count += 1
+"""
+        findings = conc.analyze([_write(tmp_path, "counting.py", src)])
+        assert [f.rule for f in findings] == ["missing-guarded-by"]
+        assert "Worker.count" in str(findings[0])
+        assert "guarded-by" in str(findings[0])
+
+    def test_annotated_and_locked_everywhere_is_clean(self, tmp_path):
+        src = _THREADED_HEADER.format(
+            annotation="   # guarded-by: _lock") + """
+    def _run(self):
+        while True:
+            with self._lock:
+                self.count += 1
+
+    def bump(self):
+        with self._lock:
+            self.count += 1
+"""
+        assert conc.analyze([_write(tmp_path, "clean.py", src)]) == []
+
+    def test_mutation_outside_named_guard_is_flagged(self, tmp_path):
+        src = _THREADED_HEADER.format(
+            annotation="   # guarded-by: _lock") + """
+    def _run(self):
+        while True:
+            with self._lock:
+                self.count += 1
+
+    def bump(self):
+        self.count += 1
+"""
+        findings = conc.analyze([_write(tmp_path, "outside.py", src)])
+        assert [f.rule for f in findings] == ["guarded-mutation-outside-lock"]
+        assert "'_lock'" in str(findings[0])
+
+    def test_guard_held_by_caller_propagates(self, tmp_path):
+        """A private helper mutating guarded state is clean when EVERY
+        caller holds the guard (must-held propagation through calls)."""
+        src = _THREADED_HEADER.format(
+            annotation="   # guarded-by: _lock") + """
+    def _run(self):
+        while True:
+            with self._lock:
+                self._bump_locked()
+
+    def bump(self):
+        with self._lock:
+            self._bump_locked()
+
+    def _bump_locked(self):
+        self.count += 1
+"""
+        assert conc.analyze([_write(tmp_path, "helper.py", src)]) == []
+
+    def test_inline_allow_silences_exactly_that_line(self, tmp_path):
+        src = _THREADED_HEADER.format(annotation="") + """
+    def _run(self):
+        while True:
+            self.count += 1   # lint: allow(missing-guarded-by)
+
+    def bump(self):
+        self.count += 1
+"""
+        # the finding anchors at the FIRST live mutation site; allowing
+        # it there silences the (single) finding for this attribute
+        assert conc.analyze([_write(tmp_path, "allowed.py", src)]) == []
+
+
+class TestStaticLockOrder:
+    def test_package_wide_inversion_is_flagged_with_both_sites(
+            self, tmp_path):
+        src = """
+import threading
+
+from bigdl_tpu import analysis
+
+
+class Pair:
+    def __init__(self):
+        self._a = analysis.make_lock("synth.a")
+        self._b = analysis.make_lock("synth.b")
+        self._t = threading.Thread(target=self.fwd, daemon=True)
+
+    def fwd(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def rev(self):
+        with self._b:
+            with self._a:
+                pass
+"""
+        findings = conc.analyze([_write(tmp_path, "inverted.py", src)])
+        assert [f.rule for f in findings] == ["lock-order-inversion"]
+        msg = str(findings[0])
+        assert "'synth.a'" in msg and "'synth.b'" in msg
+        # both sites named: the finding line and the reverse site
+        assert "inverted.py:" in msg.split("] ", 1)[1]
+
+    def test_consistent_order_is_clean(self, tmp_path):
+        src = """
+import threading
+
+from bigdl_tpu import analysis
+
+
+class Pair:
+    def __init__(self):
+        self._a = analysis.make_lock("synth.c")
+        self._b = analysis.make_lock("synth.d")
+        self._t = threading.Thread(target=self.fwd, daemon=True)
+
+    def fwd(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def also_fwd(self):
+        with self._a:
+            with self._b:
+                pass
+"""
+        assert conc.analyze([_write(tmp_path, "ordered.py", src)]) == []
+
+
+class TestStaticAsyncAbort:
+    def test_unguarded_async_raise_is_flagged(self, tmp_path):
+        src = """
+from bigdl_tpu.utils.elastic import _async_raise
+
+
+def kill(tid):
+    _async_raise(tid, RuntimeError)
+"""
+        findings = conc.analyze([_write(tmp_path, "aborter.py", src)])
+        assert [f.rule for f in findings] == ["async-abort-unguarded"]
+
+    def test_abort_under_lock_with_recheck_is_clean(self, tmp_path):
+        src = """
+import threading
+
+from bigdl_tpu import analysis
+from bigdl_tpu.utils.elastic import _async_raise
+
+
+class Watchdog:
+    def __init__(self):
+        self._lock = analysis.make_lock("synth.watchdog")
+        self.done = False
+
+    def fire(self, tid):
+        with self._lock:
+            if self.done:
+                return
+            _async_raise(tid, RuntimeError)
+"""
+        assert conc.analyze([_write(tmp_path, "guarded.py", src)]) == []
+
+
+class TestPackageGate:
+    def test_package_analyzes_clean(self):
+        findings = conc.analyze([PKG])
+        assert findings == [], \
+            "concurrency findings in bigdl_tpu/ (fix or silence inline):" \
+            "\n" + "\n".join(str(f) for f in findings)
+
+    def test_cli_entry_point_exits_zero(self):
+        """The exact command the acceptance criterion names."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "bigdl_tpu.analysis.concurrency",
+             "bigdl_tpu"],
+            cwd=REPO, capture_output=True, text=True, timeout=300,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_unknown_rule_is_an_error_listing_known_rules(self, capsys):
+        rc = conc.main(["bigdl_tpu", "--rule", "no-such-rule"])
+        assert rc != 0
+        err = capsys.readouterr().err
+        assert "unknown rule(s): no-such-rule" in err
+        for rule in conc.CONCURRENCY_RULES:
+            assert rule in err
+
+    def test_inventory_names_the_runtime_locks(self):
+        inv = conc.thread_inventory([PKG])
+        names = {l["name"] for l in inv["locks"]}
+        # the factory-routed core: one witness name per lock class
+        for expect in ("serving.engine", "serving.handle", "lm.engine",
+                       "lm.stream", "engine.prefetch", "fleet.supervisor",
+                       "ingest.ring", "checkpoint.writer"):
+            assert expect in names, f"{expect} missing from inventory"
+        assert inv["threads"], "no thread entry points found"
+
+
+# ---------------------------------------------------------------------------
+# runtime witness
+# ---------------------------------------------------------------------------
+
+class TestLockWitness:
+    def test_tier1_suite_runs_armed_strict(self):
+        """The conftest autouse fixture must have armed the witness for
+        this very test."""
+        assert lockwitness.armed() == "strict"
+
+    def test_inversion_raises_structured_violation(self):
+        a = lockwitness.make_lock("t.struct_a")
+        b = lockwitness.make_lock("t.struct_b")
+        with a:
+            with b:
+                pass
+        with b:
+            with pytest.raises(lockwitness.LockOrderViolation) as ei:
+                with a:
+                    pass
+        v = ei.value
+        assert v.edge == ("t.struct_b", "t.struct_a")
+        assert v.reverse_edge == ("t.struct_a", "t.struct_b")
+        assert "test_concurrency.py" in v.site
+        assert "test_concurrency.py" in v.reverse_site
+        assert v.stack and v.reverse_stack
+        # both stacks ride the message too
+        assert "this acquisition" in str(v) and "prior acquisition" in str(v)
+
+    def test_check_runs_before_the_blocking_acquire(self):
+        """The witness must raise while the conflicting lock is HELD by
+        another thread — i.e. before this thread blocks on it — or it
+        could never report the deadlock it exists to prevent."""
+        a = lockwitness.make_lock("t.pre_a")
+        b = lockwitness.make_lock("t.pre_b")
+        with a:
+            with b:
+                pass
+        entered = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with a:                      # other thread HOLDS a
+                entered.set()
+                release.wait(5.0)
+
+        t = threading.Thread(target=holder, daemon=True)
+        t.start()
+        assert entered.wait(5.0)
+        try:
+            with b:
+                # without the pre-acquire check this would deadlock
+                # against holder(); instead it raises immediately
+                with pytest.raises(lockwitness.LockOrderViolation):
+                    with a:
+                        pass
+        finally:
+            release.set()
+            t.join(5.0)
+
+    def test_warn_mode_counts_instead_of_raising(self):
+        lockwitness.reset()
+        lockwitness.arm("warn")
+        try:
+            a = lockwitness.make_lock("t.warn_a")
+            b = lockwitness.make_lock("t.warn_b")
+            with a:
+                with b:
+                    pass
+            with b:
+                with a:                  # would raise under strict
+                    pass
+            assert lockwitness.snapshot()["violations"] == 1
+        finally:
+            lockwitness.reset()
+            lockwitness.arm("strict")    # hand back to the fixture's mode
+
+    def test_rlock_reentry_adds_no_self_edge(self):
+        r = lockwitness.make_rlock("t.reent")
+        with r:
+            with r:                      # reentrant: no edge, no raise
+                pass
+        assert "t.reent" not in lockwitness.order_graph().get("t.reent",
+                                                              set())
+
+    def test_same_name_nesting_adds_no_self_edge(self):
+        """Two instances of one lock class (same witness name) nested —
+        e.g. two governor accounts — must not self-edge."""
+        x = lockwitness.make_lock("t.class")
+        y = lockwitness.make_lock("t.class")
+        with x:
+            with y:
+                pass
+        assert "t.class" not in lockwitness.order_graph().get("t.class",
+                                                              set())
+
+    def test_condition_wait_keeps_held_stack_truthful(self):
+        cv = lockwitness.make_condition("t.cv")
+        other = lockwitness.make_lock("t.cv_other")
+        done = []
+
+        def waiter():
+            with cv:
+                cv.wait(timeout=5.0)
+                done.append(True)
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        with cv:                         # acquirable: wait() released it
+            cv.notify_all()
+        t.join(5.0)
+        assert done == [True]
+        with cv:
+            with other:                  # cv -> other edge records cleanly
+                pass
+        assert "t.cv_other" in lockwitness.order_graph().get("t.cv", set())
+
+    def test_disarmed_is_plain_delegation(self):
+        lockwitness.disarm()
+        try:
+            lk = lockwitness.make_lock("t.disarmed")
+            before = lockwitness.snapshot()["acquires"]
+            with lk:
+                pass
+            assert lockwitness.snapshot()["acquires"] == before
+        finally:
+            lockwitness.arm("strict")    # hand back to the fixture's mode
+
+    def test_factory_exports_ride_the_analysis_namespace(self):
+        from bigdl_tpu import analysis
+        assert analysis.make_lock is lockwitness.make_lock
+        assert analysis.make_rlock is lockwitness.make_rlock
+        assert analysis.make_condition is lockwitness.make_condition
+        assert analysis.LockOrderViolation is lockwitness.LockOrderViolation
+
+
+# ---------------------------------------------------------------------------
+# chaos: seeded inversion (satellite a)
+# ---------------------------------------------------------------------------
+
+class TestChaosLockDelay:
+    @pytest.fixture(autouse=True)
+    def _chaos_env(self):
+        yield
+        chaos.uninstall()
+        config.clear_property("bigdl.chaos.lockDelayAt")
+
+    def test_seeded_two_thread_inversion_is_caught_with_both_stacks(self):
+        """The reproduce-on-demand story end to end: thread one takes
+        A→B, thread two takes B→A.  ``lockDelayAt`` stalls thread one's
+        inner acquire of B — AFTER its A→B edge is recorded, BEFORE it
+        blocks — deterministically holding the racy window open so
+        thread two runs its inverted acquisition into the witness while
+        thread one still holds A.  Without the witness this interleaving
+        is a real deadlock; with it, thread two gets the structured
+        violation and the suite reports instead of wedging."""
+        config.set_property("bigdl.chaos.lockDelayAt", "t.seed_b:1:0.4")
+        chaos.install()
+        a = lockwitness.make_lock("t.seed_a")
+        b = lockwitness.make_lock("t.seed_b")
+        caught = []
+
+        def forward():
+            with a:
+                with b:            # 1st acquire of t.seed_b: stalls 0.4 s
+                    pass
+
+        def inverted():
+            time.sleep(0.15)       # let forward() record A->B and stall
+            try:
+                with b:
+                    with a:
+                        pass
+            except lockwitness.LockOrderViolation as e:
+                caught.append(e)
+
+        t1 = threading.Thread(target=forward, daemon=True)
+        t2 = threading.Thread(target=inverted, daemon=True)
+        t1.start()
+        t2.start()
+        t1.join(10.0)
+        t2.join(10.0)
+        assert len(caught) == 1, "witness missed the seeded inversion"
+        v = caught[0]
+        assert v.edge == ("t.seed_b", "t.seed_a")
+        assert v.reverse_edge == ("t.seed_a", "t.seed_b")
+        assert "forward" in v.reverse_stack    # the other thread's stack
+        assert "inverted" in v.stack           # this thread's stack
+        assert chaos._state.lock_delays == 1   # the stall actually fired
+
+    def test_delay_fires_once_per_position_per_plan(self):
+        config.set_property("bigdl.chaos.lockDelayAt", "t.once:2:0.2")
+        chaos.install()
+        lk = lockwitness.make_lock("t.once")
+        t0 = time.monotonic()
+        for _ in range(4):
+            with lk:
+                pass
+        elapsed = time.monotonic() - t0
+        assert chaos._state.lock_delays == 1
+        assert 0.2 <= elapsed < 2.0
+
+    def test_install_pushes_target_uninstall_clears_it(self):
+        config.set_property("bigdl.chaos.lockDelayAt", "t.push:1")
+        chaos.install()
+        assert lockwitness._WITNESS.chaos_target == "t.push"
+        chaos.uninstall()
+        assert lockwitness._WITNESS.chaos_target is None
+
+
+# ---------------------------------------------------------------------------
+# regressions for the genuine findings the static pass surfaced
+# (satellite b: each fixed race keeps a test)
+# ---------------------------------------------------------------------------
+
+class TestRaceRegressions:
+    def test_handle_terminal_transition_is_first_wins_exactly_once(self):
+        """RequestHandle._finish was Event-based check-then-act: a
+        dispatch completion and a supervisor abandon() racing from two
+        threads could BOTH pass the gate and double-count the outcome.
+        Now the done-check and the state writes are one atomic region —
+        hammer the transition from many threads and exactly one wins."""
+        from bigdl_tpu.serving.engine import RequestHandle
+        wins = []
+        errs = []
+        for _ in range(50):
+            h = RequestHandle(None, 0, 0, 1 << 62)
+            barrier = threading.Barrier(4)
+            del wins[:]
+
+            def racer(tag):
+                barrier.wait(5.0)
+                if h._finish(tag, result=tag):
+                    wins.append(tag)
+
+            ts = [threading.Thread(target=racer, args=(f"o{i}",))
+                  for i in range(4)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(5.0)
+            if len(wins) != 1:
+                errs.append(list(wins))
+            assert h.outcome in ("o0", "o1", "o2", "o3")
+            assert h._result == h.outcome     # writes from ONE racer only
+        assert errs == [], f"non-atomic first-wins transitions: {errs}"
+
+    def test_abandon_after_completion_never_double_releases(self):
+        """abandon() on an already-completed handle must neither flip
+        the outcome nor release payload bytes a second time."""
+        from bigdl_tpu.resources import GOVERNOR
+        from bigdl_tpu.serving.engine import RequestHandle
+        acct = GOVERNOR.account("serving_admission")
+        base = acct.nbytes
+        h = RequestHandle(None, 0, 0, 1 << 62)
+        with h._lock:
+            h.payload_nbytes = 1024
+        acct.add(1024)
+        assert h._finish("ok", result=1)      # dispatch completion wins
+        # the engine's completion path released the bytes:
+        with h._lock:
+            nbytes, h.payload_nbytes = h.payload_nbytes, 0
+        acct.sub(nbytes)
+        assert not h.abandon()                # loses the race, releases 0
+        assert h.outcome == "ok" and h.result() == 1
+        assert acct.nbytes == base
+
+    def test_admission_bytes_are_charged_before_enqueue(self):
+        """The payload charge now happens BEFORE the handle enters the
+        queue: once queued the batcher owns it, and a completion racing
+        a post-enqueue charge would read payload_nbytes == 0 and leak
+        the governor accounting.  A completed request must leave the
+        admission account exactly where it started."""
+        import numpy as np
+        import jax
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.resources import GOVERNOR
+        from bigdl_tpu.serving import ServingEngine
+        acct = GOVERNOR.account("serving_admission")
+        base = acct.nbytes
+        model = nn.Sequential().add(nn.Linear(4, 2))
+        model.reset(jax.random.PRNGKey(0))
+        eng = ServingEngine(model)
+        try:
+            eng.warmup(np.zeros((4,), np.float32))
+            h = eng.submit(np.zeros((4,), np.float32))
+            assert h.payload_nbytes or h.done()   # charged at admission
+            h.result(timeout=10.0)
+            deadline = time.monotonic() + 5.0
+            while acct.nbytes != base and time.monotonic() < deadline:
+                time.sleep(0.01)                  # _account runs post-set
+            assert acct.nbytes == base            # charged then released
+        finally:
+            eng.stop()
+
+    def test_prefetch_error_stash_is_first_error_wins(self):
+        """BatchPrefetcher._stash_error raced two producer threads and
+        the stopping consumer over ``self.error``; the check-and-write
+        is now one atomic region — the first error sticks, later ones
+        never overwrite it."""
+        from bigdl_tpu.engine import BatchPrefetcher
+        pf = BatchPrefetcher.__new__(BatchPrefetcher)
+        pf._stats_lock = lockwitness.make_lock("t.prefetch_stats")
+        pf.error = None
+        first, second = RuntimeError("first"), RuntimeError("second")
+        pf._stash_error((first, None))
+        pf._stash_error((second, None))
+        assert pf.error is first
+        pf._stash_error((None, None))             # non-errors never clear
+        assert pf.error is first

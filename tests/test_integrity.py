@@ -249,6 +249,27 @@ class TestChaosDocDrift:
             f"chaos.py reads unregistered keys: {sorted(used - registered)}"
 
 
+class TestAnalysisDocDrift:
+    """Every ``bigdl.analysis.*`` key the code registers must have a
+    row in docs/configuration.md — and vice versa (the lockWitness knob
+    rides the same both-ways drift guard as the chaos keys)."""
+
+    _KEY = re.compile(r"bigdl\.analysis\.[A-Za-z0-9]+")
+
+    def _keys_in(self, *parts):
+        with open(os.path.join(_REPO, *parts), encoding="utf-8") as f:
+            return set(self._KEY.findall(f.read()))
+
+    def test_config_defaults_match_docs_both_ways(self):
+        code = self._keys_in("bigdl_tpu", "utils", "config.py")
+        docs = self._keys_in("docs", "configuration.md")
+        assert code - docs == set(), \
+            f"analysis keys missing a docs row: {sorted(code - docs)}"
+        assert docs - code == set(), \
+            f"documented analysis keys unknown to config.py: " \
+            f"{sorted(docs - code)}"
+
+
 class TestIngestDocDrift:
     """Every ``bigdl.ingest.*`` key the code registers must have a row
     in docs/configuration.md — and vice versa (satellite e: the
