@@ -165,6 +165,24 @@ Rules
     construction site (inside ``spawn`` itself) carries the inline
     allow; the allowlist stays empty.
 
+``untraced-terminal-verdict``
+    In the serving and fleet packages (``bigdl_tpu/serving/``,
+    ``bigdl_tpu/fleet/``): a ``raise`` that constructs a terminal
+    serving-taxonomy error (``Overloaded`` / ``DeadlineExceeded`` /
+    ``ServingDataError`` / ``HungDispatchError`` / ``ReplicaKilled``)
+    — directly or via a name bound from one — anywhere outside the
+    verdict choke points, or a raw terminal transition
+    (``req._finish(...)`` / ``stream._finish(...)``) outside the
+    accounting chokes.  Every terminal error must flow through a choke
+    that stamps ``request_trace.verdict`` (the validation chokes
+    ``_validate``/``_decode``, the KV-pool admission answer
+    ``allocate``, the offline ``generate`` paths where no admitted
+    request exists, or a ``_reject_locked``-style rejection minter);
+    every finish must flow through ``_account``/``_finish_stream``/
+    ``abandon``.  A request that dies outside the chokes is a request
+    whose trace never says why — the exact failure mode the forensic
+    layer exists to make impossible.  The allowlist stays empty.
+
 Silencing: append ``# lint: allow(<rule-name>)`` to the offending line,
 or list ``<relpath>:<rule-name>`` in an allowlist file (one per line,
 ``#`` comments) — the CI gate keeps the repo allowlist empty, so every
@@ -251,6 +269,31 @@ ACCOUNTING_CALLS = {"account", "item_nbytes", "check_item", "_charge",
 #: synchronous MT path with its mixed-shape pre-crop) are exempt
 DATASET_SCOPE = os.path.join("dataset", "")
 FLEET_SCOPE = os.path.join("fleet", "")
+
+#: the terminal serving-taxonomy errors: a request that dies with one of
+#: these must die through a verdict-recording choke point
+TERMINAL_ERRORS = {"Overloaded", "DeadlineExceeded", "ServingDataError",
+                   "HungDispatchError", "ReplicaKilled"}
+#: (rel-path suffix, function) pairs allowed to construct-and-raise a
+#: terminal error: validation chokes whose callers account the verdict,
+#: the KV-pool admission answer, and the offline generate paths (no
+#: admitted request exists to trace).  Rejection minters
+#: (``_reject_locked`` / ``_fleet_reject``) RETURN the error after
+#: stamping the trace, so their raise sites never match the pattern.
+VERDICT_RAISE_CHOKES = {
+    (os.path.join("serving", "engine.py"), "_decode"),
+    (os.path.join("serving", "lm.py"), "_validate"),
+    (os.path.join("serving", "lm.py"), "generate"),
+    (os.path.join("serving", "lm.py"), "generate_sequential"),
+    (os.path.join("serving", "kv_cache.py"), "allocate"),
+}
+#: functions allowed to drive the raw terminal transition ``._finish()``:
+#: the accounting chokes that stamp request_trace.verdict + exemplars
+VERDICT_FINISH_CHOKES = {
+    (os.path.join("serving", "engine.py"), "_account"),
+    (os.path.join("serving", "engine.py"), "abandon"),
+    (os.path.join("serving", "lm.py"), "_finish_stream"),
+}
 HOST_AUGMENT_FALLBACK_FILES = (os.path.join("dataset", "image.py"),
                                os.path.join("dataset", "mt_batch.py"))
 #: per-pixel augmentation calls that belong on device (nn.DeviceAugment)
@@ -278,7 +321,8 @@ KNOWN_RULES = frozenset({
     "unaccounted-buffer-in-stage",
     "host-augment-in-hot-path", "unsupervised-thread-in-fleet",
     "bare-except", "swallowed-exception", "raw-lock-in-threaded-module",
-    "blocking-under-lock", "lock-order", "syntax",
+    "blocking-under-lock", "lock-order", "untraced-terminal-verdict",
+    "syntax",
 })
 
 
@@ -808,6 +852,69 @@ def _rule_fleet_thread(path: str, rel: str, tree: ast.AST) -> List[Finding]:
     return out
 
 
+def _rule_untraced_verdict(path: str, rel: str,
+                           tree: ast.AST) -> List[Finding]:
+    """Terminal serving errors and raw ``._finish()`` transitions in the
+    serving/fleet packages must flow through the verdict choke points —
+    the functions whose callers (or bodies) stamp
+    ``request_trace.verdict`` and the incident ring.  A terminal error
+    raised anywhere else is a request that dies without its trace ever
+    saying why."""
+    if not (SERVING_SCOPE in rel or FLEET_SCOPE in rel):
+        return []
+    out: List[Finding] = []
+
+    def _choke(chokes: Set[Tuple[str, str]], fn: Optional[str]) -> bool:
+        return any(rel.endswith(suffix) and fn == name
+                   for suffix, name in chokes)
+
+    def _visit(node: ast.AST, fn: Optional[str],
+               terminal_names: Set[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn = node.name
+            terminal_names = set()
+        if isinstance(node, ast.Assign):
+            v = node.value
+            if (isinstance(v, ast.Call) and isinstance(v.func, ast.Name)
+                    and v.func.id in TERMINAL_ERRORS):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        terminal_names.add(t.id)
+        if isinstance(node, ast.Raise) and node.exc is not None:
+            e = node.exc
+            cls = None
+            if (isinstance(e, ast.Call) and isinstance(e.func, ast.Name)
+                    and e.func.id in TERMINAL_ERRORS):
+                cls = e.func.id
+            elif isinstance(e, ast.Name) and e.id in terminal_names:
+                cls = e.id
+            if cls is not None and not _choke(VERDICT_RAISE_CHOKES, fn):
+                out.append(Finding(
+                    rel, node.lineno, "untraced-terminal-verdict",
+                    f"terminal serving error {cls} raised outside the "
+                    "verdict choke points — the request trace never "
+                    "records why this request died; raise it from a "
+                    "validation choke (_validate/_decode/allocate) or "
+                    "mint it through a _reject_locked-style helper that "
+                    "stamps the verdict first"))
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "_finish"
+                and not _choke(VERDICT_FINISH_CHOKES, fn)):
+            out.append(Finding(
+                rel, node.lineno, "untraced-terminal-verdict",
+                "raw terminal transition ._finish() outside the "
+                "accounting chokes (_account/_finish_stream/abandon) — "
+                "bypasses request_trace.verdict, the incident ring and "
+                "the latency exemplar; finish through the accounting "
+                "choke instead"))
+        for child in ast.iter_child_nodes(node):
+            _visit(child, fn, terminal_names)
+
+    _visit(tree, None, set())
+    return out
+
+
 def _rule_raw_lock(path: str, rel: str, tree: ast.AST) -> List[Finding]:
     """Direct ``threading.Lock()``/``RLock()``/``Condition()`` construction
     anywhere in the package: every lock must come from
@@ -1050,6 +1157,7 @@ def lint_paths(targets: Sequence[str],
                          _rule_unaccounted_buffer(path, rel, tree) +
                          _rule_host_augment(path, rel, tree) +
                          _rule_fleet_thread(path, rel, tree) +
+                         _rule_untraced_verdict(path, rel, tree) +
                          _rule_raw_lock(path, rel, tree) +
                          _rule_exceptions(path, rel, tree))
         if any(rel.endswith(t) for t in THREADED_FILES):

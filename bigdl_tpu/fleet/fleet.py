@@ -33,6 +33,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from bigdl_tpu import telemetry
+from bigdl_tpu.telemetry import incident, request_trace
 from bigdl_tpu.fleet.autoscale import FleetAutoscalePolicy
 from bigdl_tpu.fleet.replica import Replica
 from bigdl_tpu.fleet.rollout import RolloutReport, run_rollout
@@ -44,6 +45,18 @@ from bigdl_tpu.serving.engine import (OUTCOMES, Overloaded, RequestHandle,
 from bigdl_tpu.utils import config, elastic
 
 logger = logging.getLogger("bigdl_tpu")
+
+
+def _fleet_reject(service: str, reason: str) -> Overloaded:
+    """Fleet-level rejection choke point: requests the fleet turns away
+    never reach an engine's admission door, so the trace is minted AND
+    terminated right here — a rejected submission still explains itself
+    (``err.trace_id`` -> ``request_trace.get``)."""
+    tid = request_trace.mint("fleet", service=service)
+    err = Overloaded(reason)
+    request_trace.verdict(tid, "rejected", error=err,
+                          reason=reason.replace(" ", "_"))
+    return err
 
 
 class _Service:
@@ -160,7 +173,7 @@ class _Service:
                           else "no healthy replicas")
                 telemetry.counter("Fleet/rejected",
                                   labels={"service": self.name}).inc()
-                raise Overloaded(reason)
+                raise _fleet_reject(self.name, reason)
             self._rr += 1
             rep = reps[self._rr % len(reps)]
         try:
@@ -194,6 +207,9 @@ class _Service:
         keep: List[Tuple[RequestHandle, Replica]] = []
         tally: Dict[str, int] = {}
         first_serve_ms = None
+        abandoned = 0
+        abandon_reason: Optional[str] = None
+        abandon_tid: Optional[str] = None
         for h, rep in batch:
             if not h.done():
                 eng = rep.engine
@@ -207,6 +223,11 @@ class _Service:
                         "this request in flight — retriable"),
                         reason="replica_crash" if crashed else
                         "replica_down")
+                    abandoned += 1
+                    abandon_reason = ("replica_crash" if crashed
+                                      else "replica_down")
+                    if abandon_tid is None:
+                        abandon_tid = getattr(h, "trace_id", None)
                     if crashed:
                         telemetry.counter(
                             "Fleet/crash_sheds",
@@ -230,6 +251,15 @@ class _Service:
                     ms = (h.finish_ns - cut_ns) / 1e6
                     if first_serve_ms is None or ms < first_serve_ms:
                         first_serve_ms = ms
+        if abandoned:
+            # the sweep just closed the crash hole — one flight-recorder
+            # event (and at most one bundle per service+cause) for the
+            # whole abandoned cohort, anchored on its first trace
+            incident.record("fleet/abandon", service=self.name,
+                            victims=abandoned, reason=abandon_reason)
+            incident.maybe_dump(f"fleet/{self.name}/{abandon_reason}",
+                                trace_id=abandon_tid,
+                                reason=abandon_reason)
         with self._lock:
             for k, v in tally.items():
                 self._counts[k] += v
@@ -262,6 +292,9 @@ class _Service:
             if used >= max_restarts:
                 telemetry.counter("Fleet/replica_abandoned",
                                   labels={"service": self.name}).inc()
+                incident.record("fleet/slot_abandoned",
+                                service=self.name, replica=rep.name,
+                                slot=rep.slot, restarts=used)
                 logger.error(
                     "fleet %s: replica %s crashed past its restart "
                     "budget (%d) — slot abandoned", self.name, rep.name,
@@ -272,6 +305,9 @@ class _Service:
                 self._restarts[rep.slot] = used + 1
             telemetry.counter("Fleet/replica_restarts",
                               labels={"service": self.name}).inc()
+            incident.record("fleet/replica_restart", service=self.name,
+                            replica=rep.name, slot=rep.slot,
+                            attempt=used + 1, budget=max_restarts)
             logger.warning(
                 "fleet %s: replica %s crashed — restarting slot %d "
                 "(restart %d/%d)", self.name, rep.name, rep.slot,
@@ -325,6 +361,9 @@ class _Service:
             telemetry.counter("Fleet/autoscale_actions",
                               labels={"service": self.name,
                                       "direction": "up"}).inc()
+            incident.record("fleet/autoscale", service=self.name,
+                            direction="up", queue_frac=round(queue_frac, 3),
+                            p99_ms=round(float(p99), 2))
             logger.info("fleet %s: autoscale +1 replica (queue %.2f, "
                         "p99 %.1f ms) -> %d", self.name, queue_frac,
                         p99, len(reps) + 1)
@@ -337,6 +376,9 @@ class _Service:
                 telemetry.counter("Fleet/autoscale_actions",
                                   labels={"service": self.name,
                                           "direction": "down"}).inc()
+                incident.record("fleet/autoscale", service=self.name,
+                                direction="down",
+                                queue_frac=round(queue_frac, 3))
                 logger.info("fleet %s: autoscale -1 replica -> %d",
                             self.name, len(reps) - 1)
         self._publish_replica_gauge()
@@ -387,11 +429,16 @@ class _Service:
                 self._last_promoted = max(n, newest)
             telemetry.counter("Fleet/promotions",
                               labels={"service": self.name}).inc()
+            incident.record("fleet/promotion", service=self.name,
+                            snapshot=n, to_version=report.to_version)
             logger.info("fleet %s: snapshot %d promoted to %s",
                         self.name, n, report.to_version)
         else:
             telemetry.counter("Fleet/promotion_failures",
                               labels={"service": self.name}).inc()
+            incident.record("fleet/promotion_failure",
+                            service=self.name, snapshot=n,
+                            reason=report.reason)
 
     # -- teardown / introspection -----------------------------------------
 
@@ -499,7 +546,7 @@ class Fleet:
         """Route one request to a healthy replica of ``name`` (or raise
         a structured retriable :class:`Overloaded`)."""
         if self._closed:
-            raise Overloaded("fleet stopped")
+            raise _fleet_reject(name, "fleet stopped")
         return self._service(name).submit(payload, deadline_ms)
 
     def _next_submit(self, service: _Service) -> int:
@@ -541,6 +588,12 @@ class Fleet:
             logger.warning("fleet: preemption observed — all services "
                            "draining (replicas self-drain, rollouts "
                            "abort)")
+            # the signal handler itself only appended the ring event
+            # (async-signal-safe); the supervisor thread is where the
+            # flight-recorder bundle is safe to write
+            incident.record("fleet/preemption_drain",
+                            services=sorted(self._services))
+            incident.maybe_dump("preemption", reason="preemption")
             for svc in list(self._services.values()):
                 with svc._lock:
                     svc.draining = True

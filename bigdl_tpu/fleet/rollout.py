@@ -49,6 +49,7 @@ from typing import Any, List, Optional, Tuple
 import numpy as np
 
 from bigdl_tpu import telemetry
+from bigdl_tpu.telemetry import incident
 from bigdl_tpu.utils import config, elastic
 
 logger = logging.getLogger("bigdl_tpu")
@@ -161,6 +162,10 @@ def run_rollout(service, candidate_model,
             telemetry.counter("Fleet/rollbacks",
                               labels={"service": service.name,
                                       "reason": slug}).inc()
+            incident.record("fleet/rollback", service=service.name,
+                            from_version=report.from_version,
+                            to_version=report.to_version, cause=slug,
+                            reason=reason)
             logger.warning("fleet %s: rollout %s -> %s ROLLED BACK (%s) — "
                            "incumbent keeps serving", service.name,
                            report.from_version, report.to_version, reason)
@@ -244,6 +249,11 @@ def run_rollout(service, candidate_model,
         report.cutover_ns = cut_ns
         report.swap_ms = (cut_ns - t0) / 1e6
         report.promoted = True
+        incident.record("fleet/cutover", service=service.name,
+                        from_version=report.from_version,
+                        to_version=report.to_version,
+                        swap_ms=round(report.swap_ms, 2),
+                        parity_checked=report.parity_checked)
         telemetry.counter("Fleet/rollouts",
                           labels={"service": service.name}).inc()
         telemetry.gauge("Fleet/swap_ms").set(report.swap_ms)

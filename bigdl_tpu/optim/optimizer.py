@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from bigdl_tpu import telemetry
+from bigdl_tpu.telemetry import incident
 from bigdl_tpu.engine import DispatchPipeline
 from bigdl_tpu.engine import to_device as _to_device
 from bigdl_tpu.dataset.dataset import AbstractDataSet, LocalDataSet, ShardedDataSet
@@ -463,17 +464,22 @@ class Optimizer:
                     # the OOMed dispatch donated-and-deleted the carries
                     # and there is no snapshot to reload them from
                     raise
+                heal_ms = (time.monotonic() - heal_t0) * 1000.0
                 telemetry.gauge(
                     "Resources/oom_replan_ms",
                     help="device-OOM detection to re-planned-step "
-                         "readiness (re-plan + restore)").set(
-                    (time.monotonic() - heal_t0) * 1000.0)
+                         "readiness (re-plan + restore)").set(heal_ms)
+                incident.record("optim/oom_replan", restored=restored,
+                                heal_ms=round(heal_ms, 2))
                 continue
             except elastic.Preempted:
                 # the driver drained and published before raising; commit
                 # the grace-period snapshot and leave — preemption is an
                 # eviction, not a fault, so no retry and no restore
+                incident.record("optim/preempted",
+                                reason=elastic.preemption_reason())
                 self._commit_preemption_snapshot()
+                incident.maybe_dump("preemption", reason="preemption")
                 raise
             except Exception as e:
                 from bigdl_tpu.integrity import (IntegrityError,
@@ -501,6 +507,11 @@ class Optimizer:
                     high_water, cur)
                 attempt += 1
                 if attempt >= retry_times:
+                    incident.record("optim/retries_exhausted",
+                                    attempt=attempt,
+                                    error=type(e).__name__)
+                    incident.maybe_dump("optim/retries_exhausted",
+                                        reason=type(e).__name__)
                     raise
                 if (isinstance(e, ReplicaDesyncError)
                         and getattr(e, "healed", False)):
@@ -508,6 +519,8 @@ class Optimizer:
                     # from the agreeing majority and rewound the eval
                     # counter — a checkpoint restore would throw away
                     # the surviving replicas' newer, valid ground
+                    incident.record("optim/desync_heal", attempt=attempt,
+                                    error=type(e).__name__)
                     interval = _retry_backoff(attempt, base, cap)
                     logger.warning(
                         "Replica desync healed in place (attempt %d/%d); "
@@ -529,6 +542,8 @@ class Optimizer:
                     # — retrying would fail on deleted buffers, so
                     # surface the original
                     raise
+                incident.record("optim/retry_restore", attempt=attempt,
+                                error=type(e).__name__, restored=restored)
                 interval = _retry_backoff(attempt, base, cap)
                 logger.exception(
                     "Training failed (attempt %d/%d); %s and retrying "
@@ -992,6 +1007,9 @@ class Optimizer:
                     "%d)%s", loss, neval, state["consecutiveBadSteps"],
                     max_bad_steps, culprit)
                 if 0 < max_bad_steps <= state["consecutiveBadSteps"]:
+                    incident.record(
+                        "optim/divergence", iteration=neval,
+                        bad_steps=state["consecutiveBadSteps"])
                     raise DivergenceError(
                         f"{state['consecutiveBadSteps']} consecutive "
                         f"non-finite losses (last at iteration {neval}) — "

@@ -142,10 +142,15 @@ class HostMemoryGovernor:
             shrinkers = list(self._shrinkers.items()) if fired else []
         if fired:
             from bigdl_tpu import telemetry
+            from bigdl_tpu.telemetry import incident
             telemetry.counter(
                 "Resources/host_pressure",
                 help="host-memory pressure excursions (budget or "
                      "injected) that fired the shrinkers").inc()
+            incident.record("governor/shrink",
+                            accounted_bytes=self.total_bytes(),
+                            budget_bytes=self.budget_bytes(),
+                            shrinkers=[name for name, _ in shrinkers])
             logger.warning(
                 "host-memory pressure: %d B accounted vs %d B budget — "
                 "shrinking %d registered buffer(s)", self.total_bytes(),

@@ -50,6 +50,7 @@ import numpy as np
 from bigdl_tpu import analysis, telemetry
 from bigdl_tpu.resources import GOVERNOR as _resource_governor
 from bigdl_tpu.resources import item_nbytes as _item_nbytes
+from bigdl_tpu.telemetry import incident, request_trace
 from bigdl_tpu.utils import elastic
 
 logger = logging.getLogger("bigdl_tpu")
@@ -147,13 +148,15 @@ class RequestHandle:
 
     __slots__ = ("raw", "index", "submit_ns", "deadline_ns", "finish_ns",
                  "outcome", "_result", "_error", "_done", "payload_nbytes",
-                 "_lock")
+                 "_lock", "trace_id")
 
-    def __init__(self, raw, index: int, submit_ns: int, deadline_ns: int):
+    def __init__(self, raw, index: int, submit_ns: int, deadline_ns: int,
+                 trace_id: Optional[str] = None):
         self.raw = raw
         self.index = index            # admission position (chaos plans key on it)
         self.submit_ns = submit_ns
         self.deadline_ns = deadline_ns
+        self.trace_id = trace_id      # None when request tracing is disarmed
         self._lock = analysis.make_lock("serving.handle")
         self.payload_nbytes = 0       # guarded-by: _lock — host bytes charged to the governor
         self.finish_ns: Optional[int] = None            # guarded-by: _lock
@@ -213,6 +216,10 @@ class RequestHandle:
             "request abandoned by its supervisor — retriable")
         if not self._finish("shed", error=err):
             return False
+        # the trace's verdict distinguishes the supervisor-side abort
+        # from an engine-side shed even though both count under "shed"
+        request_trace.verdict(self.trace_id, "aborted", error=err,
+                              reason=reason)
         with self._lock:
             nbytes = self.payload_nbytes
             self.payload_nbytes = 0
@@ -464,29 +471,36 @@ class ServingEngine:
         payload_nbytes = _item_nbytes(inputs)
         _resource_governor.check_item("serving_admission", payload_nbytes)
         telemetry.counter("Serving/submitted").inc()
+        # the trace id is minted at the admission door — BEFORE the
+        # rejection checks, so a rejected request still explains itself
+        tid = request_trace.mint("req", deadline_ms=deadline)
         with self._lock:
             self._counts["submitted"] += 1
             if self._closed or (self._stop_event.is_set() and
                                 not self._draining):
-                raise self._reject_locked("closed")
+                raise self._reject_locked("closed", trace_id=tid)
             if self._draining:
-                raise self._reject_locked("draining")
+                raise self._reject_locked("draining", trace_id=tid)
             if self._cooldown > 0:
-                raise self._reject_locked("cooldown")
+                raise self._reject_locked("cooldown", trace_id=tid)
             depth = self._q.qsize()
             if depth >= self.max_queue_depth:
-                raise self._reject_locked("queue full", depth)
+                raise self._reject_locked("queue full", depth,
+                                          trace_id=tid)
             ema = self._ema.ema
             if ema is not None:
                 waves = math.ceil((depth + 1) / self.max_batch)
                 projected = waves * ema
                 if projected > self.admission_factor * deadline:
                     raise self._reject_locked(
-                        "projected wait", depth, projected_wait_ms=projected,
+                        "projected wait", depth, trace_id=tid,
+                        projected_wait_ms=projected,
                         deadline_ms=deadline)
             req = RequestHandle(inputs, self._next_index, now,
-                                now + int(deadline * 1e6))
+                                now + int(deadline * 1e6), trace_id=tid)
             self._next_index += 1
+        request_trace.instant(tid, "request/admit", index=req.index,
+                              depth=depth)
         # admission-queue bytes: charged while the payload is queued or
         # in flight, released at the terminal state.  Charged BEFORE the
         # enqueue — once the handle is in the queue the batcher owns it,
@@ -507,7 +521,8 @@ class ServingEngine:
             self._payload_acct.sub(payload_nbytes)
             with self._lock:
                 raise self._reject_locked("queue full",
-                                          self.max_queue_depth)
+                                          self.max_queue_depth,
+                                          trace_id=tid)
         if self._closed:
             # the batcher exited between the admission check and the
             # enqueue (it marks _closed BEFORE its final leftover sweep,
@@ -519,17 +534,21 @@ class ServingEngine:
         return req
 
     def _reject_locked(self, reason: str, depth: Optional[int] = None,
-                       **kw) -> Overloaded:
+                       trace_id: Optional[str] = None, **kw) -> Overloaded:
         """Build the structured rejection and account it (caller raises).
-        Runs under ``self._lock``."""
+        Runs under ``self._lock``.  The trace-recording choke point for
+        the ``rejected`` verdict: the error carries its trace id."""
         self._counts["rejected"] += 1
         telemetry.counter("Serving/rejected").inc()
         telemetry.counter("Serving/rejected",
                           labels={"reason": reason.replace(" ", "_")}).inc()
-        return Overloaded(reason,
-                          queue_depth=(depth if depth is not None
-                                       else self._q.qsize()),
-                          max_depth=self.max_queue_depth, **kw)
+        err = Overloaded(reason,
+                         queue_depth=(depth if depth is not None
+                                      else self._q.qsize()),
+                         max_depth=self.max_queue_depth, **kw)
+        request_trace.verdict(trace_id, "rejected", error=err,
+                              reason=reason.replace(" ", "_"))
+        return err
 
     # -- accounting -------------------------------------------------------
 
@@ -568,12 +587,17 @@ class ServingEngine:
             self._payload_acct.sub(nbytes)
         with self._lock:
             self._counts[outcome] += 1
+        # the trace-recording choke point for every engine-side terminal
+        # verdict; a completed tail request becomes a histogram exemplar
+        request_trace.verdict(req.trace_id, outcome, error=error,
+                              reason=reason)
         telemetry.counter(f"Serving/{outcome}").inc()
         if reason:
             telemetry.counter(f"Serving/{outcome}",
                               labels={"reason": reason}).inc()
         if outcome == "completed":
-            self._latency.observe(req.latency_ms())
+            self._latency.observe(req.latency_ms(),
+                                  exemplar=req.trace_id)
         return True
 
     # -- the batcher thread -----------------------------------------------
@@ -670,6 +694,8 @@ class ServingEngine:
         self._drain_deadline = started_at + budget
         self._drain_reason = reason
         self._draining = True
+        incident.record("serving/drain", reason=reason, grace_s=budget,
+                        queued=self._q.qsize())
         logger.info("serving engine draining (%s): grace %.1f s, "
                     "%d request(s) queued", reason, budget,
                     self._q.qsize())
@@ -689,6 +715,7 @@ class ServingEngine:
                 "grace period — retriable")
             shed += self._account(req, "shed", error=err, reason="drained")
         if shed:
+            incident.record("serving/drain_shed", count=shed)
             logger.warning("serving drain shed %d queued request(s)", shed)
         telemetry.gauge("Serving/queue_depth").set(self._q.qsize())
 
@@ -701,9 +728,14 @@ class ServingEngine:
         req: Optional[RequestHandle] = first
         linger_until = (time.monotonic() + self.linger_ms / 1e3
                         if self.linger_ms > 0 else None)
+        dequeued_ns: Dict[int, int] = {}
         while True:
             if req is not None:
                 now = telemetry.clock_ns()
+                request_trace.record_span(req.trace_id,
+                                          "request/queue_wait",
+                                          req.submit_ns, now)
+                dequeued_ns[id(req)] = now
                 if now > req.deadline_ns:
                     waited = (now - req.submit_ns) / 1e6
                     deadline = (req.deadline_ns - req.submit_ns) / 1e6
@@ -715,7 +747,18 @@ class ServingEngine:
                     try:
                         row = self._decode(req, chaos)
                     except ServingDataError as e:
+                        incident.record("serving/quarantine",
+                                        index=req.index,
+                                        error=type(e).__name__)
                         self._account(req, "quarantined", error=e)
+                        # bundle AFTER the verdict so the trace it
+                        # embeds is terminal; the write stalls this
+                        # thread for tens of ms — legitimate work, not
+                        # a wedged dispatch, so the watchdog is paused
+                        with (wd.paused() if wd is not None
+                              else nullcontext()):
+                            incident.maybe_dump("serving/quarantine",
+                                                trace_id=req.trace_id)
                     else:
                         req.raw = row
                         batch.append(req)
@@ -736,6 +779,14 @@ class ServingEngine:
                     req = self._q.get(timeout=remaining)
             except queue.Empty:
                 break
+        if request_trace.enabled() and batch:
+            done = telemetry.clock_ns()
+            for r in batch:
+                t0 = dequeued_ns.get(id(r))
+                if t0 is not None:
+                    request_trace.record_span(r.trace_id,
+                                              "request/coalesce",
+                                              t0, done, size=len(batch))
         telemetry.gauge("Serving/queue_depth").set(self._q.qsize())
 
     def _decode(self, req: RequestHandle, chaos) -> np.ndarray:
@@ -781,11 +832,19 @@ class ServingEngine:
         return compile_cache.slice_rows(out, n)
 
     def _dispatch_batch(self, batch: List[RequestHandle], wd) -> None:
-        from bigdl_tpu.utils import chaos
+        from bigdl_tpu.utils import chaos, compile_cache
         t0 = telemetry.clock_ns()
         self.batches += 1
         chaos.on_dispatch(f"batch {self.batches}")
         out = self._run_forward(np.stack([r.raw for r in batch]))
+        if request_trace.enabled():
+            t1 = telemetry.clock_ns()
+            padded = compile_cache.bucket_size(len(batch), self._buckets)
+            for req in batch:
+                request_trace.record_span(
+                    req.trace_id, "request/dispatch", t0, t1,
+                    batch=self.batches, rows=len(batch),
+                    pad_to_bucket=padded)
         import jax
         for i, req in enumerate(batch):
             row_out = jax.tree_util.tree_map(lambda x, _i=i: x[_i], out)
@@ -818,6 +877,10 @@ class ServingEngine:
             self._account(r, "shed", error=type(error)(*error.args),
                           reason=reason)
             for r in batch)
+        incident.record("serving/abort_inflight", reason=reason,
+                        victims=failed, error=type(error).__name__)
+        incident.maybe_dump(f"serving/{reason}",
+                            trace_id=batch[0].trace_id if batch else None)
         if cool:
             with self._lock:
                 self._cooldown = max(self._cooldown, self.cooldown_batches)

@@ -62,6 +62,7 @@ import numpy as np
 from bigdl_tpu import analysis, telemetry
 from bigdl_tpu.resources import GOVERNOR as _resource_governor
 from bigdl_tpu.resources import item_nbytes as _item_nbytes
+from bigdl_tpu.telemetry import incident, request_trace
 from bigdl_tpu.serving.engine import (OUTCOMES, DeadlineExceeded,
                                       HungDispatchError,
                                       HungDispatchWatchdog, Overloaded,
@@ -424,13 +425,15 @@ class TokenStream:
     __slots__ = ("prompt", "index", "seq_id", "max_new_tokens", "eos_id",
                  "submit_ns", "deadline_ns", "first_token_ns", "finish_ns",
                  "outcome", "payload_nbytes", "_tokens", "_error",
-                 "_terminal", "_cv")
+                 "_terminal", "_cv", "trace_id")
 
     def __init__(self, prompt, index: int, submit_ns: int, deadline_ns: int,
-                 max_new_tokens: int, eos_id: Optional[int]):
+                 max_new_tokens: int, eos_id: Optional[int],
+                 trace_id: Optional[str] = None):
         self.prompt = prompt
         self.index = index          # admission position (chaos plans key on it)
         self.seq_id = index         # KV-cache sequence id
+        self.trace_id = trace_id    # None when request tracing is disarmed
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = eos_id
         self.submit_ns = submit_ns
@@ -929,25 +932,31 @@ class LMServingEngine:
         payload_nbytes = _item_nbytes(prompt)
         _resource_governor.check_item("lm_admission", payload_nbytes)
         telemetry.counter("LM/submitted").inc()
+        # trace id minted at the admission door — BEFORE the rejection
+        # checks, so a rejected prompt still explains itself
+        tid = request_trace.mint("lm", deadline_ms=deadline,
+                                 max_new_tokens=max_new)
         with self._lock:
             self._counts["submitted"] += 1
             if self._closed or (self._stop_event.is_set() and
                                 not self._draining):
-                raise self._reject_locked("closed")
+                raise self._reject_locked("closed", trace_id=tid)
             if self._draining:
-                raise self._reject_locked("draining")
+                raise self._reject_locked("draining", trace_id=tid)
             if self._cooldown > 0:
-                raise self._reject_locked("cooldown")
+                raise self._reject_locked("cooldown", trace_id=tid)
             depth = self._q.qsize() + len(self._pending)
             if depth >= self.max_queue_depth:
-                raise self._reject_locked("queue full", depth)
+                raise self._reject_locked("queue full", depth,
+                                          trace_id=tid)
             n = getattr(prompt, "shape", None)
             n = (int(np.prod(n)) if n is not None
                  else len(prompt) if hasattr(prompt, "__len__") else None)
             if (n is not None and self.cache.blocks_for(n + max_new) >
                     self.cache.allocatable_blocks):
                 # can NEVER be scheduled: larger than the entire pool
-                raise self._reject_locked("kv blocks exhausted", depth)
+                raise self._reject_locked("kv blocks exhausted", depth,
+                                          trace_id=tid)
             if self.admission_factor > 0:
                 ema = self._ema.ema
                 if ema is not None:
@@ -955,13 +964,15 @@ class LMServingEngine:
                     projected = waves * ema * max_new
                     if projected > self.admission_factor * deadline:
                         raise self._reject_locked(
-                            "projected wait", depth,
+                            "projected wait", depth, trace_id=tid,
                             projected_wait_ms=projected,
                             deadline_ms=deadline)
             stream = TokenStream(prompt, self._next_index, now,
                                  now + int(deadline * 1e6), max_new,
-                                 eos_id)
+                                 eos_id, trace_id=tid)
             self._next_index += 1
+        request_trace.instant(tid, "request/admit", index=stream.index,
+                              depth=depth)
         # charged BEFORE the enqueue — once the stream is in the queue
         # the scheduler owns it, and a completion racing a post-enqueue
         # charge would read payload_nbytes == 0 and leak the accounting
@@ -976,7 +987,8 @@ class LMServingEngine:
             self._payload_acct.sub(payload_nbytes)
             with self._lock:
                 raise self._reject_locked("queue full",
-                                          self.max_queue_depth)
+                                          self.max_queue_depth,
+                                          trace_id=tid)
         if self._closed:
             # scheduler exited between the admission check and the
             # enqueue (it marks _closed BEFORE its final sweep) — shed
@@ -986,15 +998,18 @@ class LMServingEngine:
         return stream
 
     def _reject_locked(self, reason: str, depth: Optional[int] = None,
-                       **kw) -> Overloaded:
+                       trace_id: Optional[str] = None, **kw) -> Overloaded:
         self._counts["rejected"] += 1
         telemetry.counter("LM/rejected").inc()
         telemetry.counter("LM/rejected",
                           labels={"reason": reason.replace(" ", "_")}).inc()
-        return Overloaded(reason,
-                          queue_depth=(depth if depth is not None
-                                       else self.queue_depth()),
-                          max_depth=self.max_queue_depth, **kw)
+        err = Overloaded(reason,
+                         queue_depth=(depth if depth is not None
+                                      else self.queue_depth()),
+                         max_depth=self.max_queue_depth, **kw)
+        request_trace.verdict(trace_id, "rejected", error=err,
+                              reason=reason.replace(" ", "_"))
+        return err
 
     def _validate(self, stream: TokenStream, chaos) -> np.ndarray:
         """Per-request prompt validation — the taxonomy choke point:
@@ -1038,12 +1053,17 @@ class LMServingEngine:
             self._payload_acct.sub(nbytes)
         with self._lock:
             self._counts[outcome] += 1
+        # the trace-recording choke point for every LM terminal verdict;
+        # a completed tail stream becomes a latency-histogram exemplar
+        request_trace.verdict(stream.trace_id, outcome, error=error,
+                              reason=reason)
         telemetry.counter(f"LM/{outcome}").inc()
         if reason:
             telemetry.counter(f"LM/{outcome}",
                               labels={"reason": reason}).inc()
         if outcome == "completed":
-            self._latency.observe(stream.latency_ms())
+            self._latency.observe(stream.latency_ms(),
+                                  exemplar=stream.trace_id)
         return True
 
     def stats(self) -> Dict[str, Any]:
@@ -1166,6 +1186,8 @@ class LMServingEngine:
         self._drain_deadline = started_at + budget
         self._drain_reason = reason
         self._draining = True
+        incident.record("lm/drain", reason=reason, grace_s=budget,
+                        queued=self.queue_depth())
         logger.info("LM engine draining (%s): grace %.1f s, %d queued, "
                     "%d active", reason, budget, self.queue_depth(),
                     sum(s is not None for s in self._slots))
@@ -1194,6 +1216,7 @@ class LMServingEngine:
                 shed += self._finish_stream(stream, "shed", error=err,
                                             reason="drained")
         if shed:
+            incident.record("lm/drain_shed", count=shed)
             logger.warning("LM drain shed %d queued stream(s)", shed)
         telemetry.gauge("LM/queue_depth").set(self.queue_depth())
 
@@ -1204,11 +1227,14 @@ class LMServingEngine:
         concurrent ``result()`` raises on a shared object would
         interleave tracebacks across client threads."""
         failed = 0
+        first_trace: Optional[str] = None
         for i, slot in enumerate(self._slots):
             if slot is None:
                 continue
             self._slots[i] = None
             self.cache.free_seq(slot.stream.seq_id)
+            if first_trace is None:
+                first_trace = slot.stream.trace_id
             failed += self._finish_stream(
                 slot.stream, "shed", error=type(error)(*error.args),
                 reason=reason)
@@ -1220,6 +1246,8 @@ class LMServingEngine:
             # slot sweep above is harmless.
             self._admitting = None
             self.cache.free_seq(stream.seq_id)
+            if first_trace is None:
+                first_trace = stream.trace_id
             failed += self._finish_stream(
                 stream, "shed", error=type(error)(*error.args),
                 reason=reason)
@@ -1227,6 +1255,9 @@ class LMServingEngine:
             with self._lock:
                 self._cooldown = max(self._cooldown, self.cooldown_steps)
         if failed:
+            incident.record("lm/shed_active", reason=reason,
+                            victims=failed, error=type(error).__name__)
+            incident.maybe_dump(f"lm/{reason}", trace_id=first_trace)
             logger.error(
                 "LM decode aborted (%s): %d in-flight stream(s) failed "
                 "with %s%s", reason, failed, type(error).__name__,
@@ -1259,6 +1290,9 @@ class LMServingEngine:
             # resting point; double-finish below is a guarded no-op)
             self._admitting = stream
             now = telemetry.clock_ns()
+            request_trace.record_span(stream.trace_id,
+                                      "request/queue_wait",
+                                      stream.submit_ns, now)
             if now > stream.deadline_ns:
                 waited = (now - stream.submit_ns) / 1e6
                 deadline = (stream.deadline_ns - stream.submit_ns) / 1e6
@@ -1271,7 +1305,16 @@ class LMServingEngine:
             try:
                 prompt = self._validate(stream, chaos)
             except ServingDataError as e:
+                incident.record("lm/quarantine", index=stream.index,
+                                error=type(e).__name__)
                 self._finish_stream(stream, "quarantined", error=e)
+                # bundle AFTER the verdict so the trace it embeds is
+                # terminal; the write stalls the scheduler for tens of
+                # ms — legitimate work, not a hung decode step, so the
+                # watchdog is paused or it would fire a spurious abort
+                with (wd.paused() if wd is not None else nullcontext()):
+                    incident.maybe_dump("lm/quarantine",
+                                        trace_id=stream.trace_id)
                 self._admitting = None
                 continue
             if not self.cache.can_allocate(prompt.size +
@@ -1282,6 +1325,7 @@ class LMServingEngine:
                 return
             self.cache.allocate(stream.seq_id,
                                 prompt.size + stream.max_new_tokens)
+            t_pf = telemetry.clock_ns()
             try:
                 tok, table_row = self._prefill_step_raw(stream.seq_id,
                                                         prompt)
@@ -1293,8 +1337,14 @@ class LMServingEngine:
                 continue
             if wd is not None:
                 wd.heartbeat()
+            request_trace.record_span(stream.trace_id, "request/prefill",
+                                      t_pf, telemetry.clock_ns(),
+                                      prompt_tokens=int(prompt.size))
             stream._emit(tok)
-            self._ttft.observe(stream.ttft_ms())
+            request_trace.instant(stream.trace_id, "request/emit",
+                                  token=int(tok), first=True)
+            self._ttft.observe(stream.ttft_ms(),
+                               exemplar=stream.trace_id)
             telemetry.counter("LM/tokens").inc()
             self.tokens_out += 1
             if ((stream.eos_id is not None and tok == stream.eos_id) or
@@ -1350,14 +1400,19 @@ class LMServingEngine:
             victim = next((i for i, s in enumerate(self._slots)
                            if s is not None), None)
             if victim is not None:
+                # finish-FIRST: the watchdog's async abort sweeps slots
+                # and _admitting only — a stream finished before its
+                # slot clears is a guarded no-op for the sweep, but a
+                # slot cleared before the finish would strand the stream
+                # unaccounted forever
                 slot = self._slots[victim]
-                self._slots[victim] = None
-                self.cache.free_seq(slot.stream.seq_id)
                 self._finish_stream(slot.stream, "shed",
                                     error=ServingInfraError(
                                         "chaos: kv blocks evicted under "
                                         "an active sequence — retriable"),
                                     reason="evicted")
+                self._slots[victim] = None
+                self.cache.free_seq(slot.stream.seq_id)
             if not self._any_active():
                 return
         t0 = telemetry.clock_ns()
@@ -1392,25 +1447,33 @@ class LMServingEngine:
             self._itl.observe((now - slot.last_emit_ns) / 1e6)
             slot.last_emit_ns = now
             stream._emit(tok)
+            request_trace.record_span(stream.trace_id,
+                                      "request/decode_step", t0, now,
+                                      step=step, token=tok)
             telemetry.counter("LM/tokens").inc()
             self.tokens_out += 1
             if ((stream.eos_id is not None and tok == stream.eos_id) or
                     slot.generated >= stream.max_new_tokens):
+                # finish-FIRST (same discipline as the eviction branch):
+                # an async watchdog abort landing between these lines
+                # must find either an occupied slot (sweep accounts it)
+                # or a finished stream (sweep no-ops) — never a cleared
+                # slot with an unaccounted stream
+                self._finish_stream(stream, "completed")
                 self._slots[i] = None
                 self.cache.free_seq(stream.seq_id)
-                self._finish_stream(stream, "completed")
             elif now > stream.deadline_ns:
                 # mid-stream expiry AFTER emitting: the streamed prefix
                 # stays with the client, the terminal error says why it
                 # stopped — the partially-streamed-then-failed shape
-                self._slots[i] = None
-                self.cache.free_seq(stream.seq_id)
                 waited = (now - stream.submit_ns) / 1e6
                 deadline = (stream.deadline_ns - stream.submit_ns) / 1e6
                 self._finish_stream(
                     stream, "shed",
                     error=DeadlineExceeded(waited, deadline),
                     reason="expired")
+                self._slots[i] = None
+                self.cache.free_seq(stream.seq_id)
         ms = (telemetry.clock_ns() - t0) / 1e6
         self._ema.observe(ms)
         if wd is not None:

@@ -27,8 +27,17 @@ archaeology.  Three pillars, one package:
    snapshot, and a Prometheus text dump.  The pre-existing ``Ingest/*``
    and ``Analysis/*`` scalars route through it with unchanged tags.
 
-Configuration (``bigdl.telemetry.*`` in ``utils/config.py``); the
-knob table lives in ``docs/programming-guide/optimization.md``.
+Two forensic layers ride the pillars: per-request distributed tracing
+(:mod:`~bigdl_tpu.telemetry.request_trace` — a trace id per serving/LM/
+fleet submission, a causally-ordered span chain ending in the terminal
+verdict, histogram exemplars for tail-latency lookup) and the incident
+flight recorder (:mod:`~bigdl_tpu.telemetry.incident` — a bounded
+structured-event ring plus one self-contained bundle per terminal
+fault).
+
+Configuration (``bigdl.telemetry.*`` / ``bigdl.trace.*`` /
+``bigdl.incident.*`` in ``utils/config.py``); the knob table lives in
+``docs/programming-guide/optimization.md``.
 """
 
 from __future__ import annotations
@@ -43,6 +52,7 @@ from bigdl_tpu.telemetry.metrics import (Counter, Gauge, Histogram,
 from bigdl_tpu.telemetry.step_stats import (PARTS, SlowStepDetector,
                                             StepAccount, WindowedPercentiles,
                                             step_flops)
+from bigdl_tpu.telemetry import incident, request_trace
 
 
 def counter(name, labels=None, summary=False, help=""):
@@ -77,4 +87,6 @@ __all__ = [
     # step stats
     "PARTS", "StepAccount", "WindowedPercentiles", "SlowStepDetector",
     "step_flops",
+    # per-request tracing + incident flight recorder
+    "request_trace", "incident",
 ]

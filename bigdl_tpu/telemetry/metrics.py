@@ -29,6 +29,7 @@ never contend on the registry itself.
 
 from __future__ import annotations
 
+import bisect
 import json
 import logging
 import math
@@ -61,6 +62,25 @@ def _prom_name(name: str) -> str:
     illegal character to ``_`` (``Ingest/read/throughput`` →
     ``Ingest_read_throughput``)."""
     return _PROM_BAD.sub("_", name)
+
+
+def _prom_escape(value) -> str:
+    """Label-VALUE escaping per the exposition format: backslash, double
+    quote, and newline must be escaped inside ``{k="v"}``."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+#: default histogram bucket upper bounds — a log-ish ladder sized for the
+#: millisecond-latency histograms this registry actually holds
+#: (``Serving/latency_ms``, ``LM/ttft_ms``, ``Telemetry/step_latency_ms``);
+#: the +Inf bucket is implicit
+DEFAULT_BUCKETS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                   500.0, 1000.0, 2500.0, 5000.0, 10000.0)
+
+#: exemplar trace ids retained per histogram: the K largest observations
+#: that carried one (tail-bucket forensics — "show me a p99 request")
+MAX_EXEMPLARS = 8
 
 
 class _Metric:
@@ -122,20 +142,29 @@ class Histogram(_Metric):
     stream plus exact percentiles over the most recent ``window``
     observations (the rolling-window estimator the step-latency
     p50/p95/p99 ride on — see :class:`~bigdl_tpu.telemetry.step_stats.
-    WindowedPercentiles` for the standalone form)."""
+    WindowedPercentiles` for the standalone form).  Also keeps
+    Prometheus-conformant cumulative bucket counts (fixed ``le`` ladder
+    plus the implicit ``+Inf``) and bounded **exemplars**: observations
+    tagged with a request trace id retain the ``MAX_EXEMPLARS`` largest
+    ``(value, trace_id)`` pairs, so a tail-bucket latency resolves to a
+    real request in one lookup (:meth:`tail_exemplar`)."""
 
     kind = "histogram"
 
     def __init__(self, name, labels=None, summary=False, help="",
-                 window: int = 512):
+                 window: int = 512, buckets=DEFAULT_BUCKETS):
         super().__init__(name, labels, summary, help)
         self.count = 0
         self.sum = 0.0
         self.min = math.inf
         self.max = -math.inf
         self._window: deque = deque(maxlen=max(1, int(window)))
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        # one slot per finite bound + the +Inf slot; rendered cumulative
+        self._bucket_counts = [0] * (len(self.buckets) + 1)
+        self._exemplars: List[Tuple[float, str]] = []
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None) -> None:
         value = float(value)
         with self._lock:
             self.count += 1
@@ -143,6 +172,36 @@ class Histogram(_Metric):
             self.min = min(self.min, value)
             self.max = max(self.max, value)
             self._window.append(value)
+            self._bucket_counts[bisect.bisect_left(self.buckets,
+                                                   value)] += 1
+            if exemplar is not None:
+                self._exemplars.append((value, exemplar))
+                if len(self._exemplars) > MAX_EXEMPLARS:
+                    self._exemplars.sort(key=lambda p: p[0], reverse=True)
+                    del self._exemplars[MAX_EXEMPLARS:]
+
+    def bucket_counts(self) -> List[Tuple[float, int]]:
+        """Cumulative ``(le_bound, count)`` pairs, ``math.inf`` last —
+        exactly what the ``_bucket{le=...}`` series render."""
+        with self._lock:
+            raw = list(self._bucket_counts)
+        out, running = [], 0
+        for bound, n in zip(self.buckets + (math.inf,), raw):
+            running += n
+            out.append((bound, running))
+        return out
+
+    def exemplars(self) -> List[Tuple[float, str]]:
+        """Retained ``(value, trace_id)`` pairs, largest value first."""
+        with self._lock:
+            return sorted(self._exemplars, key=lambda p: p[0],
+                          reverse=True)
+
+    def tail_exemplar(self) -> Optional[str]:
+        """The trace id of the largest observation that carried one —
+        the "show me a p99 request" entry point."""
+        ex = self.exemplars()
+        return ex[0][1] if ex else None
 
     def percentile(self, q: float) -> float:
         """Exact percentile (numpy's linear interpolation) over the
@@ -165,8 +224,12 @@ class Histogram(_Metric):
             out = {"count": self.count, "sum": self.sum,
                    "min": self.min, "max": self.max,
                    "mean": self.sum / self.count}
+            exemplars = sorted(self._exemplars, key=lambda p: p[0],
+                               reverse=True)
         for q in (50, 95, 99):
             out[f"p{q}"] = self.percentile(q)
+        if exemplars:
+            out["exemplars"] = [[v, tid] for v, tid in exemplars]
         return out
 
 
@@ -291,38 +354,47 @@ class MetricsRegistry:
         return snap
 
     def prometheus_text(self) -> str:
-        """The registry in Prometheus exposition text format (names
-        sanitized, labels rendered as ``{k="v"}``); histograms emit
-        ``_count``/``_sum`` plus quantile gauges."""
+        """The registry in Prometheus exposition text format: names
+        sanitized, label VALUES escaped (backslash / quote / newline),
+        one ``# TYPE`` line per metric; histograms emit the conformant
+        cumulative ``_bucket{le=...}`` series ending at ``le="+Inf"``
+        plus ``_sum``/``_count``."""
         with self._lock:
             metrics = list(self._metrics.values())
             providers = list(self._providers.items())
         lines: List[str] = []
+        typed: set = set()
 
         def fmt(name, labels, value):
             if labels:
-                inner = ",".join(f'{_prom_name(k)}="{labels[k]}"'
-                                 for k in sorted(labels))
+                inner = ",".join(
+                    f'{_prom_name(k)}="{_prom_escape(labels[k])}"'
+                    for k in sorted(labels))
                 return f"{name}{{{inner}}} {value}"
             return f"{name} {value}"
 
+        def type_line(pname, kind, help_text):
+            # one # TYPE (and at most one # HELP) per metric name even
+            # when label variants share it — the format forbids repeats
+            if pname in typed:
+                return
+            typed.add(pname)
+            if help_text:
+                lines.append(f"# HELP {pname} {help_text}")
+            lines.append(f"# TYPE {pname} {kind}")
+
         for m in metrics:
             pname = _prom_name(m.name)
-            if m.help:
-                lines.append(f"# HELP {pname} {m.help}")
+            type_line(pname, m.kind, m.help)
             if isinstance(m, Histogram):
-                lines.append(f"# TYPE {pname} summary")
-                st = m.stats()
-                for q in (50, 95, 99):
-                    pq = st.get(f"p{q}")
-                    if pq is not None and not math.isnan(pq):
-                        labels = dict(m.labels or {})
-                        labels["quantile"] = f"0.{q}"
-                        lines.append(fmt(pname, labels, pq))
-                lines.append(fmt(f"{pname}_count", m.labels, st["count"]))
-                lines.append(fmt(f"{pname}_sum", m.labels, st["sum"]))
+                for bound, cum in m.bucket_counts():
+                    labels = dict(m.labels or {})
+                    labels["le"] = ("+Inf" if math.isinf(bound)
+                                    else repr(bound))
+                    lines.append(fmt(f"{pname}_bucket", labels, cum))
+                lines.append(fmt(f"{pname}_sum", m.labels, m.sum))
+                lines.append(fmt(f"{pname}_count", m.labels, m.count))
             else:
-                lines.append(f"# TYPE {pname} {m.kind}")
                 lines.append(fmt(pname, m.labels, m.value))
         for name, fn in providers:
             for tag, v in fn():

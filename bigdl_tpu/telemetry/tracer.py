@@ -253,6 +253,11 @@ def export_chrome_trace(path: Optional[str] = None) -> dict:
             if args:
                 ev["args"] = args
             trace_events.append(ev)
+    # per-request lanes (telemetry/request_trace.py) merge into the same
+    # timeline under their own "requests" process row; lazy import —
+    # request_trace imports this module at its top
+    from bigdl_tpu.telemetry import request_trace
+    trace_events.extend(request_trace.chrome_events(epoch))
     doc = {"traceEvents": trace_events, "displayTimeUnit": "ms"}
     if path is not None:
         with open(path, "w") as f:

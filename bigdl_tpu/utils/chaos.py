@@ -228,6 +228,7 @@ attribute check, so production paths pay nothing.
 
 from __future__ import annotations
 
+import sys as _sys
 import threading
 from typing import Optional, Tuple
 
@@ -236,6 +237,17 @@ class ChaosError(IOError):
     """An injected storage/step fault.  Subclasses ``IOError`` so the
     production code paths cannot tell it from a real infrastructure
     failure — that is the point."""
+
+
+def _incident_note(kind: str, **fields) -> None:
+    """Flight-recorder note at each injection: the incident bundle's
+    event ring must NAME the fault a run was subjected to, or the
+    forensics read as a spontaneous failure.  Lazy lookup (never an
+    import) — chaos sits below telemetry in the import DAG, and a
+    disabled/absent recorder must cost nothing here."""
+    mod = _sys.modules.get("bigdl_tpu.telemetry.incident")
+    if mod is not None:
+        mod.record(f"chaos/{kind}", **fields)
 
 
 class _ChaosState:
@@ -548,6 +560,8 @@ class _ChaosState:
             with self._lock:
                 fire = index not in self.poison_fired
                 self.poison_fired.add(index)
+            if fire:
+                _incident_note("poison_request", index=index)
             return fire
         return False
 
@@ -568,6 +582,8 @@ class _ChaosState:
                 self.dispatch_hangs = 1
         if fire:
             import time
+            _incident_note("hang_dispatch", dispatch=self.dispatches,
+                           seconds=self.hang_dispatch_seconds)
             end = time.monotonic() + self.hang_dispatch_seconds
             while time.monotonic() < end:
                 time.sleep(0.02)
@@ -582,6 +598,8 @@ class _ChaosState:
             with self._lock:
                 fire = index not in self.prompt_poison_fired
                 self.prompt_poison_fired.add(index)
+            if fire:
+                _incident_note("poison_prompt", index=index)
             return fire
         return False
 
@@ -601,6 +619,8 @@ class _ChaosState:
                 self.decode_hangs = 1
         if fire:
             import time
+            _incident_note("hang_decode", step=step,
+                           seconds=self.hang_decode_seconds)
             end = time.monotonic() + self.hang_decode_seconds
             while time.monotonic() < end:
                 time.sleep(0.02)
@@ -617,6 +637,8 @@ class _ChaosState:
                     self.block_evictions == 0)
             if fire:
                 self.block_evictions = 1
+        if fire:
+            _incident_note("evict_block", step=step)
         return fire
 
     def burst_arrivals(self, index: int) -> int:
@@ -726,6 +748,8 @@ class _ChaosState:
             if fire:
                 self.oom_fired = 1
         if fire:
+            _incident_note("oom_step", label=label,
+                           dispatch=self.step_dispatches)
             raise RuntimeError(
                 "RESOURCE_EXHAUSTED: Out of memory while trying to "
                 f"allocate 17179869184 bytes (chaos: injected device "
@@ -756,6 +780,7 @@ class _ChaosState:
                     fire = True
                     break
         if fire:
+            _incident_note("disk_full", path=path)
             raise OSError(errno.ENOSPC,
                           f"No space left on device (chaos: injected "
                           f"disk-full writing {path})")
@@ -771,6 +796,8 @@ class _ChaosState:
                     self.pressure_fired == 0)
             if fire:
                 self.pressure_fired = 1
+        if fire:
+            _incident_note("host_mem_pressure", poll=poll_index)
         return fire
 
     # ---- fleet-control-plane hooks -------------------------------------
@@ -787,6 +814,9 @@ class _ChaosState:
                     self.replica_kills == 0)
             if fire:
                 self.replica_kills = 1
+        if fire:
+            _incident_note("kill_replica", submits=submits,
+                           replica=self.kill_replica_index)
         return self.kill_replica_index if fire else None
 
     def corrupt_candidate(self, model) -> bool:
@@ -807,6 +837,8 @@ class _ChaosState:
                 self.candidate_corruptions = 1
         if not fire:
             return False
+        _incident_note("corrupt_candidate",
+                       candidate=self.candidates_prepared)
         return _corrupt_first_float(model)
 
     def sigterm_fleet(self, submits: int) -> bool:
@@ -824,6 +856,7 @@ class _ChaosState:
                 self.fleet_sigterms = 1
         if fire:
             from bigdl_tpu.utils import elastic
+            _incident_note("sigterm_fleet", submits=submits)
             elastic.request_preemption("chaos: injected fleet-wide SIGTERM")
         return fire
 

@@ -279,6 +279,24 @@ _DEFAULTS: Dict[str, Any] = {
     "bigdl.chaos.starveStageAt": None,   # "stage:k" / "stage:k:seconds":
     # the named ingest stage throttles from its k-th item for the window —
     # downstream stages starve; autoscaler acceptance prey
+    # per-request distributed tracing (telemetry/request_trace.py)
+    "bigdl.trace.requests": False,       # mint a trace id per serving/LM/fleet
+    # submission; span chain + terminal verdict per request
+    "bigdl.trace.maxTraces": 2048,       # retained traces (oldest evicted first)
+    "bigdl.trace.maxSpansPerTrace": 512,  # per-trace span bound (then truncated flag)
+    # incident flight recorder (telemetry/incident.py)
+    "bigdl.incident.ringSize": 512,      # bounded structured-event ring capacity
+    "bigdl.incident.maxDumps": 8,        # bundle files per run, oldest-first
+    # eviction; 0 disables bundle writes entirely
+    "bigdl.incident.dir": None,          # bundle directory; None = CWD
+    "bigdl.incident.autoDump": True,     # write one bundle per terminal fault slug
+    # driver log file (utils/logger_filter.py): size-capped rotation so a
+    # long run cannot grow bigdl.log without bound
+    "bigdl.utils.LoggerFilter.disable": False,      # leave logging untouched
+    "bigdl.utils.LoggerFilter.enableSparkLog": True,  # redirect chatty infra logs
+    "bigdl.utils.LoggerFilter.logFile": None,       # None = <CWD>/bigdl.log
+    "bigdl.utils.LoggerFilter.maxBytes": 10485760,  # rotate past 10 MiB
+    "bigdl.utils.LoggerFilter.backupCount": 2,      # rotated files retained
 }
 
 _OVERRIDES: Dict[str, Any] = {}
@@ -328,3 +346,20 @@ def clear_property(name: str) -> None:
 def known_properties() -> Dict[str, Any]:
     """The full table with current values (for diagnostics)."""
     return {k: get_property(k) for k in _DEFAULTS}
+
+
+def non_default_properties() -> Dict[str, Any]:
+    """Every property whose effective value differs from the table
+    default — programmatic overrides, ``BIGDL_*`` environment settings,
+    and override keys outside the table.  The incident bundle embeds
+    exactly this (the *effective* configuration an operator must know
+    to explain a run, without the 200-line full table)."""
+    out: Dict[str, Any] = {}
+    for name, default in _DEFAULTS.items():
+        value = get_property(name)
+        if value != default and not (value is None and default is None):
+            out[name] = value
+    for name, value in _OVERRIDES.items():
+        if name not in _DEFAULTS:
+            out[name] = value
+    return out

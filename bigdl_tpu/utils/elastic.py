@@ -60,6 +60,7 @@ from __future__ import annotations
 
 import logging
 import os
+import sys
 import threading
 import time
 from contextlib import contextmanager
@@ -249,6 +250,13 @@ def request_preemption(reason: str = "signal") -> None:
     # the grace clock starts HERE: the drain the driver runs before the
     # final snapshot (pipeline flush + publish) spends the same window
     _PREEMPT["at"] = time.monotonic()
+    # flight-recorder note: one GIL-atomic deque append, no locks/IO —
+    # still signal-safe.  The bundle itself is written later by whoever
+    # observes the flag (fleet supervisor tick / optimizer Preempted
+    # branch), never from here.
+    mod = sys.modules.get("bigdl_tpu.telemetry.incident")
+    if mod is not None:
+        mod.record("preemption/requested", reason=reason)
 
 
 def preemption_requested() -> bool:

@@ -9,11 +9,16 @@ Properties (reference ``bigdl.utils.LoggerFilter.*``):
 - ``bigdl.utils.LoggerFilter.logFile``    — path (default ./bigdl.log)
 - ``bigdl.utils.LoggerFilter.enableSparkLog`` — here: whether chatty
   third-party loggers (jax/tensorflow) also go to the file
+- ``bigdl.utils.LoggerFilter.maxBytes``   — rotate the log file once it
+  reaches this size (default 10 MiB; 0 disables rotation)
+- ``bigdl.utils.LoggerFilter.backupCount`` — rotated generations kept
+  (default 2: ``bigdl.log.1``, ``bigdl.log.2``)
 """
 
 from __future__ import annotations
 
 import logging
+import logging.handlers
 import os
 from typing import Optional, Sequence
 
@@ -38,7 +43,17 @@ def redirect_spark_info_logs(log_file: Optional[str] = None,
 
     fmt = logging.Formatter(
         "%(asctime)s %(levelname)s %(name)s - %(message)s")
-    file_handler = logging.FileHandler(path)
+    # size-capped rotation: a long-lived serving process must not grow
+    # an unbounded bigdl.log (maxBytes=0 restores the unbounded append)
+    max_bytes = config.get_int("bigdl.utils.LoggerFilter.maxBytes",
+                               10 * 1024 * 1024)
+    backups = config.get_int("bigdl.utils.LoggerFilter.backupCount", 2)
+    if max_bytes > 0:
+        file_handler: logging.Handler = \
+            logging.handlers.RotatingFileHandler(
+                path, maxBytes=max_bytes, backupCount=max(0, backups))
+    else:
+        file_handler = logging.FileHandler(path)
     file_handler.setLevel(logging.INFO)
     file_handler.setFormatter(fmt)
 

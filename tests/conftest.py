@@ -122,6 +122,24 @@ def _telemetry_armed():
 
 
 @pytest.fixture(autouse=True)
+def _forensics_isolated():
+    """Per-test isolation for the forensic layers: request traces and
+    the incident event ring are dropped after each test, and automatic
+    incident-bundle dumps are disabled (a chaos test shedding requests
+    must not litter incident-*.json into the CWD — tests that assert on
+    bundles opt back in or call incident.dump() themselves)."""
+    from bigdl_tpu.telemetry import incident, request_trace
+    from bigdl_tpu.utils import config
+
+    config.set_property("bigdl.incident.autoDump", False)
+    yield
+    request_trace.disarm()
+    request_trace.reset()
+    incident.reset()
+    config.clear_property("bigdl.incident.autoDump")
+
+
+@pytest.fixture(autouse=True)
 def _hang_guard(request):
     """Per-test hard timeout without pytest-timeout (not installed in
     this image): SIGALRM fails the test at 1200 s — generous enough for

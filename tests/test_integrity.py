@@ -307,6 +307,46 @@ class TestIngestDocDrift:
             f"ingest.py reads unregistered keys: {sorted(unknown)}"
 
 
+class TestForensicsDocDrift:
+    """Every ``bigdl.trace.*`` / ``bigdl.incident.*`` /
+    ``bigdl.utils.LoggerFilter.*`` key the code registers must have a
+    row in docs/configuration.md — and vice versa (the forensic layer's
+    knobs ride the same both-ways drift guard as the chaos keys)."""
+
+    _PATTERNS = (
+        re.compile(r"bigdl\.trace\.[A-Za-z0-9]+"),
+        re.compile(r"bigdl\.incident\.[A-Za-z0-9]+"),
+        re.compile(r"bigdl\.utils\.LoggerFilter\.[A-Za-z0-9]+"),
+    )
+
+    def _keys_in(self, *parts):
+        with open(os.path.join(_REPO, *parts), encoding="utf-8") as f:
+            text = f.read()
+        out = set()
+        for pat in self._PATTERNS:
+            out |= set(pat.findall(text))
+        return out
+
+    def test_config_defaults_match_docs_both_ways(self):
+        code = self._keys_in("bigdl_tpu", "utils", "config.py")
+        docs = self._keys_in("docs", "configuration.md")
+        assert code - docs == set(), \
+            f"forensics keys missing a docs row: {sorted(code - docs)}"
+        assert docs - code == set(), \
+            f"documented forensics keys unknown to config.py: " \
+            f"{sorted(docs - code)}"
+
+    def test_module_keys_are_registered_defaults(self):
+        registered = self._keys_in("bigdl_tpu", "utils", "config.py")
+        for parts in (("bigdl_tpu", "telemetry", "request_trace.py"),
+                      ("bigdl_tpu", "telemetry", "incident.py"),
+                      ("bigdl_tpu", "utils", "logger_filter.py")):
+            used = self._keys_in(*parts)
+            assert used - registered == set(), \
+                f"{parts[-1]} reads unregistered keys: " \
+                f"{sorted(used - registered)}"
+
+
 class TestSemanticCheckpointFingerprint:
     """Satellite d: a snapshot whose payload checksums verify but whose
     save-time fingerprint mismatches is refused with a structured log

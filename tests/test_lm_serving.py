@@ -412,6 +412,95 @@ class TestLMChaos:
             "no shed stream retained a streamed prefix"
         _zero_retraces(eng)
 
+    def test_combined_chaos_every_failure_explains_itself(self, tmp_path):
+        """ISSUE-20 acceptance, end to end: under the ISSUE-18
+        combined-chaos plan with request tracing armed, every
+        non-completed request's trace id resolves to a causally-ordered
+        span chain ending in its EXACT verdict; the tail-latency
+        exemplar resolves to a real request; and each injected terminal
+        fault writes exactly one schema-validated incident bundle whose
+        event ring names the injection."""
+        import json
+
+        from bigdl_tpu import telemetry
+        from bigdl_tpu.telemetry import incident, request_trace
+        config.set_property("bigdl.lm.stallFactor", 20.0)
+        config.set_property("bigdl.lm.warmupSteps", 2)
+        config.set_property("bigdl.chaos.poisonPromptAt", "2")
+        config.set_property("bigdl.chaos.evictBlockAt", 6)
+        config.set_property("bigdl.chaos.hangDecodeAt", "20:3.0")
+        config.set_property("bigdl.incident.dir", str(tmp_path))
+        config.set_property("bigdl.incident.autoDump", True)
+        request_trace.arm()
+        chaos.install()
+        reqs = sample_lm_workload(12, VOCAB, seed=9,
+                                  prompt_lens=(4, 6, 8),
+                                  output_lens=(4, 6, 8))
+        try:
+            with _engine() as eng:
+                eng.start()
+                rec = run_lm_open_loop(eng, reqs, rate_hz=200.0, seed=4)
+                stats = eng.stats()
+        finally:
+            config.clear_property("bigdl.incident.dir")
+        _assert_identity(rec)
+        _assert_identity(stats)
+        assert rec["quarantined"] >= 1 and rec["shed"] >= 1, rec
+
+        # every request — admitted or rejected at the door — resolves
+        # to a trace ending in its exact terminal verdict
+        for key, s in rec["streams"]:
+            if s is None:
+                err = rec["errors"][key]
+                tid = getattr(err, "trace_id", None)
+                assert tid, "rejections carry their trace id on the error"
+                assert request_trace.get(tid)["verdict"] == "rejected"
+                continue
+            tr = request_trace.get(s.trace_id)
+            assert tr is not None, "every admitted stream is traced"
+            assert tr["verdict"] == s.outcome, (key, s.outcome, tr)
+            names = [sp["name"] for sp in tr["spans"]]
+            assert names[0] == "request/queue_wait", names
+            assert names[-1] == "request/verdict", names
+            assert "request/admit" in names
+            starts = [sp["t0_ns"] for sp in tr["spans"]]
+            assert starts == sorted(starts), "span chain causally ordered"
+            if s.outcome == "completed":
+                assert "request/prefill" in names
+                assert "request/decode_step" in names
+
+        # exemplar round-trip: the tail of the latency histogram IS a
+        # real request from this run
+        ex = telemetry.histogram("LM/latency_ms").tail_exemplar()
+        run_tids = {s.trace_id for _, s in rec["streams"] if s is not None}
+        assert ex in run_tids
+        assert request_trace.get(ex) is not None
+
+        # one schema-validated bundle per injected terminal fault,
+        # its ring naming the injection
+        paths = incident.dumped()
+        docs = []
+        for p in paths:
+            with open(p) as f:
+                docs.append(json.load(f))
+        reasons = [d["reason"] for d in docs]
+        assert len(set(reasons)) == len(reasons), \
+            "one bundle per fault slug, never duplicates"
+        assert "lm/quarantine" in reasons
+        assert "lm/hung_decode" in reasons
+        ring_kinds = {e["kind"] for d in docs for e in d["events"]}
+        assert "chaos/poison_prompt" in ring_kinds
+        assert "chaos/hang_decode" in ring_kinds
+        for d in docs:
+            assert d["schema"] == "bigdl.incident/1"
+            for k in ("reason", "written_ns", "events", "spans",
+                      "metrics", "config", "threads", "trace_id"):
+                assert k in d, k
+        quarantine = docs[reasons.index("lm/quarantine")]
+        assert quarantine["trace"] is not None
+        assert quarantine["trace"]["verdict"] == "quarantined"
+        _zero_retraces(eng)
+
 
 # ---------------------------------------------------------------------------
 # int8 decode tier

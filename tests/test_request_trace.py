@@ -1,0 +1,628 @@
+"""Per-request tracing + the incident flight recorder (ISSUE 20).
+
+The claims under test: every serving/LM/fleet submission gets a trace
+id at the admission door and accumulates a causally-ordered span chain
+ending in its exact terminal verdict; tail-latency histograms carry
+exemplar trace ids so a p99 outlier resolves to a real request in one
+lookup; structured errors carry ``trace_id``; the incident recorder
+keeps a bounded always-on event ring and writes ONE schema'd bundle
+per terminal fault (once per fault slug, bounded file count, degrading
+gracefully on a full disk); and injected chaos faults are NAMED in the
+bundle's event ring, so a failure never reads as spontaneous.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu import telemetry
+from bigdl_tpu.fleet import Fleet
+from bigdl_tpu.serving import (HungDispatchError, Overloaded,
+                               ServingDataError, ServingEngine)
+from bigdl_tpu.serving.engine import DeadlineExceeded, OUTCOMES
+from bigdl_tpu.telemetry import incident, request_trace
+from bigdl_tpu.telemetry.metrics import Histogram, MetricsRegistry
+from bigdl_tpu.utils import chaos, config, elastic
+
+DIN, DOUT = 4, 3
+
+_KEYS = (
+    "bigdl.compile.buckets", "bigdl.serving.warmupBatches",
+    "bigdl.trace.requests", "bigdl.trace.maxTraces",
+    "bigdl.trace.maxSpansPerTrace",
+    "bigdl.incident.ringSize", "bigdl.incident.maxDumps",
+    "bigdl.incident.dir", "bigdl.incident.autoDump",
+    "bigdl.chaos.poisonRequestAt", "bigdl.chaos.hangDispatchAt",
+    "bigdl.chaos.killReplicaAt", "bigdl.chaos.diskFullAt",
+    "bigdl.chaos.slowRequestAt",
+    "bigdl.fleet.maxReplicaRestarts",
+)
+
+
+@pytest.fixture(autouse=True)
+def _trace_env():
+    """Armed request tracing, disarmed chaos, clean knobs around every
+    test (the conftest fixture already resets traces/ring after)."""
+    from bigdl_tpu.resources import storage
+    elastic.clear_preemption()
+    request_trace.arm()
+    yield
+    chaos.uninstall()
+    elastic.clear_preemption()
+    storage.reset()
+    for k in _KEYS:
+        config.clear_property(k)
+
+
+def _model(seed=7):
+    m = (nn.Sequential().add(nn.Linear(DIN, 16)).add(nn.Tanh())
+         .add(nn.Linear(16, DOUT)))
+    m.reset(jax.random.PRNGKey(seed))
+    return m
+
+
+def _engine(model=None, buckets="2,4,8", warm=True, **kw):
+    if buckets:
+        config.set_property("bigdl.compile.buckets", buckets)
+    eng = ServingEngine(model if model is not None else _model(), **kw)
+    if warm:
+        eng.warmup(np.zeros((DIN,), np.float32))
+    return eng
+
+
+def _rows(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((n, DIN)).astype(np.float32)
+
+
+def _span_names(trace):
+    return [s["name"] for s in trace["spans"]]
+
+
+def _assert_identity(stats):
+    assert stats["unaccounted"] == 0, stats
+    assert sum(stats[o] for o in OUTCOMES) == stats["submitted"], stats
+
+
+# ---------------------------------------------------------------------------
+# request_trace unit behaviour
+# ---------------------------------------------------------------------------
+
+class TestRequestTraceUnit:
+    def test_disarmed_mint_returns_none_and_recorders_noop(self):
+        request_trace.disarm()
+        tid = request_trace.mint("req")
+        assert tid is None
+        # every recorder must be a no-op on None — call sites thread the
+        # id unconditionally
+        request_trace.record_span(None, "x", 0, 1)
+        request_trace.instant(None, "x")
+        assert request_trace.verdict(None, "completed") is False
+        assert request_trace.get(None) is None
+        with request_trace.span(None, "x"):
+            pass
+
+    def test_span_chain_is_causally_ordered(self):
+        tid = request_trace.mint("req", deadline_ms=50.0)
+        t = telemetry.clock_ns()
+        # recorded out of order on purpose: get() must sort by start
+        request_trace.record_span(tid, "request/dispatch", t + 200, t + 300)
+        request_trace.record_span(tid, "request/queue_wait", t, t + 100)
+        request_trace.verdict(tid, "completed")
+        tr = request_trace.get(tid)
+        assert _span_names(tr) == ["request/queue_wait",
+                                   "request/dispatch", "request/verdict"]
+        assert tr["verdict"] == "completed"
+        assert tr["attrs"] == {"deadline_ms": 50.0}
+
+    def test_verdict_first_wins_and_tags_error(self):
+        tid = request_trace.mint("req")
+        err = Overloaded("queue full")
+        assert request_trace.verdict(tid, "rejected", error=err,
+                                     reason="queue_full") is True
+        assert err.trace_id == tid
+        # a later verdict (e.g. a racing abandon) must not overwrite
+        assert request_trace.verdict(tid, "shed") is False
+        tr = request_trace.get(tid)
+        assert tr["verdict"] == "rejected" and tr["reason"] == "queue_full"
+
+    def test_registry_bounded_oldest_trace_evicted(self):
+        request_trace.arm(max_traces=4)
+        tids = [request_trace.mint("req") for _ in range(6)]
+        assert request_trace.get(tids[0]) is None
+        assert request_trace.get(tids[1]) is None
+        assert request_trace.get(tids[-1]) is not None
+        assert len(request_trace.traces()) == 4
+
+    def test_spans_bounded_trace_flagged_truncated(self):
+        request_trace.arm(max_spans=3)
+        tid = request_trace.mint("req")
+        for i in range(5):
+            request_trace.instant(tid, f"request/step_{i}")
+        tr = request_trace.get(tid)
+        assert len(tr["spans"]) == 3
+        assert tr["truncated"] is True
+
+    def test_chrome_export_gets_request_lane_with_verdict(self, tmp_path):
+        tid = request_trace.mint("req")
+        t = telemetry.clock_ns()
+        request_trace.record_span(tid, "request/dispatch", t, t + 1000)
+        request_trace.verdict(tid, "shed", reason="expired")
+        doc = telemetry.export_chrome_trace(str(tmp_path / "trace.json"))
+        lanes = [e["args"]["name"] for e in doc["traceEvents"]
+                 if e["ph"] == "M" and e["name"] == "thread_name"
+                 and e["pid"] == 1]
+        assert f"request:{tid} [shed]" in lanes
+        spans = [e for e in doc["traceEvents"]
+                 if e.get("cat") == "request" and e["ph"] == "X"]
+        assert spans and spans[0]["args"]["trace_id"] == tid
+        # the file round-trips as JSON
+        with open(tmp_path / "trace.json") as f:
+            assert json.load(f)["displayTimeUnit"] == "ms"
+
+    def test_spans_mirror_onto_thread_rings_with_trace_id(self):
+        tid = request_trace.mint("req")
+        t = telemetry.clock_ns()
+        request_trace.record_span(tid, "request/dispatch", t, t + 10)
+        mirrored = [e for e in telemetry.events()
+                    if (e["args"] or {}).get("trace_id") == tid]
+        assert mirrored and mirrored[0]["name"] == "request/dispatch"
+
+
+# ---------------------------------------------------------------------------
+# histogram exemplars: the p99 -> trace lookup
+# ---------------------------------------------------------------------------
+
+class TestExemplars:
+    def test_tail_exemplar_is_the_largest_observation(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat")
+        for i, v in enumerate((5.0, 50.0, 2.0)):
+            h.observe(v, exemplar=f"req-{i:06d}")
+        h.observe(1.0)                      # untraced: no exemplar
+        assert h.tail_exemplar() == "req-000001"
+        ex = h.exemplars()
+        assert ex[0] == (50.0, "req-000001")
+        assert all(ex[i][0] >= ex[i + 1][0] for i in range(len(ex) - 1))
+
+    def test_exemplars_bounded(self):
+        from bigdl_tpu.telemetry.metrics import MAX_EXEMPLARS
+        reg = MetricsRegistry()
+        h = reg.histogram("lat")
+        for i in range(MAX_EXEMPLARS * 3):
+            h.observe(float(i), exemplar=f"req-{i:06d}")
+        ex = h.exemplars()
+        assert len(ex) == MAX_EXEMPLARS
+        # the largest survive
+        assert ex[0][0] == float(MAX_EXEMPLARS * 3 - 1)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text-format conformance (satellite: metrics.py export)
+# ---------------------------------------------------------------------------
+
+class TestPrometheusConformance:
+    def test_type_lines_once_per_metric_name(self):
+        reg = MetricsRegistry()
+        reg.counter("Serving/submitted", labels={"svc": "a"}).inc()
+        reg.counter("Serving/submitted", labels={"svc": "b"}).inc()
+        reg.gauge("Serving/queue_depth").set(3)
+        text = reg.prometheus_text()
+        assert text.count("# TYPE Serving_submitted counter") == 1
+        assert text.count("# TYPE Serving_queue_depth gauge") == 1
+        for line in text.splitlines():
+            assert line.startswith("#") or " " in line
+
+    def test_label_values_escaped(self):
+        reg = MetricsRegistry()
+        reg.counter("errs", labels={"msg": 'a"b\\c\nd'}).inc()
+        text = reg.prometheus_text()
+        assert 'msg="a\\"b\\\\c\\nd"' in text
+
+    def test_histogram_buckets_cumulative_with_inf_sum_count(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat")        # DEFAULT_BUCKETS ladder
+        for v in (0.5, 5.0, 50.0, 20000.0):
+            h.observe(v)
+        counts = dict(h.bucket_counts())
+        assert counts[1.0] == 1
+        assert counts[5.0] == 2         # le is inclusive
+        assert counts[50.0] == 3
+        assert counts[10000.0] == 3
+        assert counts[float("inf")] == 4
+        text = reg.prometheus_text()
+        assert "# TYPE lat histogram" in text
+        # bucket lines cumulative and ordered, +Inf == _count
+        assert 'lat_bucket{le="1.0"} 1' in text
+        assert 'lat_bucket{le="5.0"} 2' in text
+        assert 'lat_bucket{le="10000.0"} 3' in text
+        assert 'lat_bucket{le="+Inf"} 4' in text
+        assert "lat_count 4" in text
+        assert f"lat_sum {0.5 + 5.0 + 50.0 + 20000.0}" in text
+
+    def test_bucket_boundary_observation_lands_in_its_le_bucket(self):
+        h = Histogram("lat", buckets=(1.0, 10.0))
+        h.observe(1.0)                  # le="1.0" is inclusive
+        counts = dict(h.bucket_counts())
+        assert counts[1.0] == 1
+        assert counts[10.0] == 1
+        assert counts[float("inf")] == 1
+
+
+# ---------------------------------------------------------------------------
+# incident flight recorder
+# ---------------------------------------------------------------------------
+
+class TestIncidentRecorder:
+    def test_ring_is_bounded_and_resizable(self):
+        config.set_property("bigdl.incident.ringSize", 4)
+        incident.reset()
+        for i in range(10):
+            incident.record("test/event", i=i)
+        evs = incident.events()
+        assert len(evs) == 4
+        assert [e["fields"]["i"] for e in evs] == [6, 7, 8, 9]
+        assert evs[0]["kind"] == "test/event"
+        assert evs[0]["thread"]
+
+    def test_bundle_schema_is_self_contained(self):
+        config.set_property("bigdl.trace.maxTraces", 16)
+        tid = request_trace.mint("req")
+        request_trace.verdict(tid, "shed", reason="expired")
+        incident.record("chaos/poison_request", index=1)
+        doc = incident.bundle("unit-test", trace_id=tid)
+        assert doc["schema"] == "bigdl.incident/1"
+        for key in ("reason", "written_ns", "events", "spans", "metrics",
+                    "config", "threads", "trace", "trace_id"):
+            assert key in doc, key
+        assert doc["trace"]["verdict"] == "shed"
+        assert any(e["kind"] == "chaos/poison_request"
+                   for e in doc["events"])
+        # the effective-config capture names the non-default knob
+        assert doc["config"]["bigdl.trace.maxTraces"] == 16
+        # thread stacks include this very thread
+        assert any("test_bundle_schema" in "".join(stack)
+                   for stack in doc["threads"].values())
+        json.dumps(doc, default=repr)   # JSON-serializable end to end
+
+    def test_dump_bounded_files_oldest_evicted(self, tmp_path):
+        config.set_property("bigdl.incident.dir", str(tmp_path))
+        config.set_property("bigdl.incident.maxDumps", 2)
+        paths = [incident.dump(f"fault-{i}") for i in range(3)]
+        assert all(p is not None for p in paths)
+        assert not os.path.exists(paths[0]), "oldest bundle evicted"
+        assert os.path.exists(paths[1]) and os.path.exists(paths[2])
+        assert incident.dumped() == paths[1:]
+        with open(paths[2]) as f:
+            assert json.load(f)["reason"] == "fault-2"
+        assert telemetry.counter("Incident/dumps").value >= 3
+
+    def test_maybe_dump_once_per_slug(self, tmp_path):
+        config.set_property("bigdl.incident.dir", str(tmp_path))
+        config.set_property("bigdl.incident.autoDump", True)
+        first = incident.maybe_dump("serving/hung_dispatch")
+        again = incident.maybe_dump("serving/hung_dispatch")
+        other = incident.maybe_dump("serving/quarantine")
+        assert first is not None and os.path.exists(first)
+        assert again is None, "one bundle per fault slug per run"
+        assert other is not None and other != first
+
+    def test_maybe_dump_respects_autodump_off(self, tmp_path):
+        config.set_property("bigdl.incident.dir", str(tmp_path))
+        config.set_property("bigdl.incident.autoDump", False)
+        assert incident.maybe_dump("anything") is None
+        assert incident.dumped() == []
+
+    def test_dump_rides_disk_full_degradation(self, tmp_path):
+        """A full disk while writing the bundle must degrade the
+        recorder (PR 14 discipline), never crash the failing run a
+        second time."""
+        from bigdl_tpu.resources import storage
+        config.set_property("bigdl.incident.dir", str(tmp_path))
+        config.set_property("bigdl.chaos.diskFullAt", "1:incident-")
+        chaos.install()
+        assert incident.dump("terminal-fault") is None
+        assert storage.is_degraded("incident")
+        # degraded: later dumps are suppressed without touching disk
+        assert incident.dump("second-fault") is None
+        assert incident.dumped() == []
+
+
+# ---------------------------------------------------------------------------
+# engine integration: the span chain through the serving stack
+# ---------------------------------------------------------------------------
+
+class TestServingEngineTraced:
+    def test_completed_request_full_chain_and_exemplar(self):
+        with _engine(deadline_ms=10000.0, max_batch=4) as eng:
+            handles = [eng.submit(r) for r in _rows(8, seed=1)]
+            for h in handles:
+                h.result(timeout=30)
+            stats = eng.stats()
+        _assert_identity(stats)
+        for h in handles:
+            tr = request_trace.get(h.trace_id)
+            assert tr is not None, "every admitted request is traced"
+            names = _span_names(tr)
+            assert tr["verdict"] == "completed"
+            # causal order: wait -> coalesce -> dispatch -> verdict
+            assert names.index("request/queue_wait") < \
+                names.index("request/coalesce") < \
+                names.index("request/dispatch") < \
+                names.index("request/verdict")
+            dispatch = next(s for s in tr["spans"]
+                            if s["name"] == "request/dispatch")
+            assert dispatch["args"]["pad_to_bucket"] >= \
+                dispatch["args"]["rows"]
+        # exemplar round-trip: the latency histogram's tail exemplar
+        # resolves to a REAL completed request
+        ex = telemetry.histogram("Serving/latency_ms").tail_exemplar()
+        assert ex in {h.trace_id for h in handles}
+        assert request_trace.get(ex)["verdict"] == "completed"
+
+    def test_rejected_request_traced_with_verdict(self):
+        eng = _engine(warm=False, start=False, max_queue_depth=4,
+                      deadline_ms=10000.0)
+        try:
+            for _ in range(4):
+                eng.submit(_rows(1)[0])
+            with pytest.raises(Overloaded) as ei:
+                eng.submit(_rows(1)[0])
+        finally:
+            eng.stop()
+        seen = ei.value
+        assert getattr(seen, "trace_id", None), \
+            "structured serving errors carry their trace id"
+        tr = request_trace.get(seen.trace_id)
+        assert tr["verdict"] == "rejected"
+        assert tr["reason"] == "queue_full"
+        assert tr["error"] and "Overloaded" in tr["error"]
+        _assert_identity(eng.stats())
+
+    def test_expired_request_sheds_with_verdict(self):
+        # chaos wedges the first handled request; everything behind it
+        # ages past its 120 ms deadline and is shed at dequeue time
+        config.set_property("bigdl.chaos.slowRequestAt", "1:0.5")
+        chaos.install()
+        with _engine(deadline_ms=120.0, max_batch=4) as eng:
+            handles = [eng.submit(r) for r in _rows(4)]
+            shed = []
+            for h in handles:
+                try:
+                    h.result(timeout=30)
+                except DeadlineExceeded as e:
+                    shed.append((h, e))
+        assert shed, "the wedge must age out the queued requests"
+        for h, e in shed:
+            assert e.trace_id == h.trace_id
+            tr = request_trace.get(h.trace_id)
+            assert tr["verdict"] == "shed" and tr["reason"] == "expired"
+
+
+# ---------------------------------------------------------------------------
+# chaos propagation: injected faults terminate traces AND name
+# themselves in the incident bundle (satellite: trace-under-chaos)
+# ---------------------------------------------------------------------------
+
+class TestChaosTracePropagation:
+    def test_poison_request_quarantined_trace_and_bundle(self, tmp_path):
+        config.set_property("bigdl.chaos.poisonRequestAt", "1")
+        config.set_property("bigdl.incident.dir", str(tmp_path))
+        config.set_property("bigdl.incident.autoDump", True)
+        chaos.install()
+        with _engine(deadline_ms=10000.0, max_batch=4) as eng:
+            handles = [eng.submit(r) for r in _rows(4, seed=5)]
+            victim = next(h for h in handles if h.index == 1)
+            with pytest.raises(ServingDataError) as ei:
+                victim.result(timeout=30)
+            for h in handles:
+                if h is not victim:
+                    h.result(timeout=30)
+        _assert_identity(eng.stats())
+        # the error carries the trace id; the trace ends in the verdict
+        assert ei.value.trace_id == victim.trace_id
+        tr = request_trace.get(victim.trace_id)
+        assert tr["verdict"] == "quarantined"
+        # exactly one bundle; its event ring NAMES the injected fault
+        assert len(incident.dumped()) == 1
+        with open(incident.dumped()[0]) as f:
+            doc = json.load(f)
+        kinds = [e["kind"] for e in doc["events"]]
+        assert "chaos/poison_request" in kinds
+        assert doc["trace"]["trace_id"] == victim.trace_id
+        # once-per-position: the same plan never double-fires
+        assert chaos._state.poison_fired == {1}
+
+    def test_hang_dispatch_watchdog_trace_and_bundle(self, tmp_path):
+        config.set_property("bigdl.chaos.hangDispatchAt", "5:3.0")
+        config.set_property("bigdl.serving.warmupBatches", 2)
+        config.set_property("bigdl.incident.dir", str(tmp_path))
+        config.set_property("bigdl.incident.autoDump", True)
+        chaos.install()
+        with _engine(deadline_ms=30000.0, max_batch=2, stall_factor=5.0,
+                     cooldown_batches=2) as eng:
+            for _ in range(4):
+                eng.submit(_rows(1)[0]).result(timeout=30)
+            victim = eng.submit(_rows(1)[0])
+            with pytest.raises(HungDispatchError) as ei:
+                victim.result(timeout=30)
+        assert ei.value.trace_id == victim.trace_id
+        tr = request_trace.get(victim.trace_id)
+        assert tr["verdict"] == "shed"
+        assert tr["reason"] == "hung_dispatch"
+        paths = incident.dumped()
+        assert len(paths) == 1, "one incident bundle per injected fault"
+        with open(paths[0]) as f:
+            doc = json.load(f)
+        kinds = [e["kind"] for e in doc["events"]]
+        assert "chaos/hang_dispatch" in kinds
+        assert "serving/abort_inflight" in kinds
+
+    def test_kill_replica_aborted_trace_and_bundle(self, tmp_path):
+        # the kill is an async-raise into the batcher thread; wedge the
+        # dispatch first (slowRequestAt) so it deterministically lands
+        # with a request IN FLIGHT — the stranded handle only the
+        # supervisor sweep can close
+        config.set_property("bigdl.chaos.killReplicaAt", "4:0")
+        config.set_property("bigdl.chaos.slowRequestAt", "1:0.7")
+        config.set_property("bigdl.compile.buckets", "2,4")
+        config.set_property("bigdl.incident.dir", str(tmp_path))
+        config.set_property("bigdl.incident.autoDump", True)
+        chaos.install()
+        fleet = Fleet(poll_interval=0.02)
+        fleet.add_model("svc", _model(), replicas=1,
+                        warm_row=np.zeros((DIN,), np.float32),
+                        engine_kw={"deadline_ms": 30000.0})
+        aborted = []
+        try:
+            handles = []
+            for r in _rows(8):
+                try:
+                    handles.append(fleet.submit("svc", r))
+                except Overloaded:
+                    pass
+                time.sleep(0.005)
+            assert chaos._state.replica_kills == 1
+
+            def _aborted():
+                return [h for h in handles
+                        if h.trace_id is not None and
+                        (request_trace.get(h.trace_id) or {}).get(
+                            "verdict") == "aborted"]
+
+            deadline = time.monotonic() + 15.0
+            while not _aborted() and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert fleet.quiesce(20.0)
+            _assert_identity(fleet.stats("svc"))
+            aborted = _aborted()
+        finally:
+            fleet.stop()
+        assert aborted, "the crashed replica's in-flight requests " \
+            "must end in an aborted-verdict trace"
+        for h in aborted:
+            tr = request_trace.get(h.trace_id)
+            assert tr["verdict"] == "aborted"
+            assert tr["reason"] == "replica_crash"
+            assert h.outcome == "shed", \
+                "the accounting identity still tallies abandons as shed"
+        paths = incident.dumped()
+        assert paths, "the abandon sweep writes an incident bundle"
+        with open(paths[0]) as f:
+            doc = json.load(f)
+        kinds = [e["kind"] for e in doc["events"]]
+        assert "chaos/kill_replica" in kinds
+        assert "fleet/abandon" in kinds
+
+    def test_fleet_rejection_minted_and_traced(self):
+        fleet = Fleet(poll_interval=0.02)
+        fleet.add_model("svc", _model(), replicas=1,
+                        warm_row=np.zeros((DIN,), np.float32))
+        fleet.stop()
+        with pytest.raises(Overloaded) as ei:
+            fleet.submit("svc", np.zeros((DIN,), np.float32))
+        tr = request_trace.get(ei.value.trace_id)
+        assert tr["kind"] == "fleet"
+        assert tr["verdict"] == "rejected"
+        assert tr["reason"] == "fleet_stopped"
+
+
+# ---------------------------------------------------------------------------
+# logger rotation (satellite: bounded bigdl.log)
+# ---------------------------------------------------------------------------
+
+class TestLoggerRotation:
+    def test_log_file_rotates_at_size_cap(self, tmp_path):
+        import logging
+        from bigdl_tpu.utils.logger_filter import redirect_spark_info_logs
+        path = str(tmp_path / "bigdl.log")
+        config.set_property("bigdl.utils.LoggerFilter.maxBytes", 2048)
+        config.set_property("bigdl.utils.LoggerFilter.backupCount", 2)
+        lg = logging.getLogger("bigdl_tpu")
+        prev_handlers, prev_prop = lg.handlers[:], lg.propagate
+        try:
+            redirect_spark_info_logs(log_file=path)
+            for i in range(200):
+                lg.info("rotation filler line %04d %s", i, "x" * 64)
+            assert os.path.exists(path)
+            assert os.path.getsize(path) <= 4096
+            assert os.path.exists(path + ".1"), "rotated generation kept"
+            assert not os.path.exists(path + ".3"), \
+                "backupCount bounds the generations"
+        finally:
+            for h in lg.handlers:
+                h.close()
+            lg.handlers, lg.propagate = prev_handlers, prev_prop
+            config.clear_property("bigdl.utils.LoggerFilter.maxBytes")
+            config.clear_property("bigdl.utils.LoggerFilter.backupCount")
+
+
+# ---------------------------------------------------------------------------
+# lint rule: untraced-terminal-verdict (satellite: the linter proves every
+# terminal error flows through a verdict-recording choke point)
+# ---------------------------------------------------------------------------
+
+class TestUntracedVerdictRule:
+    def _lint(self, tmp_path, body, name="lm.py"):
+        from bigdl_tpu.analysis.lint import lint_paths
+        d = tmp_path / "serving"
+        d.mkdir(exist_ok=True)
+        (d / name).write_text(body, encoding="utf-8")
+        return [f for f in lint_paths([str(tmp_path)])
+                if f.rule == "untraced-terminal-verdict"]
+
+    def test_flags_direct_raise_outside_chokes(self, tmp_path):
+        found = self._lint(tmp_path,
+                           "def _dispatch(self, req):\n"
+                           "    raise Overloaded('no', queue_depth=1,\n"
+                           "                     max_depth=1)\n")
+        assert len(found) == 1 and found[0].line == 2
+        assert "Overloaded" in found[0].message
+
+    def test_flags_raise_of_bound_name(self, tmp_path):
+        found = self._lint(tmp_path,
+                           "def _dispatch(self, req):\n"
+                           "    err = DeadlineExceeded('late')\n"
+                           "    err.extra = 1\n"
+                           "    raise err\n")
+        assert len(found) == 1 and found[0].line == 4
+
+    def test_flags_raw_finish_outside_accounting_chokes(self, tmp_path):
+        found = self._lint(tmp_path,
+                           "def _dispatch(self, req):\n"
+                           "    req._finish('shed', error=None)\n")
+        assert len(found) == 1
+        assert "_finish" in found[0].message
+
+    def test_accepts_choke_functions_and_minted_rejections(self, tmp_path):
+        assert self._lint(
+            tmp_path,
+            "def _validate(self, row):\n"
+            "    raise ServingDataError('bad', index=0)\n"
+            "def generate(self, prompts):\n"
+            "    raise ServingDataError('bad', index=0)\n"
+            "def submit(self, row):\n"
+            "    raise self._reject_locked('queue full')\n"
+            "def _finish_stream(self, stream, outcome):\n"
+            "    stream._finish(outcome, error=None)\n") == []
+
+    def test_out_of_scope_files_are_ignored(self, tmp_path):
+        from bigdl_tpu.analysis.lint import lint_paths
+        (tmp_path / "optim.py").write_text(
+            "def run():\n    raise Overloaded('x')\n", encoding="utf-8")
+        assert [f for f in lint_paths([str(tmp_path)])
+                if f.rule == "untraced-terminal-verdict"] == []
+
+    def test_production_serving_and_fleet_are_clean(self):
+        from bigdl_tpu.analysis.lint import lint_paths
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        targets = [os.path.join(repo, "bigdl_tpu", "serving"),
+                   os.path.join(repo, "bigdl_tpu", "fleet")]
+        assert [f for f in lint_paths(targets)
+                if f.rule == "untraced-terminal-verdict"] == []

@@ -162,9 +162,9 @@ class TestRegistry:
         text = reg.prometheus_text()
         assert '# TYPE Ingest_read_items counter' in text
         assert 'Ingest_read_items{engine="e0"} 9.0' in text
-        assert '# TYPE Telemetry_step_latency_ms summary' in text
+        assert '# TYPE Telemetry_step_latency_ms histogram' in text
         assert 'Telemetry_step_latency_ms_count 1' in text
-        assert 'quantile="0.50"' in text
+        assert 'le="+Inf"' in text
 
     def test_drop_prefix(self):
         reg = MetricsRegistry()
